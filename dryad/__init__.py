@@ -1,0 +1,5 @@
+"""``dryad`` — API-compatibility alias for :mod:`dryad_tpu` (BASELINE.json:5
+names the public surface ``dryad.train`` / ``dryad.predict``)."""
+
+from dryad_tpu import *  # noqa: F401,F403
+from dryad_tpu import __version__, train, predict, Dataset, Booster, Params  # noqa: F401

@@ -1,0 +1,80 @@
+"""dryad_tpu — a TPU-native gradient-boosted-decision-tree framework.
+
+Public API mirrors the reference's ``dryad.train`` / ``dryad.predict``
+surface (BASELINE.json:5).  The ``dryad`` package is an alias of this one.
+
+    import dryad_tpu as dryad
+    ds = dryad.Dataset(X, y)
+    booster = dryad.train({"objective": "binary", "num_trees": 100}, ds)
+    p = dryad.predict(booster, X_test)
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping, Optional
+
+import numpy as np
+
+from dryad_tpu.booster import Booster
+from dryad_tpu.config import Params, make_params
+from dryad_tpu.dataset import Dataset
+
+__version__ = "0.1.0"
+__all__ = ["train", "predict", "Dataset", "Booster", "Params", "__version__"]
+
+
+def train(
+    params: "Params | Mapping[str, Any] | None" = None,
+    train_set: Optional[Dataset] = None,
+    valid_sets: Optional[list[Dataset]] = None,
+    *,
+    backend: str = "auto",
+    init_booster: Optional[Booster] = None,
+    callback=None,
+    **kw: Any,
+) -> Booster:
+    """Train a booster.  backend: 'auto' (TPU if available), 'tpu', 'cpu'."""
+    p = make_params(params, **kw)
+    if train_set is None:
+        raise ValueError("train_set is required")
+    valid = valid_sets[0] if valid_sets else None
+    if backend == "auto":
+        backend = "tpu" if (_accelerator_present() and _engine_present()) else "cpu"
+    if backend == "cpu":
+        from dryad_tpu.cpu.trainer import train_cpu
+
+        return train_cpu(p, train_set, valid, init_booster=init_booster, callback=callback)
+    if backend == "tpu":
+        from dryad_tpu.engine.train import train_device
+
+        return train_device(p, train_set, valid, init_booster=init_booster, callback=callback)
+    raise ValueError(f"unknown backend {backend!r}")
+
+
+def predict(
+    booster: Booster,
+    X: np.ndarray,
+    *,
+    raw_score: bool = False,
+    backend: str = "cpu",
+    num_iteration: Optional[int] = None,
+) -> np.ndarray:
+    """Predict on raw features through the booster's frozen bin mapper."""
+    return booster.predict(
+        X, raw_score=raw_score, backend=backend, num_iteration=num_iteration
+    )
+
+
+def _accelerator_present() -> bool:
+    try:
+        import jax
+
+        return any(d.platform != "cpu" for d in jax.devices())
+    except Exception:
+        return False
+
+
+def _engine_present() -> bool:
+    import importlib.util
+
+    return importlib.util.find_spec("dryad_tpu.engine") is not None

@@ -1,0 +1,208 @@
+"""Booster: the trained model as structure-of-arrays tree tables.
+
+Trees live in flat, preallocated SoA arrays (SURVEY.md §2 #12) so the same
+representation feeds the vectorized CPU predict, the jit TPU predict, and
+checkpointing without conversion:
+
+* ``feature[t, n]``    int32   split feature, or -1 when node n is a leaf
+* ``threshold[t, n]``  int32   split threshold *bin id*; rows with
+                               ``bin <= threshold`` go left (numerical)
+* ``is_cat[t, n]``     bool    categorical split: membership test instead
+* ``cat_bitset[t,n,w]``uint32  bins in the left subset (categorical splits)
+* ``left/right[t, n]`` int32   child node ids
+* ``value[t, n]``      float32 leaf delta (learning-rate already applied)
+
+Node 0 is the root.  Traversal compares *bin ids* (integers), so the CPU and
+TPU predict paths are bit-identical by construction; the float work — summing
+leaf deltas across trees — runs in the same fixed tree order and fp32 on both
+backends (BASELINE.json:5 bit-identity contract).
+
+Multiclass stores K trees per boosting iteration, ordered
+``iteration * K + class``.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+from typing import Optional
+
+import numpy as np
+
+from dryad_tpu.config import Params
+from dryad_tpu.data.sketch import BinMapper
+
+CAT_WORDS = 8  # bitset words per node: supports max_bins <= 256 categorical splits
+
+
+class Booster:
+    def __init__(
+        self,
+        params: Params,
+        mapper: BinMapper,
+        feature: np.ndarray,
+        threshold: np.ndarray,
+        left: np.ndarray,
+        right: np.ndarray,
+        value: np.ndarray,
+        is_cat: np.ndarray,
+        cat_bitset: np.ndarray,
+        init_score: np.ndarray,
+        max_depth_seen: int,
+        best_iteration: int = -1,
+    ):
+        self.params = params
+        self.mapper = mapper
+        self.feature = feature
+        self.threshold = threshold
+        self.left = left
+        self.right = right
+        self.value = value
+        self.is_cat = is_cat
+        self.cat_bitset = cat_bitset
+        self.init_score = np.asarray(init_score, np.float32).reshape(-1)  # (K,) or (1,)
+        self.max_depth_seen = int(max_depth_seen)
+        self.best_iteration = int(best_iteration)
+
+    # ---- shape helpers -----------------------------------------------------
+    @property
+    def num_total_trees(self) -> int:
+        return int(self.feature.shape[0])
+
+    @property
+    def num_outputs(self) -> int:
+        return self.params.num_outputs
+
+    @property
+    def num_iterations(self) -> int:
+        return self.num_total_trees // self.num_outputs
+
+    def tree_arrays(self) -> dict[str, np.ndarray]:
+        return {
+            "feature": self.feature,
+            "threshold": self.threshold,
+            "left": self.left,
+            "right": self.right,
+            "value": self.value,
+            "is_cat": self.is_cat,
+            "cat_bitset": self.cat_bitset,
+        }
+
+    # ---- predict -----------------------------------------------------------
+    def predict(
+        self,
+        X: np.ndarray,
+        *,
+        raw_score: bool = False,
+        backend: str = "cpu",
+        num_iteration: Optional[int] = None,
+    ) -> np.ndarray:
+        """Predict on raw features: bin through the frozen mapper, traverse."""
+        X_binned = self.mapper.transform(np.asarray(X, np.float32))
+        return self.predict_binned(
+            X_binned, raw_score=raw_score, backend=backend, num_iteration=num_iteration
+        )
+
+    def predict_binned(
+        self,
+        X_binned: np.ndarray,
+        *,
+        raw_score: bool = False,
+        backend: str = "cpu",
+        num_iteration: Optional[int] = None,
+    ) -> np.ndarray:
+        if backend == "cpu":
+            from dryad_tpu.cpu.predict import predict_binned_cpu
+
+            raw = predict_binned_cpu(self, X_binned, num_iteration=num_iteration)
+        elif backend == "tpu":
+            from dryad_tpu.engine.predict import predict_binned_device
+
+            raw = np.asarray(predict_binned_device(self, X_binned, num_iteration=num_iteration))
+        else:
+            raise ValueError(f"unknown backend {backend!r}")
+        if raw_score:
+            return raw if self.num_outputs > 1 else raw[:, 0]
+        from dryad_tpu.objectives import get_objective
+
+        out = get_objective(self.params).transform_np(raw)
+        return out if self.num_outputs > 1 else out[:, 0] if out.ndim == 2 else out
+
+    # ---- serialization -----------------------------------------------------
+    def save(self, path: str) -> None:
+        with open(path, "wb") as f:
+            f.write(self.to_bytes())
+
+    def to_bytes(self) -> bytes:
+        buf = io.BytesIO()
+        np.savez_compressed(
+            buf,
+            feature=self.feature,
+            threshold=self.threshold,
+            left=self.left,
+            right=self.right,
+            value=self.value,
+            is_cat=self.is_cat,
+            cat_bitset=self.cat_bitset,
+            init_score=self.init_score,
+            meta=np.frombuffer(
+                json.dumps(
+                    {
+                        "params": self.params.to_dict(),
+                        "max_depth_seen": self.max_depth_seen,
+                        "best_iteration": self.best_iteration,
+                        "format_version": 1,
+                    }
+                ).encode(),
+                dtype=np.uint8,
+            ),
+            mapper=np.frombuffer(self.mapper.to_bytes(), dtype=np.uint8),
+        )
+        return buf.getvalue()
+
+    @classmethod
+    def load(cls, path: str) -> "Booster":
+        with open(path, "rb") as f:
+            return cls.from_bytes(f.read())
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "Booster":
+        with np.load(io.BytesIO(data)) as z:
+            meta = json.loads(bytes(z["meta"]).decode())
+            params = Params.from_dict(meta["params"])
+            mapper = BinMapper.from_bytes(bytes(z["mapper"]))
+            return cls(
+                params,
+                mapper,
+                z["feature"],
+                z["threshold"],
+                z["left"],
+                z["right"],
+                z["value"],
+                z["is_cat"],
+                z["cat_bitset"],
+                z["init_score"],
+                meta["max_depth_seen"],
+                meta.get("best_iteration", -1),
+            )
+
+    # ---- introspection -----------------------------------------------------
+    def feature_importance(self, importance_type: str = "split") -> np.ndarray:
+        """Per-feature importance: 'split' counts uses as a split feature."""
+        F = self.mapper.num_features
+        used = self.feature[self.feature >= 0]
+        if importance_type != "split":
+            raise NotImplementedError("only 'split' importance in this version")
+        return np.bincount(used, minlength=F).astype(np.int64)
+
+
+def empty_tree_arrays(num_total_trees: int, max_nodes: int) -> dict[str, np.ndarray]:
+    return {
+        "feature": np.full((num_total_trees, max_nodes), -1, np.int32),
+        "threshold": np.zeros((num_total_trees, max_nodes), np.int32),
+        "left": np.zeros((num_total_trees, max_nodes), np.int32),
+        "right": np.zeros((num_total_trees, max_nodes), np.int32),
+        "value": np.zeros((num_total_trees, max_nodes), np.float32),
+        "is_cat": np.zeros((num_total_trees, max_nodes), bool),
+        "cat_bitset": np.zeros((num_total_trees, max_nodes, CAT_WORDS), np.uint32),
+    }

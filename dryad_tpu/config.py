@@ -1,0 +1,179 @@
+"""Typed training-parameter surface for dryad_tpu.
+
+Mirrors the ``dryad.train(params, dataset)`` API contract (BASELINE.json:5;
+SURVEY.md §5 "Config/flag system").  The reference checkout was absent in this
+environment (SURVEY.md header), so param names follow the de-facto GBDT
+vocabulary (LightGBM/XGBoost family) that the capability contract in
+SURVEY.md §2 implies; aliases can be grafted on once the reference's exact
+names are observable.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, Mapping, Sequence
+
+OBJECTIVES = ("binary", "multiclass", "regression", "lambdarank")
+GROWTH_POLICIES = ("leafwise", "depthwise")
+
+# Alias table so configs written against common GBDT engines keep working.
+_PARAM_ALIASES = {
+    "num_iterations": "num_trees",
+    "n_estimators": "num_trees",
+    "num_round": "num_trees",
+    "num_boost_round": "num_trees",
+    "eta": "learning_rate",
+    "shrinkage_rate": "learning_rate",
+    "max_bin": "max_bins",
+    "reg_lambda": "lambda_l2",
+    "lambda": "lambda_l2",
+    "min_sum_hessian_in_leaf": "min_child_weight",
+    "min_child_samples": "min_data_in_leaf",
+    "min_data": "min_data_in_leaf",
+    "min_gain_to_split": "min_split_gain",
+    "bagging_fraction": "subsample",
+    "feature_fraction": "colsample",
+    "random_state": "seed",
+    "bagging_seed": "seed",
+    "application": "objective",
+    "grow_policy": "growth",
+    "num_classes": "num_class",
+}
+
+_OBJECTIVE_ALIASES = {
+    "binary_logloss": "binary",
+    "logistic": "binary",
+    "binary:logistic": "binary",
+    "softmax": "multiclass",
+    "multi:softmax": "multiclass",
+    "multiclassova": "multiclass",
+    "l2": "regression",
+    "mse": "regression",
+    "reg:squarederror": "regression",
+    "lambdamart": "lambdarank",
+    "rank:ndcg": "lambdarank",
+}
+
+_GROWTH_ALIASES = {
+    "leaf": "leafwise",
+    "lossguide": "leafwise",
+    "leaf_wise": "leafwise",
+    "depth": "depthwise",
+    "depth_wise": "depthwise",
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class Params:
+    """Frozen, validated hyper-parameters for one training run."""
+
+    objective: str = "binary"
+    num_class: int = 1
+    num_trees: int = 100
+    num_leaves: int = 31
+    max_depth: int = -1          # -1: bounded only by num_leaves
+    learning_rate: float = 0.1
+    max_bins: int = 256          # includes the reserved missing bin (id 0)
+    lambda_l2: float = 1.0
+    min_child_weight: float = 1e-3
+    min_data_in_leaf: int = 20
+    min_split_gain: float = 0.0
+    growth: str = "leafwise"
+    subsample: float = 1.0
+    colsample: float = 1.0
+    seed: int = 0
+    categorical_features: tuple[int, ...] = ()
+    # evaluation / early stopping
+    metric: str = ""              # "" = objective default
+    early_stopping_rounds: int = 0  # 0 = disabled
+    # LambdaMART
+    sigmoid: float = 1.0
+    ndcg_at: int = 10
+    lambdarank_truncation: int = 30
+    # Engine knobs (TPU path)
+    hist_backend: str = "auto"   # auto | xla | pallas
+    hist_subtraction: bool = True
+    rows_per_chunk: int = 65536  # row-tile for the chunked histogram scan
+    deterministic: bool = True
+
+    # ---- derived -----------------------------------------------------------
+    @property
+    def effective_num_leaves(self) -> int:
+        if self.growth == "depthwise" and self.max_depth > 0:
+            return min(self.num_leaves, 2 ** self.max_depth) if self.num_leaves > 0 else 2 ** self.max_depth
+        return self.num_leaves
+
+    @property
+    def max_nodes(self) -> int:
+        return 2 * self.effective_num_leaves - 1
+
+    @property
+    def num_outputs(self) -> int:
+        """Trees trained per boosting iteration (K for multiclass, else 1)."""
+        return self.num_class if self.objective == "multiclass" else 1
+
+    def validate(self) -> "Params":
+        if self.objective not in OBJECTIVES:
+            raise ValueError(f"objective must be one of {OBJECTIVES}, got {self.objective!r}")
+        if self.objective == "multiclass" and self.num_class < 2:
+            raise ValueError("multiclass requires num_class >= 2")
+        if self.growth not in GROWTH_POLICIES:
+            raise ValueError(f"growth must be one of {GROWTH_POLICIES}, got {self.growth!r}")
+        if not (2 <= self.max_bins <= 65536):
+            raise ValueError("max_bins must be in [2, 65536]")
+        if self.categorical_features and self.max_bins > 256:
+            raise ValueError("categorical splits support max_bins <= 256 (bitset width)")
+        if self.min_data_in_leaf < 1:
+            raise ValueError("min_data_in_leaf must be >= 1")
+        if self.num_leaves < 2:
+            raise ValueError("num_leaves must be >= 2")
+        if self.num_trees < 1:
+            raise ValueError("num_trees must be >= 1")
+        if not (0.0 < self.learning_rate):
+            raise ValueError("learning_rate must be > 0")
+        if not (0.0 < self.subsample <= 1.0) or not (0.0 < self.colsample <= 1.0):
+            raise ValueError("subsample/colsample must be in (0, 1]")
+        if self.hist_backend not in ("auto", "xla", "pallas"):
+            raise ValueError("hist_backend must be auto|xla|pallas")
+        return self
+
+    def replace(self, **kw: Any) -> "Params":
+        return dataclasses.replace(self, **kw).validate()
+
+    # ---- construction ------------------------------------------------------
+    @classmethod
+    def from_dict(cls, d: Mapping[str, Any]) -> "Params":
+        norm: dict[str, Any] = {}
+        known = {f.name for f in dataclasses.fields(cls)}
+        for key, value in d.items():
+            key = _PARAM_ALIASES.get(key, key)
+            if key == "objective" and isinstance(value, str):
+                value = _OBJECTIVE_ALIASES.get(value, value)
+            if key == "growth" and isinstance(value, str):
+                value = _GROWTH_ALIASES.get(value, value)
+            if key == "categorical_features" and isinstance(value, Sequence):
+                value = tuple(int(v) for v in value)
+            if key not in known:
+                raise ValueError(f"unknown parameter {key!r}")
+            norm[key] = value
+        return cls(**norm).validate()
+
+    @classmethod
+    def from_json(cls, path: str) -> "Params":
+        with open(path) as f:
+            return cls.from_dict(json.load(f))
+
+    def to_dict(self) -> dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+def make_params(params: "Params | Mapping[str, Any] | None" = None, **kw: Any) -> Params:
+    """Accept a Params, a plain dict, or kwargs — the ``dryad.train`` front door."""
+    if params is None:
+        return Params.from_dict(kw)
+    if isinstance(params, Params):
+        return (params.replace(**kw) if kw else params.validate())
+    merged = dict(params)
+    merged.update(kw)
+    return Params.from_dict(merged)

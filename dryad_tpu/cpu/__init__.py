@@ -1,0 +1,4 @@
+from dryad_tpu.cpu.trainer import train_cpu
+from dryad_tpu.cpu.predict import predict_binned_cpu
+
+__all__ = ["train_cpu", "predict_binned_cpu"]

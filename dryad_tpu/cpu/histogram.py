@@ -1,0 +1,138 @@
+"""Canonical CPU histogram builder + split finder.
+
+This module defines the *semantics* the TPU engine must reproduce
+(SURVEY.md §2 #5-6): per-(feature, bin) gradient/hessian/count sums, prefix
+scans, the exact gain formula, validity masks, and first-index tie-breaking.
+CPU accumulates in float64 for numerical quality; the TPU path accumulates
+fp32 on the MXU — tree-structure parity tests tolerate only the resulting
+last-ulp argmax differences (none observed on continuous data).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+NEG_INF = np.float64(-np.inf)
+
+
+def build_hist(
+    Xb: np.ndarray,
+    g: np.ndarray,
+    h: np.ndarray,
+    rows: np.ndarray,
+    total_bins: int,
+    elem_budget: int = 16_777_216,
+) -> np.ndarray:
+    """Σ grad / Σ hess / count per (feature, bin) over ``rows`` → (3, F, B) f64.
+
+    Single fused bincount over combined (feature*B + bin) indices; the row
+    chunk is sized as ``elem_budget / F`` so per-chunk temporaries stay
+    bounded on wide data (Epsilon, 2000 features — BASELINE.json:9).
+    """
+    F = Xb.shape[1]
+    B = int(total_bins)
+    chunk = max(1, elem_budget // F)
+    offsets = (np.arange(F, dtype=np.int32) * B)[None, :]
+    hg = np.zeros(F * B, np.float64)
+    hh = np.zeros(F * B, np.float64)
+    hc = np.zeros(F * B, np.float64)
+    for start in range(0, rows.size, chunk):
+        rc = rows[start : start + chunk]
+        idx = (Xb[rc].astype(np.int32) + offsets).ravel()
+        gw = np.repeat(g[rc].astype(np.float64), F)
+        hw = np.repeat(h[rc].astype(np.float64), F)
+        hg += np.bincount(idx, weights=gw, minlength=F * B)
+        hh += np.bincount(idx, weights=hw, minlength=F * B)
+        hc += np.bincount(idx, minlength=F * B).astype(np.float64)
+    return np.stack([hg, hh, hc]).reshape(3, F, B)
+
+
+@dataclasses.dataclass
+class SplitInfo:
+    gain: float
+    feature: int
+    threshold: int          # numerical: bin id; categorical: prefix length
+    is_cat: bool
+    cat_members: np.ndarray  # categorical: sorted member bin ids of the left set
+    g_left: float
+    h_left: float
+    c_left: float
+
+
+def leaf_output(G: float, H: float, lambda_l2: float, learning_rate: float) -> float:
+    """Newton leaf value with shrinkage applied (fp32-rounded, both backends)."""
+    return float(np.float32(-(np.float32(G) / np.float32(H + lambda_l2)) * np.float32(learning_rate)))
+
+
+def find_best_split(
+    hist: np.ndarray,
+    G: float,
+    H: float,
+    C: float,
+    *,
+    lambda_l2: float,
+    min_child_weight: float,
+    min_data_in_leaf: int,
+    min_split_gain: float,
+    feature_mask: np.ndarray | None = None,
+    is_categorical: np.ndarray | None = None,
+    cat_smooth: float = 10.0,
+) -> SplitInfo | None:
+    """Best (feature, threshold) over the histogram; None when nothing valid.
+
+    Numerical: scan "bin <= t goes left" for every t.  Categorical: LightGBM
+    style sorted-subset — bins ordered by g/(h+smooth), best prefix becomes
+    the left membership set.  Tie-break: first index in flattened (F, B)
+    order (matches both np.argmax and jnp.argmax).
+    """
+    hg, hh, hc = hist[0], hist[1], hist[2]
+    F, B = hg.shape
+    parent_score = G * G / (H + lambda_l2)
+
+    GL = np.cumsum(hg, axis=1)
+    HL = np.cumsum(hh, axis=1)
+    CL = np.cumsum(hc, axis=1)
+
+    cat_order: dict[int, np.ndarray] = {}
+    if is_categorical is not None and is_categorical.any():
+        # Rewrite the scan to sorted-bin order, only for categorical rows.
+        for f in np.where(is_categorical)[0]:
+            with np.errstate(invalid="ignore", divide="ignore"):
+                ratio = np.where(hc[f] > 0, hg[f] / (hh[f] + cat_smooth), np.inf)
+            o = np.argsort(ratio, kind="stable")
+            cat_order[int(f)] = o
+            GL[f] = np.cumsum(hg[f][o])
+            HL[f] = np.cumsum(hh[f][o])
+            CL[f] = np.cumsum(hc[f][o])
+
+    GR, HR, CR = G - GL, H - HL, C - CL
+    valid = (
+        (CL >= min_data_in_leaf)
+        & (CR >= min_data_in_leaf)
+        & (HL >= min_child_weight)
+        & (HR >= min_child_weight)
+    )
+    if feature_mask is not None:
+        valid &= feature_mask[:, None]
+    with np.errstate(invalid="ignore", divide="ignore"):
+        gain = 0.5 * (GL * GL / (HL + lambda_l2) + GR * GR / (HR + lambda_l2) - parent_score)
+    gain = np.where(valid, gain, NEG_INF)
+
+    flat = int(np.argmax(gain))
+    best_gain = float(gain.ravel()[flat])
+    if not np.isfinite(best_gain) or best_gain <= min_split_gain:
+        return None
+    f, t = flat // B, flat % B
+    if is_categorical is not None and is_categorical[f]:
+        members = np.sort(cat_order[int(f)][: t + 1]).astype(np.int32)
+        return SplitInfo(best_gain, f, t, True, members, float(GL[f, t]), float(HL[f, t]), float(CL[f, t]))
+    return SplitInfo(best_gain, f, t, False, np.empty(0, np.int32), float(GL[f, t]), float(HL[f, t]), float(CL[f, t]))
+
+
+def cat_members_to_bitset(members: np.ndarray, words: int) -> np.ndarray:
+    bs = np.zeros(words, np.uint32)
+    for m in members:
+        bs[m >> 5] |= np.uint32(1) << np.uint32(m & 31)
+    return bs

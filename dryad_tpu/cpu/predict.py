@@ -1,0 +1,61 @@
+"""Canonical CPU predict: vectorized level-synchronous tree traversal.
+
+The contract (BASELINE.json:5): predict is bit-identical between CPU and TPU.
+Traversal decisions compare integer bin ids (exact on both), and the float
+accumulation of leaf deltas runs tree-by-tree in fp32 in the same order as
+the device scan — so equality is structural, not approximate.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+
+def predict_tree_leaves(
+    trees: dict[str, np.ndarray], Xb: np.ndarray, t: int, depth_bound: int
+) -> np.ndarray:
+    """Leaf node id reached by every row in tree ``t``."""
+    N = Xb.shape[0]
+    node = np.zeros(N, np.int64)
+    feature = trees["feature"][t]
+    threshold = trees["threshold"][t]
+    left, right = trees["left"][t], trees["right"][t]
+    is_cat = trees["is_cat"][t]
+    cat_bs = trees["cat_bitset"][t]
+    for _ in range(max(depth_bound, 1)):
+        f = feature[node]
+        internal = f >= 0
+        if not internal.any():
+            break
+        fc = np.where(internal, f, 0)
+        bins_v = Xb[np.arange(N), fc].astype(np.int64)
+        num_left = bins_v <= threshold[node]
+        # bitset word index is clipped: bins beyond the bitset (>256 only on
+        # numerical-split nodes) never consult cat_left
+        word = cat_bs[node, np.minimum(bins_v >> 5, cat_bs.shape[1] - 1)]
+        cat_left = (word >> (bins_v & 31).astype(np.uint32)) & 1 > 0
+        go_left = np.where(is_cat[node], cat_left, num_left)
+        nxt = np.where(go_left, left[node], right[node])
+        node = np.where(internal, nxt, node)
+    return node
+
+
+def predict_binned_cpu(
+    booster, Xb: np.ndarray, num_iteration: Optional[int] = None
+) -> np.ndarray:
+    """Raw scores (N, K): init_score + Σ_t leaf value, fp32, fixed tree order."""
+    K = booster.num_outputs
+    N = Xb.shape[0]
+    if num_iteration is None:
+        # early stopping: default to the best iteration (LightGBM semantics)
+        n_iter = booster.best_iteration if booster.best_iteration > 0 else booster.num_iterations
+    else:
+        n_iter = min(num_iteration, booster.num_iterations)
+    score = np.broadcast_to(booster.init_score, (N, K)).astype(np.float32).copy()
+    trees = booster.tree_arrays()
+    for t in range(n_iter * K):
+        leaves = predict_tree_leaves(trees, Xb, t, booster.max_depth_seen)
+        score[:, t % K] += booster.value[t, leaves]
+    return score

@@ -1,0 +1,297 @@
+"""Canonical CPU leaf-wise trainer — the parity oracle (BASELINE.json:5).
+
+Pure numpy, deterministic.  Defines the exact tree-construction semantics the
+TPU engine replicates (SURVEY.md §7 step 1):
+
+* leaf-wise growth: split the leaf with the globally best gain next; the left
+  child keeps the parent's leaf slot, the right child takes the next free
+  slot; ties broken by lowest slot index (np.argmax first-max).
+* child node stats come from the parent histogram's prefix at the chosen
+  split (not from re-summing rows), exactly as the device path derives them.
+* histogram subtraction (child = parent − sibling) on the larger child when
+  enabled — the smaller child is built directly.
+* bagging/colsample masks are drawn host-side from Philox(seed, iteration)
+  and are shared verbatim with the TPU path, so sampling never breaks parity.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import numpy as np
+
+from dryad_tpu.booster import CAT_WORDS, Booster, empty_tree_arrays
+from dryad_tpu.config import Params
+from dryad_tpu.cpu.histogram import (
+    build_hist,
+    cat_members_to_bitset,
+    find_best_split,
+    leaf_output,
+)
+from dryad_tpu.cpu.predict import predict_tree_leaves
+from dryad_tpu.dataset import Dataset
+from dryad_tpu.objectives import get_objective
+
+
+def sample_masks(params: Params, iteration: int, num_rows: int, num_features: int):
+    """Host-side deterministic bagging/colsample masks, shared by both backends."""
+    row_mask = None
+    feat_mask = None
+    if params.subsample < 1.0 or params.colsample < 1.0:
+        rng = np.random.Generator(np.random.Philox(key=params.seed, counter=iteration))
+        if params.subsample < 1.0:
+            row_mask = rng.uniform(size=num_rows) < params.subsample
+        if params.colsample < 1.0:
+            k = max(1, int(round(params.colsample * num_features)))
+            feat_mask = np.zeros(num_features, bool)
+            feat_mask[rng.permutation(num_features)[:k]] = True
+    return row_mask, feat_mask
+
+
+class _TreeGrower:
+    """Grows one tree; mirrors engine/grower.py step-for-step."""
+
+    def __init__(self, params: Params, Xb: np.ndarray, total_bins: int, is_categorical: np.ndarray):
+        self.p = params
+        self.Xb = Xb
+        self.B = total_bins
+        self.is_cat_feat = is_categorical
+
+    def grow(
+        self,
+        g: np.ndarray,
+        h: np.ndarray,
+        rows: np.ndarray,
+        feat_mask: Optional[np.ndarray],
+        out: dict[str, np.ndarray],
+        t: int,
+    ) -> int:
+        """Fill tree t's row of the SoA arrays; returns max depth reached."""
+        p = self.p
+        L = p.effective_num_leaves
+        max_depth = p.max_depth if p.max_depth > 0 else L  # depth cap
+        # per-leaf-slot state
+        leaf_node = np.full(L, -1, np.int64)
+        leaf_gain = np.full(L, -np.inf)
+        leaf_rows: list[Optional[np.ndarray]] = [None] * L
+        leaf_hist: list[Optional[np.ndarray]] = [None] * L
+        leaf_split: list = [None] * L
+        leaf_G = np.zeros(L)
+        leaf_H = np.zeros(L)
+        leaf_depth = np.zeros(L, np.int64)
+
+        hist0 = build_hist(self.Xb, g, h, rows, self.B)
+        # canonical leaf totals: feature-0 histogram sums (device derives the
+        # same way, keeping parent/child stat bookkeeping backend-identical)
+        G0, H0, C0 = float(hist0[0, 0].sum()), float(hist0[1, 0].sum()), float(rows.size)
+        leaf_node[0], leaf_rows[0], leaf_hist[0] = 0, rows, hist0
+        leaf_G[0], leaf_H[0] = G0, H0
+        leaf_split[0] = self._best(hist0, G0, H0, C0, 0, max_depth, feat_mask)
+        leaf_gain[0] = leaf_split[0].gain if leaf_split[0] else -np.inf
+
+        num_nodes, max_seen_depth = 1, 0
+        depthwise = p.growth == "depthwise"
+        for k in range(L - 1):
+            if depthwise:
+                # split shallowest level first (best gain within the level);
+                # realizes true depth-wise growth in the leaf-wise machinery
+                finite = np.isfinite(leaf_gain)
+                if not finite.any():
+                    break
+                dmin = leaf_depth[finite].min()
+                s = int(np.argmax(np.where(finite & (leaf_depth == dmin), leaf_gain, -np.inf)))
+            else:
+                s = int(np.argmax(leaf_gain))
+            if not np.isfinite(leaf_gain[s]):
+                break
+            split = leaf_split[s]
+            parent = int(leaf_node[s])
+            prows = leaf_rows[s]
+            phist = leaf_hist[s]
+            pG, pH = leaf_G[s], leaf_H[s]
+            depth = int(leaf_depth[s])
+
+            bins_f = self.Xb[prows, split.feature].astype(np.int64)
+            if split.is_cat:
+                go_left = np.isin(bins_f, split.cat_members)
+            else:
+                go_left = bins_f <= split.threshold
+            rows_l, rows_r = prows[go_left], prows[~go_left]
+
+            left_id, right_id = num_nodes, num_nodes + 1
+            num_nodes += 2
+            out["feature"][t, parent] = split.feature
+            out["threshold"][t, parent] = split.threshold if not split.is_cat else 0
+            out["left"][t, parent] = left_id
+            out["right"][t, parent] = right_id
+            if split.is_cat:
+                out["is_cat"][t, parent] = True
+                out["cat_bitset"][t, parent] = cat_members_to_bitset(split.cat_members, CAT_WORDS)
+            max_seen_depth = max(max_seen_depth, depth + 1)
+
+            # child stats from the parent-histogram prefix (canonical contract)
+            GL, HL, CL = split.g_left, split.h_left, split.c_left
+            GR, HR, CR = pG - GL, pH - HL, float(prows.size) - CL
+
+            # histograms: smaller child direct, larger by subtraction
+            left_smaller = rows_l.size <= rows_r.size
+            srows = rows_l if left_smaller else rows_r
+            shist = build_hist(self.Xb, g, h, srows, self.B)
+            if self.p.hist_subtraction:
+                ohist = phist - shist
+            else:
+                ohist = build_hist(self.Xb, g, h, rows_r if left_smaller else rows_l, self.B)
+            hist_l, hist_r = (shist, ohist) if left_smaller else (ohist, shist)
+
+            sl, sr = s, k + 1
+            for slot, node_id, r_, hist_, G_, H_, C_ in (
+                (sl, left_id, rows_l, hist_l, GL, HL, CL),
+                (sr, right_id, rows_r, hist_r, GR, HR, CR),
+            ):
+                leaf_node[slot] = node_id
+                leaf_rows[slot] = r_
+                leaf_hist[slot] = hist_
+                leaf_G[slot], leaf_H[slot] = G_, H_
+                leaf_depth[slot] = depth + 1
+                sp = self._best(hist_, G_, H_, C_, depth + 1, max_depth, feat_mask)
+                leaf_split[slot] = sp
+                leaf_gain[slot] = sp.gain if sp else -np.inf
+
+        # finalize leaf values
+        for slot in range(L):
+            node = int(leaf_node[slot])
+            if node < 0:
+                continue
+            out["feature"][t, node] = -1
+            out["value"][t, node] = leaf_output(
+                leaf_G[slot], leaf_H[slot], self.p.lambda_l2, self.p.learning_rate
+            )
+        return max_seen_depth
+
+    def _best(self, hist, G, H, C, depth, max_depth, feat_mask):
+        if depth >= max_depth or C < 2 * self.p.min_data_in_leaf:
+            return None
+        return find_best_split(
+            hist, G, H, C,
+            lambda_l2=self.p.lambda_l2,
+            min_child_weight=self.p.min_child_weight,
+            min_data_in_leaf=self.p.min_data_in_leaf,
+            min_split_gain=self.p.min_split_gain,
+            feature_mask=feat_mask,
+            is_categorical=self.is_cat_feat,
+        )
+
+
+def train_cpu(
+    params: Params,
+    data: Dataset,
+    valid: Optional[Dataset] = None,
+    *,
+    num_trees: Optional[int] = None,
+    init_booster: Optional[Booster] = None,
+    callback: Optional[Callable[[int, dict], None]] = None,
+) -> Booster:
+    """Reference trainer: ``dryad.train`` semantics on the CPU backend."""
+    p = params.validate()
+    obj = get_objective(p)
+    Xb = data.X_binned
+    y = data.y
+    N, F = Xb.shape
+    K = p.num_outputs
+    B = data.mapper.total_bins
+    is_cat = data.mapper.is_categorical
+    T = (num_trees if num_trees is not None else p.num_trees) * K
+
+    out = empty_tree_arrays(T, p.max_nodes)
+    init = np.asarray(obj.init_score(y, data.weight), np.float32).reshape(-1)
+    score = np.broadcast_to(init, (N, K)).astype(np.float32).copy()
+    qoff = data.query_offsets
+    grower = _TreeGrower(p, Xb, B, is_cat)
+    max_depth_seen = 0
+
+    start_iter = 0
+    if init_booster is not None:
+        # resume: replay prior trees' scores, then keep growing (SURVEY.md §5)
+        prev = init_booster
+        if prev.params.max_nodes != p.max_nodes or prev.num_outputs != K:
+            raise ValueError(
+                "init_booster is incompatible: num_leaves/max_depth/num_class must match "
+                f"(prev max_nodes={prev.params.max_nodes}, new={p.max_nodes}; "
+                f"prev outputs={prev.num_outputs}, new={K})"
+            )
+        if prev.num_total_trees > T:
+            raise ValueError(
+                f"init_booster already has {prev.num_iterations} iterations; "
+                f"new num_trees={T // K} must be >= that"
+            )
+        for t in range(prev.num_total_trees):
+            leaves = predict_tree_leaves(prev.tree_arrays(), Xb, t, prev.max_depth_seen)
+            score[:, t % K] += prev.value[t, leaves]
+        for k_arr in out:
+            out[k_arr][: prev.num_total_trees] = prev.tree_arrays()[k_arr]
+        start_iter = prev.num_iterations
+        max_depth_seen = prev.max_depth_seen
+
+    # validation / early stopping state (SURVEY.md §5 metrics stream)
+    vXb = valid.X_binned if valid is not None else None
+    vscore = (
+        np.broadcast_to(init, (vXb.shape[0], K)).astype(np.float32).copy()
+        if valid is not None
+        else None
+    )
+    best_iteration, best_value, stale = -1, None, 0
+
+    all_rows = np.arange(N, dtype=np.int64)
+    for it in range(start_iter, T // K):
+        if p.objective == "lambdarank":
+            grads, hess = obj.grad_hess_np(score[:, 0], y, data.weight, query_offsets=qoff)
+            grads, hess = grads[:, None], hess[:, None]
+        elif K > 1:
+            grads, hess = obj.grad_hess_np(score, y, data.weight)
+        else:
+            grads, hess = obj.grad_hess_np(score[:, 0], y, data.weight)
+            grads, hess = grads[:, None], hess[:, None]
+
+        row_mask, feat_mask = sample_masks(p, it, N, F)
+        rows = all_rows if row_mask is None else all_rows[row_mask]
+        for k in range(K):
+            t = it * K + k
+            d = grower.grow(grads[:, k], hess[:, k], rows, feat_mask, out, t)
+            max_depth_seen = max(max_depth_seen, d)
+            leaves = predict_tree_leaves(out, Xb, t, max(max_depth_seen, 1))
+            score[:, k] += out["value"][t, leaves]
+            if valid is not None:
+                vleaves = predict_tree_leaves(out, vXb, t, max(max_depth_seen, 1))
+                vscore[:, k] += out["value"][t, vleaves]
+
+        info: dict = {"iteration": it}
+        if valid is not None:
+            from dryad_tpu.metrics import evaluate_raw
+
+            name, value, higher = evaluate_raw(
+                p.objective, p.metric, valid.y, vscore if K > 1 else vscore[:, 0],
+                valid.query_offsets, p.ndcg_at,
+            )
+            info[f"valid_{name}"] = value
+            improved = best_value is None or (value > best_value if higher else value < best_value)
+            if improved:
+                best_iteration, best_value, stale = it + 1, value, 0
+            else:
+                stale += 1
+            if p.early_stopping_rounds and stale >= p.early_stopping_rounds:
+                if callback is not None:
+                    callback(it, info)
+                T = (it + 1) * K  # trim unfilled trailing trees
+                break
+        if callback is not None:
+            callback(it, info)
+
+    for key in out:
+        out[key] = out[key][:T]
+    return Booster(
+        p, data.mapper,
+        out["feature"], out["threshold"], out["left"], out["right"], out["value"],
+        out["is_cat"], out["cat_bitset"],
+        init, max_depth_seen,
+        best_iteration=best_iteration,
+    )

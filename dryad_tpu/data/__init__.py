@@ -1,0 +1,4 @@
+from dryad_tpu.data.sketch import BinMapper, sketch_features
+from dryad_tpu.data.binning import bin_matrix
+
+__all__ = ["BinMapper", "sketch_features", "bin_matrix"]

@@ -1,0 +1,69 @@
+"""Binning front-ends: dense matrices and CSR-style sparse input.
+
+The dense path simply routes through the frozen BinMapper (see data/sketch.py
+for the bit-exact contract).  The sparse path serves Criteo-style workloads
+(BASELINE.json:11): a CSR triple is densified *per row-block* into bin ids,
+where absent entries take the feature's zero-value bin — never materializing
+the dense float matrix.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from dryad_tpu.data.sketch import BinMapper
+
+
+def bin_matrix(X: np.ndarray, mapper: BinMapper) -> np.ndarray:
+    """Dense raw features → bin ids (N, F) uint8/uint16."""
+    return mapper.transform(X)
+
+
+def zero_bins(mapper: BinMapper) -> np.ndarray:
+    """Per-feature bin id that the raw value 0.0 maps to (sparse default)."""
+    zero = np.zeros((1,), np.float32)
+    return np.array(
+        [mapper.transform_column(zero, f)[0] for f in range(mapper.num_features)],
+        np.int32,
+    )
+
+
+def bin_csr(
+    indptr: np.ndarray,
+    indices: np.ndarray,
+    values: np.ndarray,
+    num_features: int,
+    mapper: BinMapper,
+    block_rows: int = 65536,
+) -> np.ndarray:
+    """CSR (indptr, indices, values) → dense binned (N, F) without a dense float pass.
+
+    Implicit zeros bin to the feature's zero bin, matching the dense semantics
+    of a materialized matrix with explicit 0.0 entries bit-for-bit.
+    """
+    n = indptr.shape[0] - 1
+    out = np.empty((n, num_features), mapper.bin_dtype)
+    zb = zero_bins(mapper).astype(mapper.bin_dtype)
+    for start in range(0, n, block_rows):
+        stop = min(start + block_rows, n)
+        block = np.broadcast_to(zb, (stop - start, num_features)).copy()
+        lo, hi = indptr[start], indptr[stop]
+        rows = np.repeat(
+            np.arange(start, stop, dtype=np.int64) - start,
+            np.diff(indptr[start : stop + 1]),
+        )
+        cols = indices[lo:hi]
+        vals = values[lo:hi].astype(np.float32)
+        # bin the explicit entries feature-by-feature (vectorized inside)
+        order = np.argsort(cols, kind="stable")
+        rows_s, cols_s, vals_s = rows[order], cols[order], vals[order]
+        bounds = np.searchsorted(cols_s, np.arange(num_features + 1))
+        for f in range(num_features):
+            a, b = bounds[f], bounds[f + 1]
+            if a == b:
+                continue
+            block[rows_s[a:b], f] = mapper.transform_column(vals_s[a:b], f).astype(
+                mapper.bin_dtype
+            )
+        out[start:stop] = block
+    return out

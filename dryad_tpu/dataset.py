@@ -1,0 +1,98 @@
+"""Dataset container: raw ingest → frozen sketch → binned matrix.
+
+The public surface mirrors the reference's train-time data object implied by
+``dryad.train(params, dataset)`` (BASELINE.json:5).  A Dataset owns:
+
+* the frozen BinMapper (quantile sketch output — the bit-identity anchor),
+* the binned matrix (N, F) uint8/uint16,
+* labels, optional weights, and optional ranking query groups.
+
+Validation sets bin through the *training* mapper (``Dataset.bind``), exactly
+as predict does.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from dryad_tpu.data.binning import bin_csr, bin_matrix
+from dryad_tpu.data.sketch import BinMapper, sketch_features
+
+
+class Dataset:
+    def __init__(
+        self,
+        X: Optional[np.ndarray] = None,
+        y: Optional[np.ndarray] = None,
+        *,
+        weight: Optional[np.ndarray] = None,
+        group: Optional[np.ndarray] = None,
+        categorical_features: Sequence[int] = (),
+        max_bins: int = 256,
+        mapper: Optional[BinMapper] = None,
+        csr: Optional[tuple[np.ndarray, np.ndarray, np.ndarray, int]] = None,
+    ):
+        if (X is None) == (csr is None):
+            raise ValueError("provide exactly one of X (dense) or csr=(indptr, indices, values, num_features)")
+        self.categorical_features = tuple(int(c) for c in categorical_features)
+        if csr is not None:
+            indptr, indices, values, num_features = csr
+            if mapper is None:
+                mapper = _sketch_csr(indptr, indices, values, num_features, max_bins, self.categorical_features)
+            self.mapper = mapper
+            self.X_binned = bin_csr(indptr, indices, values, num_features, mapper)
+        else:
+            X = np.asarray(X, np.float32)
+            if mapper is None:
+                mapper = sketch_features(X, max_bins=max_bins, categorical_features=self.categorical_features)
+            self.mapper = mapper
+            self.X_binned = bin_matrix(X, mapper)
+
+        self.num_rows, self.num_features = self.X_binned.shape
+        self.y = None if y is None else np.ascontiguousarray(y, np.float32)
+        if self.y is not None and self.y.shape[0] != self.num_rows:
+            raise ValueError("y length mismatch")
+        self.weight = None if weight is None else np.ascontiguousarray(weight, np.float32)
+        # ranking: group[i] = #rows in query i (LightGBM convention)
+        self.group = None if group is None else np.ascontiguousarray(group, np.int64)
+        if self.group is not None and int(self.group.sum()) != self.num_rows:
+            raise ValueError("group sizes must sum to num_rows")
+
+    def bind(self, X: np.ndarray, y: Optional[np.ndarray] = None, **kw) -> "Dataset":
+        """Bin new data (validation/test) through this dataset's frozen mapper."""
+        return Dataset(X, y, mapper=self.mapper, categorical_features=self.categorical_features, **kw)
+
+    @property
+    def query_offsets(self) -> Optional[np.ndarray]:
+        if self.group is None:
+            return None
+        return np.concatenate([[0], np.cumsum(self.group)]).astype(np.int64)
+
+
+def _sketch_csr(indptr, indices, values, num_features, max_bins, categorical_features):
+    """Sketch from CSR by densifying per-feature value lists + implicit zeros.
+
+    Implicit zeros participate in the sketch (they dominate Criteo-style
+    data), represented by injecting the exact count of zeros per feature.
+    """
+    n = indptr.shape[0] - 1
+    cols = np.asarray(indices)
+    vals = np.asarray(values, np.float32)
+    order = np.argsort(cols, kind="stable")
+    cols_s, vals_s = cols[order], vals[order]
+    bounds = np.searchsorted(cols_s, np.arange(num_features + 1))
+    from dryad_tpu.data.sketch import FeatureBins, _sketch_categorical, _sketch_numerical  # noqa: PLC0415
+
+    cats = frozenset(int(c) for c in categorical_features)
+    feats: list[FeatureBins] = []
+    for f in range(num_features):
+        explicit = vals_s[bounds[f] : bounds[f + 1]]
+        n_zero = n - explicit.size
+        if n_zero > 0:
+            col = np.concatenate([explicit, np.zeros(n_zero, np.float32)])
+        else:
+            col = explicit
+        feats.append(_sketch_categorical(col, max_bins) if f in cats else _sketch_numerical(col, max_bins))
+    return BinMapper(feats, max_bins)

@@ -1,0 +1,138 @@
+"""Evaluation metrics: AUC, logloss, multiclass logloss, RMSE, NDCG@k.
+
+Canonical numpy implementations (SURVEY.md §2 #11).  The headline metric pair
+is boosting iters/sec + final AUC (BASELINE.json:2); NDCG serves the
+LambdaMART config (BASELINE.json:10).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+_EPS = 1e-15
+
+
+def auc(y_true: np.ndarray, y_score: np.ndarray) -> float:
+    """Exact ROC-AUC via the rank statistic, with midrank tie handling."""
+    y_true = np.asarray(y_true).astype(np.float64)
+    y_score = np.asarray(y_score).astype(np.float64)
+    pos = y_true > 0.5
+    n_pos = int(pos.sum())
+    n_neg = y_true.size - n_pos
+    if n_pos == 0 or n_neg == 0:
+        return float("nan")
+    order = np.argsort(y_score, kind="mergesort")
+    sorted_scores = y_score[order]
+    ranks = np.empty(y_true.size, np.float64)
+    # midranks for ties
+    i = 0
+    while i < y_true.size:
+        j = i
+        while j + 1 < y_true.size and sorted_scores[j + 1] == sorted_scores[i]:
+            j += 1
+        ranks[order[i : j + 1]] = 0.5 * (i + j) + 1.0
+        i = j + 1
+    sum_pos_ranks = ranks[pos].sum()
+    return float((sum_pos_ranks - n_pos * (n_pos + 1) / 2.0) / (n_pos * n_neg))
+
+
+def binary_logloss(y_true: np.ndarray, y_prob: np.ndarray) -> float:
+    y = np.asarray(y_true, np.float64)
+    p = np.clip(np.asarray(y_prob, np.float64), _EPS, 1.0 - _EPS)
+    return float(-(y * np.log(p) + (1 - y) * np.log(1 - p)).mean())
+
+
+def multi_logloss(y_true: np.ndarray, y_prob: np.ndarray) -> float:
+    y = np.asarray(y_true).astype(np.int64)
+    p = np.clip(np.asarray(y_prob, np.float64), _EPS, 1.0)
+    p = p / p.sum(axis=1, keepdims=True)
+    return float(-np.log(p[np.arange(y.size), y]).mean())
+
+
+def accuracy(y_true: np.ndarray, y_prob: np.ndarray) -> float:
+    y = np.asarray(y_true).astype(np.int64)
+    pred = np.asarray(y_prob).argmax(axis=1)
+    return float((pred == y).mean())
+
+
+def rmse(y_true: np.ndarray, y_pred: np.ndarray) -> float:
+    d = np.asarray(y_true, np.float64) - np.asarray(y_pred, np.float64)
+    return float(np.sqrt(np.mean(d * d)))
+
+
+def dcg_at_k(rels: np.ndarray, k: int) -> float:
+    rels = np.asarray(rels, np.float64)[:k]
+    if rels.size == 0:
+        return 0.0
+    gains = np.power(2.0, rels) - 1.0
+    discounts = 1.0 / np.log2(np.arange(2, rels.size + 2))
+    return float((gains * discounts).sum())
+
+
+def ndcg_at_k(
+    y_true: np.ndarray, y_score: np.ndarray, query_offsets: np.ndarray, k: int = 10
+) -> float:
+    """Mean NDCG@k over queries; queries with zero ideal DCG count as 1.0
+    (LightGBM convention)."""
+    y_true = np.asarray(y_true, np.float64)
+    y_score = np.asarray(y_score, np.float64)
+    total, nq = 0.0, 0
+    for q in range(query_offsets.size - 1):
+        a, b = int(query_offsets[q]), int(query_offsets[q + 1])
+        rels = y_true[a:b]
+        order = np.argsort(-y_score[a:b], kind="mergesort")
+        ideal = np.sort(rels)[::-1]
+        idcg = dcg_at_k(ideal, k)
+        total += 1.0 if idcg == 0.0 else dcg_at_k(rels[order], k) / idcg
+        nq += 1
+    return float(total / max(nq, 1))
+
+
+METRICS = {
+    "auc": auc,
+    "binary_logloss": binary_logloss,
+    "multi_logloss": multi_logloss,
+    "accuracy": accuracy,
+    "rmse": rmse,
+}
+
+DEFAULT_METRIC = {
+    "binary": "auc",
+    "multiclass": "multi_logloss",
+    "regression": "rmse",
+    "lambdarank": "ndcg",
+}
+
+HIGHER_BETTER = {"auc": True, "ndcg": True, "accuracy": True,
+                 "binary_logloss": False, "multi_logloss": False, "rmse": False}
+
+
+def evaluate_raw(
+    objective: str,
+    metric: str,
+    y: np.ndarray,
+    raw_score: np.ndarray,
+    query_offsets: np.ndarray | None = None,
+    ndcg_at: int = 10,
+) -> tuple[str, float, bool]:
+    """Evaluate a metric on raw (pre-link) scores → (name, value, higher_better)."""
+    name = metric or DEFAULT_METRIC[objective]
+    s = raw_score if raw_score.ndim == 1 else raw_score[:, 0] if raw_score.shape[1] == 1 else raw_score
+    if name == "auc":
+        value = auc(y, s)
+    elif name == "binary_logloss":
+        value = binary_logloss(y, 1.0 / (1.0 + np.exp(-s)))
+    elif name == "multi_logloss":
+        e = np.exp(s - s.max(axis=1, keepdims=True))
+        value = multi_logloss(y, e / e.sum(axis=1, keepdims=True))
+    elif name == "accuracy":
+        value = accuracy(y, s)
+    elif name == "rmse":
+        value = rmse(y, s)
+    elif name == "ndcg":
+        if query_offsets is None:
+            raise ValueError("ndcg requires query groups on the validation set")
+        value = ndcg_at_k(y, s, query_offsets, k=ndcg_at)
+    else:
+        raise ValueError(f"unknown metric {name!r}")
+    return name, value, HIGHER_BETTER[name]

@@ -1,0 +1,224 @@
+"""Training objectives: gradients/hessians + init score + output transform.
+
+Each objective ships a canonical numpy implementation (used by the CPU
+reference trainer, the parity oracle per BASELINE.json:5) and a jax
+implementation (used on-device by the TPU engine).  Tests assert the two
+agree to fp32 tolerance (SURVEY.md §4 "each objective's grad/hess vs
+autodiff").
+
+Sign convention: we *minimize* the loss; ``g = dL/ds`` for raw score s, and
+the Newton leaf value is ``-G/(H + lambda_l2)``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from dryad_tpu.metrics import dcg_at_k
+
+
+def _sigmoid_np(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+class Binary:
+    """Binary cross-entropy on logit scores (Higgs config, BASELINE.json:7)."""
+
+    name = "binary"
+    num_outputs = 1
+
+    @staticmethod
+    def init_score(y: np.ndarray, weight=None) -> float:
+        w = np.ones_like(y) if weight is None else weight
+        p = float(np.clip(np.average(y, weights=w), 1e-12, 1 - 1e-12))
+        return float(np.log(p / (1 - p)))
+
+    @staticmethod
+    def grad_hess_np(score: np.ndarray, y: np.ndarray, weight=None):
+        p = _sigmoid_np(score.astype(np.float32))
+        g = (p - y).astype(np.float32)
+        h = (p * (1.0 - p)).astype(np.float32)
+        if weight is not None:
+            g, h = g * weight, h * weight
+        return g, h
+
+    @staticmethod
+    def grad_hess_jax(score, y, weight=None):
+        import jax.numpy as jnp  # local: keep numpy path importable without jax init
+
+        p = jnp.asarray(1.0, jnp.float32) / (1.0 + jnp.exp(-score))
+        g = p - y
+        h = p * (1.0 - p)
+        if weight is not None:
+            g, h = g * weight, h * weight
+        return g, h
+
+    @staticmethod
+    def transform_np(score: np.ndarray) -> np.ndarray:
+        return _sigmoid_np(score)
+
+
+class Regression:
+    """Squared error on raw scores (Epsilon config, BASELINE.json:9)."""
+
+    name = "regression"
+    num_outputs = 1
+
+    @staticmethod
+    def init_score(y: np.ndarray, weight=None) -> float:
+        w = np.ones_like(y) if weight is None else weight
+        return float(np.average(y, weights=w))
+
+    @staticmethod
+    def grad_hess_np(score, y, weight=None):
+        g = (score - y).astype(np.float32)
+        h = np.ones_like(g)
+        if weight is not None:
+            g, h = g * weight, h * weight
+        return g, h
+
+    @staticmethod
+    def grad_hess_jax(score, y, weight=None):
+        import jax.numpy as jnp
+
+        g = score - y
+        h = jnp.ones_like(g)
+        if weight is not None:
+            g, h = g * weight, h * weight
+        return g, h
+
+    @staticmethod
+    def transform_np(score):
+        return score
+
+
+class Multiclass:
+    """Softmax cross-entropy; K parallel trees per iteration (Covertype,
+    BASELINE.json:8).  score shape (N, K); y holds class ids."""
+
+    name = "multiclass"
+
+    def __init__(self, num_class: int):
+        self.num_class = int(num_class)
+        self.num_outputs = self.num_class
+
+    def init_score(self, y: np.ndarray, weight=None) -> np.ndarray:
+        # uniform prior start (all-zero logits) keeps CPU/TPU trivially identical
+        return np.zeros(self.num_class, np.float32)
+
+    def grad_hess_np(self, score: np.ndarray, y: np.ndarray, weight=None):
+        s = score.astype(np.float64)
+        s -= s.max(axis=1, keepdims=True)
+        e = np.exp(s)
+        p = (e / e.sum(axis=1, keepdims=True)).astype(np.float32)
+        onehot = np.zeros_like(p)
+        onehot[np.arange(y.shape[0]), y.astype(np.int64)] = 1.0
+        g = p - onehot
+        h = p * (1.0 - p)
+        if weight is not None:
+            g, h = g * weight[:, None], h * weight[:, None]
+        return g, h
+
+    def grad_hess_jax(self, score, y, weight=None):
+        import jax
+        import jax.numpy as jnp
+
+        p = jax.nn.softmax(score, axis=1)
+        onehot = jax.nn.one_hot(y.astype(jnp.int32), self.num_class, dtype=jnp.float32)
+        g = p - onehot
+        h = p * (1.0 - p)
+        if weight is not None:
+            g, h = g * weight[:, None], h * weight[:, None]
+        return g, h
+
+    @staticmethod
+    def transform_np(score):
+        s = score.astype(np.float64)
+        s -= s.max(axis=1, keepdims=True)
+        e = np.exp(s)
+        return (e / e.sum(axis=1, keepdims=True)).astype(np.float32)
+
+
+class LambdaRank:
+    """LambdaMART pairwise ranking with |ΔNDCG| weighting (MSLR config,
+    BASELINE.json:10).  Canonical numpy path iterates queries with a
+    vectorized pair matrix per query; the TPU path (engine/lambdarank) uses
+    padded per-query segments (SURVEY.md §3, §7 hard part d).
+    """
+
+    name = "lambdarank"
+    num_outputs = 1
+
+    def __init__(self, sigmoid: float = 1.0, truncation: int = 30):
+        self.sigma = float(sigmoid)
+        self.truncation = int(truncation)
+
+    @staticmethod
+    def init_score(y: np.ndarray, weight=None) -> float:
+        return 0.0
+
+    def grad_hess_np(self, score, y, weight=None, query_offsets=None):
+        assert query_offsets is not None, "lambdarank requires query groups"
+        n = score.shape[0]
+        g = np.zeros(n, np.float32)
+        h = np.zeros(n, np.float32)
+        for q in range(query_offsets.size - 1):
+            a, b = int(query_offsets[q]), int(query_offsets[q + 1])
+            gq, hq = self._query_grad(score[a:b], y[a:b])
+            g[a:b], h[a:b] = gq, hq
+        if weight is not None:
+            g, h = g * weight, h * weight
+        return g, h
+
+    def _query_grad(self, s: np.ndarray, rel: np.ndarray):
+        m = s.shape[0]
+        g = np.zeros(m, np.float32)
+        h = np.zeros(m, np.float32)
+        if m < 2:
+            return g, h
+        order = np.argsort(-s, kind="mergesort")  # current ranking, stable
+        rank_of = np.empty(m, np.int64)
+        rank_of[order] = np.arange(m)
+        ideal = np.sort(rel)[::-1]
+        inv_max_dcg = dcg_at_k(ideal, m)
+        if inv_max_dcg <= 0.0:
+            return g, h
+        inv_max_dcg = 1.0 / inv_max_dcg
+        gains = np.power(2.0, rel.astype(np.float64)) - 1.0
+        discounts = 1.0 / np.log2(rank_of.astype(np.float64) + 2.0)
+        # truncation: only pairs where the better-ranked doc sits in top-k
+        topk = rank_of < self.truncation
+        rel_diff = rel[:, None] - rel[None, :]
+        valid = (rel_diff > 0) & (topk[:, None] | topk[None, :])
+        if not valid.any():
+            return g, h
+        sdiff = (s[:, None] - s[None, :]).astype(np.float64)
+        rho = 1.0 / (1.0 + np.exp(self.sigma * sdiff))  # P(pair mis-ordered-ish)
+        delta_ndcg = (
+            np.abs(gains[:, None] - gains[None, :])
+            * np.abs(discounts[:, None] - discounts[None, :])
+            * inv_max_dcg
+        )
+        lam = np.where(valid, self.sigma * rho * delta_ndcg, 0.0)
+        hes = np.where(valid, self.sigma * self.sigma * rho * (1.0 - rho) * delta_ndcg, 0.0)
+        # i preferred over j: push s_i up (negative gradient), s_j down
+        g -= lam.sum(axis=1).astype(np.float32)
+        g += lam.sum(axis=0).astype(np.float32)
+        h += (hes.sum(axis=1) + hes.sum(axis=0)).astype(np.float32)
+        return g, h
+
+    @staticmethod
+    def transform_np(score):
+        return score
+
+
+def get_objective(params) -> object:
+    if params.objective == "binary":
+        return Binary()
+    if params.objective == "regression":
+        return Regression()
+    if params.objective == "multiclass":
+        return Multiclass(params.num_class)
+    if params.objective == "lambdarank":
+        return LambdaRank(params.sigmoid, params.lambdarank_truncation)
+    raise ValueError(f"unknown objective {params.objective!r}")

@@ -1,0 +1,60 @@
+import numpy as np
+
+from dryad_tpu.data.binning import bin_csr, bin_matrix, zero_bins
+from dryad_tpu.data.sketch import sketch_features
+from dryad_tpu.dataset import Dataset
+from dryad_tpu import datasets
+
+
+def _dense_from_csr(indptr, indices, values, n, F):
+    X = np.zeros((n, F), np.float32)
+    for r in range(n):
+        for k in range(indptr[r], indptr[r + 1]):
+            X[r, indices[k]] = values[k]
+    return X
+
+
+def test_csr_matches_dense_bitwise():
+    (indptr, indices, values, F), y, cat_ids = datasets.criteo_like(n=2000, seed=19)
+    n = indptr.shape[0] - 1
+    X = _dense_from_csr(indptr, indices, values, n, F)
+    mapper = sketch_features(X, max_bins=64, categorical_features=cat_ids)
+    dense_bins = bin_matrix(X, mapper)
+    csr_bins = bin_csr(indptr, indices, values, F, mapper, block_rows=333)
+    np.testing.assert_array_equal(dense_bins, csr_bins)
+
+
+def test_csr_dataset_sketch_includes_zeros():
+    (indptr, indices, values, F), y, cat_ids = datasets.criteo_like(n=3000, seed=23)
+    ds = Dataset(csr=(indptr, indices, values, F), y=y, categorical_features=cat_ids, max_bins=64)
+    n = indptr.shape[0] - 1
+    X = _dense_from_csr(indptr, indices, values, n, F)
+    ref = sketch_features(X, max_bins=64, categorical_features=cat_ids)
+    np.testing.assert_array_equal(ds.X_binned, bin_matrix(X, ref))
+
+
+def test_zero_bins_consistency():
+    rng = np.random.default_rng(5)
+    X = rng.normal(size=(1000, 3)).astype(np.float32)
+    X[:500, 1] = 0.0
+    m = sketch_features(X, max_bins=32)
+    zb = zero_bins(m)
+    direct = m.transform(np.zeros((1, 3), np.float32))[0]
+    np.testing.assert_array_equal(zb, direct.astype(np.int64))
+
+
+def test_dataset_bind_uses_frozen_mapper():
+    X, y = datasets.higgs_like(2000, seed=3)
+    ds = Dataset(X, y, max_bins=32)
+    Xv, yv = datasets.higgs_like(500, seed=4)
+    dv = ds.bind(Xv, yv)
+    assert dv.mapper is ds.mapper
+    np.testing.assert_array_equal(dv.X_binned, ds.mapper.transform(Xv))
+
+
+def test_group_validation():
+    X, y, group = datasets.mslr_like(num_queries=10, seed=17)
+    ds = Dataset(X, y, group=group)
+    off = ds.query_offsets
+    assert off[0] == 0 and off[-1] == ds.num_rows
+    assert (np.diff(off) == group).all()

@@ -1,0 +1,143 @@
+import numpy as np
+import pytest
+
+import dryad_tpu as dryad
+from dryad_tpu import datasets
+from dryad_tpu.metrics import auc, binary_logloss, rmse, multi_logloss, accuracy
+
+
+@pytest.fixture(scope="module")
+def higgs_small():
+    X, y = datasets.higgs_like(20_000, seed=7)
+    return X[:16_000], y[:16_000], X[16_000:], y[16_000:]
+
+
+def test_binary_end_to_end(higgs_small):
+    Xtr, ytr, Xte, yte = higgs_small
+    ds = dryad.Dataset(Xtr, ytr, max_bins=64)
+    b = dryad.train(
+        {"objective": "binary", "num_trees": 30, "num_leaves": 31, "learning_rate": 0.2},
+        ds, backend="cpu",
+    )
+    p_tr = dryad.predict(b, Xtr)
+    p_te = dryad.predict(b, Xte)
+    auc_tr, auc_te = auc(ytr, p_tr), auc(yte, p_te)
+    assert auc_tr > 0.80, auc_tr
+    assert auc_te > 0.70, auc_te
+    # boosting actually reduces train loss vs prior
+    base = np.clip(ytr.mean(), 1e-9, 1 - 1e-9)
+    prior_ll = binary_logloss(ytr, np.full_like(ytr, base))
+    assert binary_logloss(ytr, p_tr) < prior_ll * 0.9
+
+
+def test_training_monotone_improvement(higgs_small):
+    Xtr, ytr, _, _ = higgs_small
+    ds = dryad.Dataset(Xtr, ytr, max_bins=64)
+    b = dryad.train({"objective": "binary", "num_trees": 20, "num_leaves": 15}, ds, backend="cpu")
+    p5 = dryad.predict(b, Xtr, num_iteration=5)
+    p20 = dryad.predict(b, Xtr, num_iteration=20)
+    assert binary_logloss(ytr, p20) < binary_logloss(ytr, p5)
+
+
+def test_regression():
+    X, y = datasets.epsilon_like(4000, num_features=50, seed=3)
+    ds = dryad.Dataset(X, y)
+    b = dryad.train({"objective": "regression", "num_trees": 40, "num_leaves": 31, "learning_rate": 0.2}, ds, backend="cpu")
+    pred = dryad.predict(b, X)
+    assert rmse(y, pred) < 0.7 * np.std(y)
+
+
+def test_multiclass():
+    X, y = datasets.covertype_like(8000, seed=5)
+    ds = dryad.Dataset(X, y)
+    b = dryad.train(
+        {"objective": "multiclass", "num_class": 7, "num_trees": 15, "num_leaves": 15, "learning_rate": 0.3},
+        ds, backend="cpu",
+    )
+    prob = dryad.predict(b, X)
+    assert prob.shape == (8000, 7)
+    np.testing.assert_allclose(prob.sum(axis=1), 1.0, atol=1e-5)
+    assert accuracy(y, prob) > 0.55
+    assert multi_logloss(y, prob) < np.log(7) * 0.8
+
+
+def test_min_data_in_leaf_respected():
+    X, y = datasets.higgs_like(2000, seed=1)
+    ds = dryad.Dataset(X, y)
+    b = dryad.train(
+        {"objective": "binary", "num_trees": 3, "num_leaves": 64, "min_data_in_leaf": 200},
+        ds, backend="cpu",
+    )
+    Xb = ds.X_binned
+    from dryad_tpu.cpu.predict import predict_tree_leaves
+
+    for t in range(b.num_total_trees):
+        leaves = predict_tree_leaves(b.tree_arrays(), Xb, t, b.max_depth_seen)
+        counts = np.bincount(leaves)
+        assert counts[counts > 0].min() >= 200
+
+
+def test_max_depth_respected():
+    X, y = datasets.higgs_like(5000, seed=2)
+    ds = dryad.Dataset(X, y)
+    b = dryad.train(
+        {"objective": "binary", "num_trees": 5, "num_leaves": 256, "max_depth": 3},
+        ds, backend="cpu",
+    )
+    assert b.max_depth_seen <= 3
+    # depth 3 -> at most 8 leaves => at most 15 nodes
+    assert (b.feature >= 0).sum(axis=1).max() <= 7
+
+
+def test_depthwise_growth_param():
+    X, y = datasets.higgs_like(3000, seed=4)
+    ds = dryad.Dataset(X, y)
+    b = dryad.train(
+        {"objective": "binary", "num_trees": 3, "growth": "depthwise", "max_depth": 4, "num_leaves": 10_000},
+        ds, backend="cpu",
+    )
+    assert b.params.effective_num_leaves == 16
+
+
+def test_bagging_and_colsample_deterministic():
+    X, y = datasets.higgs_like(5000, seed=6)
+    ds = dryad.Dataset(X, y)
+    params = {"objective": "binary", "num_trees": 10, "subsample": 0.7, "colsample": 0.7, "seed": 42}
+    b1 = dryad.train(params, ds, backend="cpu")
+    b2 = dryad.train(params, ds, backend="cpu")
+    np.testing.assert_array_equal(b1.feature, b2.feature)
+    np.testing.assert_array_equal(b1.value, b2.value)
+    p = dryad.predict(b1, X)
+    assert auc(y, p) > 0.7
+
+
+def test_save_load_roundtrip(tmp_path, higgs_small):
+    Xtr, ytr, Xte, _ = higgs_small
+    ds = dryad.Dataset(Xtr, ytr)
+    b = dryad.train({"objective": "binary", "num_trees": 5}, ds, backend="cpu")
+    path = str(tmp_path / "model.dryad")
+    b.save(path)
+    b2 = dryad.Booster.load(path)
+    np.testing.assert_array_equal(
+        dryad.predict(b, Xte, raw_score=True), dryad.predict(b2, Xte, raw_score=True)
+    )
+
+
+def test_resume_matches_straight_run(higgs_small):
+    Xtr, ytr, _, _ = higgs_small
+    ds = dryad.Dataset(Xtr, ytr)
+    params = {"objective": "binary", "num_trees": 10, "num_leaves": 15}
+    full = dryad.train(params, ds, backend="cpu")
+    half = dryad.train({**params, "num_trees": 5}, ds, backend="cpu")
+    resumed = dryad.train(params, ds, backend="cpu", init_booster=half)
+    np.testing.assert_array_equal(full.feature, resumed.feature)
+    np.testing.assert_allclose(full.value, resumed.value, rtol=1e-6, atol=1e-7)
+
+
+def test_feature_importance(higgs_small):
+    Xtr, ytr, _, _ = higgs_small
+    ds = dryad.Dataset(Xtr, ytr)
+    b = dryad.train({"objective": "binary", "num_trees": 5}, ds, backend="cpu")
+    imp = b.feature_importance()
+    assert imp.shape == (Xtr.shape[1],)
+    assert imp.sum() == (b.feature >= 0).sum()

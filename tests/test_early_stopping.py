@@ -1,0 +1,55 @@
+import numpy as np
+import pytest
+
+import dryad_tpu as dryad
+from dryad_tpu import datasets
+
+
+def test_valid_eval_and_early_stopping():
+    X, y = datasets.higgs_like(12_000, seed=9)
+    ds = dryad.Dataset(X[:8000], y[:8000])
+    dv = ds.bind(X[8000:], y[8000:])
+    seen = []
+    b = dryad.train(
+        {"objective": "binary", "num_trees": 60, "num_leaves": 63,
+         "learning_rate": 0.5, "early_stopping_rounds": 5},
+        ds, valid_sets=[dv], backend="cpu",
+        callback=lambda it, info: seen.append(info),
+    )
+    assert any("valid_auc" in s for s in seen)
+    assert b.best_iteration > 0
+    # predictions default to best_iteration
+    p_best = dryad.predict(b, X[8000:], raw_score=True)
+    p_explicit = dryad.predict(b, X[8000:], raw_score=True, num_iteration=b.best_iteration)
+    np.testing.assert_array_equal(p_best, p_explicit)
+
+
+def test_depthwise_grows_balanced_levels():
+    X, y = datasets.higgs_like(6000, seed=3)
+    ds = dryad.Dataset(X, y)
+    b = dryad.train(
+        {"objective": "binary", "num_trees": 2, "growth": "depthwise", "max_depth": 4,
+         "min_data_in_leaf": 1},
+        ds, backend="cpu",
+    )
+    # depth-wise: every internal node at depth < d-1 was split before any
+    # deeper node → the tree is level-complete: 2^4 = 16 leaves, 15 internal
+    internal = (b.feature[0] >= 0).sum()
+    assert internal == 15, internal
+
+
+def test_resume_incompatible_raises():
+    X, y = datasets.higgs_like(2000, seed=5)
+    ds = dryad.Dataset(X, y)
+    prev = dryad.train({"objective": "binary", "num_trees": 3, "num_leaves": 15}, ds, backend="cpu")
+    with pytest.raises(ValueError, match="incompatible"):
+        dryad.train({"objective": "binary", "num_trees": 6, "num_leaves": 31}, ds,
+                    backend="cpu", init_booster=prev)
+    with pytest.raises(ValueError, match="num_trees"):
+        dryad.train({"objective": "binary", "num_trees": 2, "num_leaves": 15}, ds,
+                    backend="cpu", init_booster=prev)
+
+
+def test_categorical_max_bins_guard():
+    with pytest.raises(ValueError, match="bitset"):
+        dryad.Params.from_dict({"max_bins": 512, "categorical_features": [0]})

@@ -1,0 +1,53 @@
+import numpy as np
+
+from dryad_tpu import metrics
+
+
+def test_auc_perfect_and_random():
+    y = np.array([0, 0, 1, 1])
+    assert metrics.auc(y, np.array([0.1, 0.2, 0.8, 0.9])) == 1.0
+    assert metrics.auc(y, np.array([0.9, 0.8, 0.2, 0.1])) == 0.0
+    assert abs(metrics.auc(y, np.array([0.5, 0.5, 0.5, 0.5])) - 0.5) < 1e-12
+
+
+def test_auc_ties_midrank():
+    y = np.array([0, 1, 0, 1])
+    s = np.array([0.3, 0.3, 0.1, 0.9])
+    # pairs: (pos .3 vs neg .3)=0.5, (pos .3 vs neg .1)=1, (pos .9 vs both)=2 → 3.5/4
+    assert abs(metrics.auc(y, s) - 3.5 / 4) < 1e-12
+
+
+def test_auc_matches_sklearn_formula_random():
+    rng = np.random.default_rng(0)
+    y = (rng.uniform(size=500) < 0.4).astype(float)
+    s = rng.normal(size=500)
+    # brute-force pair counting oracle
+    pos, neg = s[y == 1], s[y == 0]
+    wins = (pos[:, None] > neg[None, :]).sum() + 0.5 * (pos[:, None] == neg[None, :]).sum()
+    assert abs(metrics.auc(y, s) - wins / (pos.size * neg.size)) < 1e-10
+
+
+def test_logloss():
+    y = np.array([1.0, 0.0])
+    p = np.array([0.9, 0.1])
+    expect = -np.mean([np.log(0.9), np.log(0.9)])
+    assert abs(metrics.binary_logloss(y, p) - expect) < 1e-12
+
+
+def test_ndcg():
+    # single query, perfect ranking → 1.0
+    y = np.array([3.0, 2.0, 1.0, 0.0])
+    off = np.array([0, 4])
+    assert abs(metrics.ndcg_at_k(y, np.array([4.0, 3.0, 2.0, 1.0]), off, k=4) - 1.0) < 1e-12
+    worst = metrics.ndcg_at_k(y, np.array([1.0, 2.0, 3.0, 4.0]), off, k=4)
+    assert 0.0 < worst < 1.0
+
+
+def test_ndcg_zero_ideal_counts_one():
+    y = np.zeros(4)
+    off = np.array([0, 4])
+    assert metrics.ndcg_at_k(y, np.arange(4.0), off, k=4) == 1.0
+
+
+def test_rmse():
+    assert metrics.rmse(np.array([1.0, 2.0]), np.array([1.0, 4.0])) == np.sqrt(2.0)

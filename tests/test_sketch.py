@@ -1,0 +1,99 @@
+import numpy as np
+import pytest
+
+from dryad_tpu.data.sketch import MISSING_BIN, BinMapper, sketch_features
+
+
+def test_distinct_small_gets_one_bin_per_value():
+    col = np.array([3.0, 1.0, 2.0, 1.0, 3.0, 2.0], np.float32)
+    m = sketch_features(col[:, None], max_bins=256)
+    b = m.transform(col[:, None])[:, 0]
+    # distinct values map to distinct bins, order-preserving, starting at 1
+    assert b.tolist() == [3, 1, 2, 1, 3, 2]
+    assert m.features[0].n_bins == 4  # missing bin + one bin per distinct value
+
+def test_monotone_binning():
+    rng = np.random.default_rng(0)
+    col = rng.normal(size=10_000).astype(np.float32)
+    m = sketch_features(col[:, None], max_bins=64)
+    b = m.transform(col[:, None])[:, 0]
+    order = np.argsort(col)
+    assert (np.diff(b[order].astype(int)) >= 0).all()
+    assert b.min() >= 1
+    assert int(b.max()) <= 63
+
+
+def test_heavy_ties_do_not_straddle():
+    col = np.concatenate([np.zeros(5000), np.ones(100), np.full(100, 2.0)]).astype(np.float32)
+    m = sketch_features(col[:, None], max_bins=8)
+    b = m.transform(col[:, None])[:, 0]
+    assert len(np.unique(b[col == 0.0])) == 1
+    assert len(np.unique(b[col == 1.0])) == 1
+
+
+def test_nan_goes_to_missing_bin():
+    col = np.array([1.0, np.nan, 2.0, np.nan], np.float32)
+    m = sketch_features(col[:, None], max_bins=16)
+    b = m.transform(col[:, None])[:, 0]
+    assert b[1] == MISSING_BIN and b[3] == MISSING_BIN
+    assert b[0] != MISSING_BIN and b[2] != MISSING_BIN
+
+
+def test_constant_column():
+    col = np.full(100, 3.5, np.float32)
+    m = sketch_features(col[:, None], max_bins=16)
+    b = m.transform(col[:, None])[:, 0]
+    assert len(np.unique(b)) == 1
+
+
+def test_infinities():
+    col = np.array([-np.inf, -1.0, 0.0, 1.0, np.inf], np.float32)
+    m = sketch_features(col[:, None], max_bins=16)
+    b = m.transform(col[:, None])[:, 0].astype(int)
+    assert (np.diff(b) >= 0).all()
+    assert b[0] >= 1  # -inf is a value, not missing
+
+
+def test_categorical_ranking_and_overflow():
+    col = np.array([5, 5, 5, 7, 7, 9] + [i + 100 for i in range(300)], np.float32)
+    m = sketch_features(col[:, None], max_bins=8, categorical_features=[0])
+    fb = m.features[0]
+    assert fb.is_categorical
+    b = m.transform(col[:, None])[:, 0]
+    # most frequent category (5) gets bin 1
+    assert (b[:3] == 1).all()
+    assert (b[3:5] == 2).all()
+    # rare categories overflow into the last bin
+    assert (b[-100:] == fb.overflow_bin).all()
+    # unseen value at predict time also overflows
+    assert m.transform(np.array([[12345.0]], np.float32))[0, 0] == fb.overflow_bin
+
+
+def test_roundtrip_serialization():
+    rng = np.random.default_rng(1)
+    X = rng.normal(size=(500, 4)).astype(np.float32)
+    X[::7, 2] = np.nan
+    m = sketch_features(X, max_bins=32, categorical_features=[3])
+    m2 = BinMapper.from_bytes(m.to_bytes())
+    np.testing.assert_array_equal(m.transform(X), m2.transform(X))
+
+
+def test_determinism():
+    rng = np.random.default_rng(2)
+    X = rng.normal(size=(2000, 6)).astype(np.float32)
+    a = sketch_features(X, max_bins=64)
+    b = sketch_features(X, max_bins=64)
+    np.testing.assert_array_equal(a.transform(X), b.transform(X))
+    for fa, fb in zip(a.features, b.features):
+        np.testing.assert_array_equal(fa.edges, fb.edges)
+
+
+def test_quantile_balance():
+    rng = np.random.default_rng(3)
+    col = rng.exponential(size=100_000).astype(np.float32)
+    m = sketch_features(col[:, None], max_bins=64)
+    b = m.transform(col[:, None])[:, 0]
+    counts = np.bincount(b)[1:]  # skip missing bin
+    counts = counts[counts > 0]
+    # equal-frequency: no bin should be wildly off 1/62 of the mass
+    assert counts.max() < 3 * counts.mean()
