@@ -1,0 +1,85 @@
+"""Headline benchmark: boosting iters/sec on the Higgs-shaped config
+(BASELINE.json:2 — "boosting iters/sec + final AUC, Higgs, depth-8").
+
+Runs the device trainer on the attached accelerator (TPU under the axon
+tunnel; CPU otherwise), measures steady-state boosting iterations/second
+after a warm-up that absorbs jit compilation, and prints ONE JSON line.
+
+``vs_baseline`` is the speedup over the CPU canonical reference trainer on
+an identical (sub-sampled) config — no published Dryad-on-A100 number exists
+in this environment (BASELINE.md), so the CPU reference is the recorded
+baseline the driver tracks across rounds.
+
+Env knobs: BENCH_ROWS (default 200000), BENCH_TREES (default 20),
+BENCH_LEAVES (default 255), BENCH_GROWTH (default depthwise).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    rows = int(os.environ.get("BENCH_ROWS", 200_000))
+    trees = int(os.environ.get("BENCH_TREES", 20))
+    leaves = int(os.environ.get("BENCH_LEAVES", 255))
+    growth = os.environ.get("BENCH_GROWTH", "depthwise")
+    warmup = 3
+
+    import dryad_tpu as dryad
+    from dryad_tpu.config import make_params
+    from dryad_tpu.datasets import higgs_like
+    from dryad_tpu.metrics import auc
+
+    X, y = higgs_like(rows, seed=7)
+    ds = dryad.Dataset(X, y, max_bins=256)
+    params = make_params(dict(
+        objective="binary", num_trees=trees + warmup, num_leaves=leaves,
+        max_depth=8, growth=growth, max_bins=256, learning_rate=0.1,
+    ))
+
+    from dryad_tpu.engine.train import train_device
+
+    times = []
+    t_last = [time.perf_counter()]
+
+    def cb(it, info):
+        now = time.perf_counter()
+        times.append(now - t_last[0])
+        t_last[0] = now
+
+    booster = train_device(params, ds, callback=cb)
+    steady = times[warmup:]
+    iters_per_sec = len(steady) / sum(steady)
+
+    train_auc = auc(y, booster.predict(X, raw_score=True))
+
+    # CPU-reference baseline on a subsample, scaled to the full row count
+    # (histogram work is linear in rows; SURVEY.md §3 hot loops)
+    base_rows = min(rows, 50_000)
+    Xs = X[:base_rows]
+    ys = y[:base_rows]
+    ds_s = dryad.Dataset(Xs, ys, max_bins=256)
+    cpu_params = params.replace(num_trees=2)
+    t0 = time.perf_counter()
+    dryad.train(cpu_params, ds_s, backend="cpu")
+    cpu_time = (time.perf_counter() - t0) / 2 * (rows / base_rows)
+    vs_baseline = iters_per_sec * cpu_time  # = cpu_time_per_iter / dev_time_per_iter
+
+    print(json.dumps({
+        "metric": f"boosting_iters_per_sec_higgs{rows // 1000}k_depth8_{leaves}leaves",
+        "value": round(iters_per_sec, 3),
+        "unit": "iters/s",
+        "vs_baseline": round(vs_baseline, 3),
+        "final_train_auc": round(float(train_auc), 5),
+        "rows": rows,
+        "trees_timed": len(steady),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -55,6 +55,10 @@ class Dataset:
         if self.y is not None and self.y.shape[0] != self.num_rows:
             raise ValueError("y length mismatch")
         self.weight = None if weight is None else np.ascontiguousarray(weight, np.float32)
+        if self.weight is not None and self.weight.shape[0] != self.num_rows:
+            raise ValueError(
+                f"weight length {self.weight.shape[0]} != num_rows {self.num_rows}"
+            )
         # ranking: group[i] = #rows in query i (LightGBM convention)
         self.group = None if group is None else np.ascontiguousarray(group, np.int64)
         if self.group is not None and int(self.group.sum()) != self.num_rows:

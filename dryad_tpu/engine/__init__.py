@@ -1,0 +1,17 @@
+"""dryad_tpu.engine — the TPU-native training/predict engine.
+
+The reference's three CUDA kernels (per-feature histogram builder, split-gain
+scan, row-partition/apply — BASELINE.json:5) map here to XLA/Pallas programs
+designed for the MXU + VMEM memory hierarchy rather than for CUDA's
+atomic-scatter model:
+
+* histogram.py — scatter-add has no TPU atomics, so the histogram is a
+  masked one-hot matmul (MXU) or a Pallas row-tiled VMEM accumulation.
+* split.py — split-gain scan as a vectorized cumsum + masked argmax.
+* grower.py — the leaf-wise grower as a fixed-trip-count ``lax.fori_loop``
+  with slot masking (XLA needs static shapes; the reference's dynamic
+  host-side loop becomes compiled control flow).
+* train.py / predict.py — the ``dryad.train`` / ``dryad.predict`` device
+  backends; the histogram allreduce rides ``jax.lax.psum`` over ICI/DCN in
+  place of the reference's NCCL (SURVEY.md §2 #13-14).
+"""

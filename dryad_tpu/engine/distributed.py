@@ -1,0 +1,83 @@
+"""Distributed data-parallel training: rows sharded over a device mesh.
+
+The reference distributes GBDT training the NCCL way: shard rows across
+workers, build local per-node grad/hess histograms, allreduce them, take
+identical split decisions everywhere (BASELINE.json:5; SURVEY.md §2 #13-14).
+The TPU-native translation keeps that exact dataflow but rides XLA
+collectives:
+
+* mesh axis ``"data"`` spans all chips (ICI within a slice, DCN across
+  hosts on v5p-64 — the mesh abstracts both).
+* the full per-class train step (grad/hess -> grow -> partition -> score
+  update) runs under ``shard_map``: every device executes the same grower
+  program on its row shard.
+* the ONLY cross-device exchange is the fused grad/hess/count histogram
+  ``jax.lax.psum`` inside ``build_hist`` — one latency-bound allreduce per
+  split, payload (3, F, B) fp32, exactly where the reference put NCCL.
+  Split decisions are then derived from the replicated histogram, so every
+  device grows bit-identical trees with no further communication.
+
+Row counts must divide the mesh; ``pad_rows`` pads with bagged-out rows
+(mask False) that cannot influence any histogram.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dryad_tpu.config import Params
+from dryad_tpu.engine.grower import grow_any
+from dryad_tpu.engine.predict import tree_leaves
+
+AXIS = "data"
+
+
+def make_mesh(devices=None) -> Mesh:
+    devices = jax.devices() if devices is None else devices
+    return Mesh(np.asarray(devices), (AXIS,))
+
+
+def padded_rows(n: int, n_shards: int) -> int:
+    return -(-n // n_shards) * n_shards
+
+
+def shard_rows(mesh: Mesh, *arrays):
+    """Place row-indexed arrays with rows split over the mesh's data axis."""
+    out = []
+    for a in arrays:
+        spec = P(AXIS) if a.ndim == 1 else P(AXIS, *(None,) * (a.ndim - 1))
+        out.append(jax.device_put(a, NamedSharding(mesh, spec)))
+    return tuple(out)
+
+
+@partial(jax.jit, static_argnames=("params", "total_bins", "has_cat", "mesh"))
+def grow_and_apply_sharded(params: Params, total_bins: int, has_cat: bool,
+                           mesh: Mesh, Xb, g, h, bag_mask, feat_mask,
+                           is_cat_feat, score_k):
+    """One sharded tree-grow + score update; tree comes back replicated."""
+
+    def step(Xb_l, g_l, h_l, bag_l, fmask, iscat, score_l):
+        tree = grow_any(
+            params, total_bins, Xb_l, g_l, h_l, bag_l, fmask, iscat,
+            has_cat=has_cat, axis_name=AXIS,
+        )
+        leaves = tree_leaves(tree, Xb_l, tree["max_depth"])
+        return tree, score_l + tree["value"][leaves]
+
+    row = P(AXIS)
+    row2 = P(AXIS, None)
+    rep = P()
+    tree_specs = {
+        "feature": rep, "threshold": rep, "left": rep, "right": rep,
+        "value": rep, "is_cat": rep, "cat_bitset": rep, "max_depth": rep,
+    }
+    return jax.shard_map(
+        step, mesh=mesh,
+        in_specs=(row2, row, row, row, rep, rep, row),
+        out_specs=(tree_specs, row),
+    )(Xb, g, h, bag_mask, feat_mask, is_cat_feat, score_k)

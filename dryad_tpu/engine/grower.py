@@ -1,0 +1,286 @@
+"""Device leaf-wise tree grower — compiled replacement for the reference's
+host-side grower + CUDA row-partition kernel (BASELINE.json:5; SURVEY.md §2
+#7-8).
+
+XLA traces once and forbids data-dependent shapes, so the reference's
+dynamic per-leaf row lists become a **slot machine** (SURVEY.md §7 step 2):
+
+* ``row_slot`` (N,) — every row carries the id of the leaf *slot* it lives
+  in (slot L = out-of-bag sentinel).  The CUDA partition kernel's row
+  shuffling becomes a vectorized ``where`` on this array.
+* L leaf slots, each holding its node id, stats (G/H/C), depth, cached best
+  split, and its full histogram — preallocated, validity-masked.
+* the grow loop is a ``lax.fori_loop`` with exactly L-1 trips; a trip whose
+  best gain is -inf is a compiled no-op (``lax.cond``), mirroring the CPU
+  trainer's early break.
+
+Semantics mirror ``cpu/trainer.py::_TreeGrower`` step for step: the left
+child keeps the parent's slot, the right child takes slot k+1; child stats
+come from the parent histogram prefix; the smaller child's histogram is
+built directly and the larger obtained by subtraction (LightGBM trick —
+halves histogram work); ties broken by first index.
+
+Distribution (SURVEY.md §2 #13-14): under ``shard_map`` with rows sharded,
+every device runs this same program on its shard; the only cross-device
+exchange is the fused grad/hess/count histogram psum inside ``build_hist``
+— exactly where the reference placed its NCCL allreduce.  G/H/C stats are
+derived from the (replicated) histogram, so all devices take identical
+split decisions without further collectives.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from dryad_tpu.booster import CAT_WORDS
+from dryad_tpu.config import Params
+from dryad_tpu.engine.histogram import build_hist
+from dryad_tpu.engine.split import NEG_INF, find_best_split
+
+_BIG_DEPTH = jnp.int32(2**30)
+
+
+def grow_any(params, total_bins, Xb, g, h, bag_mask, feat_mask, is_cat_feat,
+             *, has_cat=False, axis_name=None):
+    """Route to the fastest grower for the growth policy.
+
+    Depth-wise growth takes the level-synchronous path (one batched
+    histogram pass per level — levelwise.py); leaf-wise keeps the exact
+    one-split-at-a-time reference semantics below.
+    """
+    if params.growth == "depthwise" and params.max_depth > 0:
+        from dryad_tpu.engine.levelwise import grow_tree_levelwise
+
+        return grow_tree_levelwise(
+            params, total_bins, Xb, g, h, bag_mask, feat_mask, is_cat_feat,
+            has_cat=has_cat, axis_name=axis_name,
+        )
+    return grow_tree(
+        params, total_bins, Xb, g, h, bag_mask, feat_mask, is_cat_feat,
+        has_cat=has_cat, axis_name=axis_name,
+    )
+
+
+def root_stats(hist0: jnp.ndarray):
+    """Canonical leaf totals = feature-0 histogram sums (cpu/trainer.py
+    contract) — shared by both growers so the derivation can never diverge."""
+    return hist0[0, 0].sum(), hist0[1, 0].sum(), hist0[2, 0].sum()
+
+
+def finalize_leaf_values(p: Params, M: int, slot_node, slot_G, slot_H,
+                         value: jnp.ndarray) -> jnp.ndarray:
+    """Newton leaf values with shrinkage, fp32, scattered to leaf nodes."""
+    vals = -(slot_G / (slot_H + jnp.float32(p.lambda_l2))) * jnp.float32(p.learning_rate)
+    idx = jnp.where(slot_node >= 0, slot_node, M)
+    return value.at[idx].set(vals, mode="drop")
+
+
+def pack_cat_bitset(cat_mask_nodes: jnp.ndarray, M: int) -> jnp.ndarray:
+    """(M, B) bool membership masks -> (M, CAT_WORDS) uint32 node bitsets,
+    bit layout b -> word b>>5, bit b&31 (matches cpu/histogram.py)."""
+    catm = cat_mask_nodes
+    width = CAT_WORDS * 32
+    if catm.shape[1] < width:
+        catm = jnp.pad(catm, ((0, 0), (0, width - catm.shape[1])))
+    bits = catm[:, :width].reshape(M, CAT_WORDS, 32).astype(jnp.uint32)
+    return (bits << jnp.arange(32, dtype=jnp.uint32)).sum(axis=2, dtype=jnp.uint32)
+
+
+def grow_tree(
+    params: Params,
+    total_bins: int,
+    Xb: jnp.ndarray,          # (N, F) uint8/uint16 — local row shard
+    g: jnp.ndarray,           # (N,) f32
+    h: jnp.ndarray,           # (N,) f32
+    bag_mask: jnp.ndarray,    # (N,) bool — bagging subsample
+    feat_mask: jnp.ndarray,   # (F,) bool — colsample
+    is_cat_feat: jnp.ndarray, # (F,) bool
+    *,
+    has_cat: bool = False,
+    axis_name: str | None = None,
+) -> dict[str, Any]:
+    """Grow one tree; returns SoA tree arrays (max_nodes,) + max_depth.
+
+    Pure function of its inputs — jit it (single device) or call it inside
+    ``shard_map`` (rows sharded over ``axis_name``).
+    """
+    p = params
+    N, F = Xb.shape
+    B = int(total_bins)
+    L = p.effective_num_leaves
+    M = p.max_nodes
+    depth_cap = p.max_depth if p.max_depth > 0 else L
+    depthwise = p.growth == "depthwise"
+
+    def best(hist, G, H, C, depth):
+        allow = (depth < depth_cap) & (C >= 2 * p.min_data_in_leaf)
+        return find_best_split(
+            hist, G, H, C,
+            lambda_l2=p.lambda_l2,
+            min_child_weight=p.min_child_weight,
+            min_data_in_leaf=p.min_data_in_leaf,
+            min_split_gain=p.min_split_gain,
+            feat_mask=feat_mask,
+            is_cat_feat=is_cat_feat,
+            allow=allow,
+            has_cat=has_cat,
+        )
+
+    def hist_of(mask):
+        return build_hist(
+            Xb, g, h, mask, B,
+            rows_per_chunk=p.rows_per_chunk, axis_name=axis_name,
+        )
+
+    # ---- root ---------------------------------------------------------------
+    row_slot = jnp.where(bag_mask, 0, L).astype(jnp.int32)
+    hist0 = hist_of(row_slot == 0)
+    G0, H0, C0 = root_stats(hist0)
+    root = best(hist0, G0, H0, C0, jnp.int32(0))
+
+    st = {
+        "row_slot": row_slot,
+        "slot_node": jnp.full((L,), -1, jnp.int32).at[0].set(0),
+        "slot_gain": jnp.full((L,), NEG_INF, jnp.float32).at[0].set(root.gain),
+        "slot_G": jnp.zeros((L,), jnp.float32).at[0].set(G0),
+        "slot_H": jnp.zeros((L,), jnp.float32).at[0].set(H0),
+        "slot_C": jnp.zeros((L,), jnp.float32).at[0].set(C0),
+        "slot_depth": jnp.zeros((L,), jnp.int32),
+        "sp_feature": jnp.full((L,), -1, jnp.int32).at[0].set(root.feature),
+        "sp_thresh": jnp.zeros((L,), jnp.int32).at[0].set(root.threshold),
+        "sp_GL": jnp.zeros((L,), jnp.float32).at[0].set(root.g_left),
+        "sp_HL": jnp.zeros((L,), jnp.float32).at[0].set(root.h_left),
+        "sp_CL": jnp.zeros((L,), jnp.float32).at[0].set(root.c_left),
+        "sp_catmask": jnp.zeros((L, root.cat_mask.shape[0]), bool).at[0].set(root.cat_mask),
+        "hists": jnp.zeros((L, 3, F, B), jnp.float32).at[0].set(hist0),
+        "feature": jnp.full((M,), -1, jnp.int32),
+        "threshold": jnp.zeros((M,), jnp.int32),
+        "left": jnp.zeros((M,), jnp.int32),
+        "right": jnp.zeros((M,), jnp.int32),
+        "value": jnp.zeros((M,), jnp.float32),
+        "is_cat": jnp.zeros((M,), bool),
+        "cat_mask_nodes": jnp.zeros((M, root.cat_mask.shape[0]), bool),
+        "num_nodes": jnp.int32(1),
+        "max_depth": jnp.int32(0),
+    }
+
+    # ---- grow loop ----------------------------------------------------------
+    def pick_slot(s_gain, s_depth):
+        finite = s_gain > NEG_INF
+        if depthwise:
+            # split the shallowest level first, best gain within it
+            dmin = jnp.min(jnp.where(finite, s_depth, _BIG_DEPTH))
+            masked = jnp.where(finite & (s_depth == dmin), s_gain, NEG_INF)
+            return jnp.argmax(masked).astype(jnp.int32)
+        return jnp.argmax(s_gain).astype(jnp.int32)
+
+    def do_split(k, s, st):
+        parent = st["slot_node"][s]
+        sf = st["sp_feature"][s]
+        thr = st["sp_thresh"][s]
+        catm = st["sp_catmask"][s]
+        cat_split = is_cat_feat[sf] if has_cat else jnp.bool_(False)
+
+        bins_f = jnp.take(Xb, sf, axis=1).astype(jnp.int32)
+        if has_cat:
+            go_left = jnp.where(cat_split, catm[jnp.minimum(bins_f, catm.shape[0] - 1)],
+                                bins_f <= thr)
+        else:
+            go_left = bins_f <= thr
+        in_slot = st["row_slot"] == s
+
+        GL, HL, CL = st["sp_GL"][s], st["sp_HL"][s], st["sp_CL"][s]
+        Gp, Hp, Cp = st["slot_G"][s], st["slot_H"][s], st["slot_C"][s]
+        GR, HR, CR = Gp - GL, Hp - HL, Cp - CL
+
+        left_id = st["num_nodes"]
+        right_id = left_id + 1
+        new_r = jnp.int32(k + 1)
+
+        feature = st["feature"].at[parent].set(sf)
+        threshold = st["threshold"].at[parent].set(jnp.where(cat_split, 0, thr))
+        left = st["left"].at[parent].set(left_id)
+        right = st["right"].at[parent].set(right_id)
+        is_cat_arr = st["is_cat"].at[parent].set(cat_split)
+        cat_nodes = st["cat_mask_nodes"].at[parent].set(
+            jnp.where(cat_split, catm, jnp.zeros_like(catm))
+        )
+
+        # row partition/apply: left child keeps slot s, right child takes k+1
+        row_slot = jnp.where(in_slot & ~go_left, new_r, st["row_slot"])
+
+        # smaller child's histogram direct; larger by subtraction
+        left_smaller = CL <= CR
+        if p.hist_subtraction:
+            small_slot = jnp.where(left_smaller, s, new_r)
+            shist = hist_of(row_slot == small_slot)
+            ohist = st["hists"][s] - shist
+            hist_l = jnp.where(left_smaller, shist, ohist)
+            hist_r = jnp.where(left_smaller, ohist, shist)
+        else:
+            hist_l = hist_of(row_slot == s)
+            hist_r = hist_of(row_slot == new_r)
+        hists = st["hists"].at[s].set(hist_l).at[new_r].set(hist_r)
+
+        depth_c = st["slot_depth"][s] + 1
+        res_l = best(hist_l, GL, HL, CL, depth_c)
+        res_r = best(hist_r, GR, HR, CR, depth_c)
+
+        def put(a, vl, vr):
+            return a.at[s].set(vl).at[new_r].set(vr)
+
+        return {
+            "row_slot": row_slot,
+            "slot_node": put(st["slot_node"], left_id, right_id),
+            "slot_gain": put(st["slot_gain"], res_l.gain, res_r.gain),
+            "slot_G": put(st["slot_G"], GL, GR),
+            "slot_H": put(st["slot_H"], HL, HR),
+            "slot_C": put(st["slot_C"], CL, CR),
+            "slot_depth": put(st["slot_depth"], depth_c, depth_c),
+            "sp_feature": put(st["sp_feature"], res_l.feature, res_r.feature),
+            "sp_thresh": put(st["sp_thresh"], res_l.threshold, res_r.threshold),
+            "sp_GL": put(st["sp_GL"], res_l.g_left, res_r.g_left),
+            "sp_HL": put(st["sp_HL"], res_l.h_left, res_r.h_left),
+            "sp_CL": put(st["sp_CL"], res_l.c_left, res_r.c_left),
+            "sp_catmask": put(st["sp_catmask"], res_l.cat_mask, res_r.cat_mask),
+            "hists": hists,
+            "feature": feature,
+            "threshold": threshold,
+            "left": left,
+            "right": right,
+            "value": st["value"],
+            "is_cat": is_cat_arr,
+            "cat_mask_nodes": cat_nodes,
+            "num_nodes": st["num_nodes"] + 2,
+            "max_depth": jnp.maximum(st["max_depth"], depth_c),
+        }
+
+    def body(k, st):
+        s = pick_slot(st["slot_gain"], st["slot_depth"])
+        return jax.lax.cond(
+            st["slot_gain"][s] > NEG_INF,
+            lambda st_: do_split(k, s, st_),
+            lambda st_: st_,
+            st,
+        )
+
+    st = jax.lax.fori_loop(0, L - 1, body, st)
+
+    # ---- finalize leaf values + node bitsets (shared helpers) ---------------
+    value = finalize_leaf_values(p, M, st["slot_node"], st["slot_G"], st["slot_H"],
+                                 st["value"])
+    cat_bitset = pack_cat_bitset(st["cat_mask_nodes"], M)
+
+    return {
+        "feature": st["feature"],
+        "threshold": st["threshold"],
+        "left": st["left"],
+        "right": st["right"],
+        "value": value,
+        "is_cat": st["is_cat"],
+        "cat_bitset": cat_bitset,
+        "max_depth": st["max_depth"],
+    }

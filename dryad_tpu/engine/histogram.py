@@ -1,0 +1,170 @@
+"""Device histogram builder — the TPU equivalent of the reference's CUDA
+per-feature histogram kernel (BASELINE.json:5; SURVEY.md §2 #5).
+
+TPUs have no atomic scatter-add, so the bincount-style scatter the CUDA
+kernel relies on is reformulated as a **masked one-hot matmul** that runs on
+the MXU (SURVEY.md §7 step 2):
+
+    hist[k, f, b] = sum_r w[k, r] * [bin(r, f) == b]      k in {grad, hess, count}
+
+i.e. a (3, C) x (C, F*B) matmul per row-chunk, with the one-hot operand
+built by comparing the chunk's bin ids against an iota and never leaving the
+fusion scope of one chunk.  Chunks are processed under ``lax.scan`` so the
+one-hot temporary stays bounded regardless of N (Epsilon's 2000 features
+stress this — BASELINE.json:9).
+
+Accumulation is fp32: exact for counts below 2**24 and within last-ulp of
+the CPU reference's f64 histograms for gain argmax purposes (documented
+tolerance, SURVEY.md §7 hard part c).
+
+When ``axis_name`` is set the per-shard partial histogram is allreduced with
+``jax.lax.psum`` — the NCCL-allreduce replacement (SURVEY.md §2 #14); grad,
+hess, and count ride one fused psum per call.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+def _chunk_rows(num_rows: int, num_features: int, total_bins: int,
+                rows_per_chunk: int, elem_budget: int = 1 << 26) -> int:
+    """Row-chunk size: respect the caller's cap and a one-hot element budget."""
+    by_budget = max(256, elem_budget // max(num_features * total_bins, 1))
+    c = min(rows_per_chunk, by_budget, max(num_rows, 1))
+    return max(c, 1)
+
+
+def build_hist(
+    Xb: jnp.ndarray,
+    g: jnp.ndarray,
+    h: jnp.ndarray,
+    mask: jnp.ndarray,
+    total_bins: int,
+    *,
+    rows_per_chunk: int = 65536,
+    axis_name: str | None = None,
+) -> jnp.ndarray:
+    """Masked per-(feature, bin) sums -> (3, F, B) fp32: grad, hess, count.
+
+    ``mask`` (N,) bool selects the rows that contribute (the rows of the leaf
+    being histogrammed — the replacement for gathering a dynamic row list,
+    which XLA's static-shape model rules out).
+    """
+    N, F = Xb.shape
+    B = int(total_bins)
+    C = _chunk_rows(N, F, B, rows_per_chunk)
+    pad = (-N) % C
+    if pad:
+        Xb = jnp.pad(Xb, ((0, pad), (0, 0)))
+        g = jnp.pad(g, (0, pad))
+        h = jnp.pad(h, (0, pad))
+        mask = jnp.pad(mask, (0, pad))
+    n_chunks = (N + pad) // C
+
+    Xc = Xb.reshape(n_chunks, C, F)
+    m = mask.astype(jnp.float32).reshape(n_chunks, C)
+    # weights (n_chunks, 3, C): grad, hess, count — one matmul covers all three
+    w = jnp.stack(
+        [g.astype(jnp.float32).reshape(n_chunks, C) * m,
+         h.astype(jnp.float32).reshape(n_chunks, C) * m,
+         m],
+        axis=1,
+    )
+    iota = jnp.arange(B, dtype=jnp.int32)
+
+    def body(acc, chunk):
+        xc, wc = chunk
+        onehot = (xc.astype(jnp.int32)[:, :, None] == iota).astype(jnp.float32)
+        # HIGHEST precision: the default lets XLA round the f32 operands to
+        # bf16 on the MXU, which breaks gain-argmax parity with the CPU ref
+        part = jax.lax.dot_general(
+            wc, onehot.reshape(C, F * B),
+            (((1,), (0,)), ((), ())),
+            precision=jax.lax.Precision.HIGHEST,
+            preferred_element_type=jnp.float32,
+        )
+        return acc + part, None
+
+    acc0 = jnp.zeros((3, F * B), jnp.float32)
+    if axis_name is not None:
+        # under shard_map the carry must be marked device-varying to match
+        # the varying per-chunk partials (JAX vma tracking)
+        acc0 = jax.lax.pcast(acc0, axis_name, to="varying")
+    acc, _ = jax.lax.scan(body, acc0, (Xc, w))
+    hist = acc.reshape(3, F, B)
+    if axis_name is not None:
+        hist = jax.lax.psum(hist, axis_name)  # the NCCL-allreduce equivalent
+    return hist
+
+
+@partial(jax.jit, static_argnames=("total_bins", "rows_per_chunk"))
+def build_hist_jit(Xb, g, h, mask, total_bins, rows_per_chunk=65536):
+    return build_hist(Xb, g, h, mask, total_bins, rows_per_chunk=rows_per_chunk)
+
+
+def build_hist_multi(
+    Xb: jnp.ndarray,
+    g: jnp.ndarray,
+    h: jnp.ndarray,
+    sel: jnp.ndarray,
+    num_cols: int,
+    total_bins: int,
+    *,
+    rows_per_chunk: int = 65536,
+    axis_name: str | None = None,
+) -> jnp.ndarray:
+    """Histograms for ``num_cols`` leaves in ONE pass -> (P, 3, F, B) fp32.
+
+    ``sel`` (N,) assigns each row to a column in [0, P); P means "drop".
+    This is the level-wise formulation (SURVEY.md §7 step 6): batching every
+    leaf of a tree level into the matmul's N dimension costs barely more
+    than a single masked pass, because the MXU pads N to 128 anyway — the
+    per-leaf masked approach wastes that padding P times over.
+
+    One ``psum`` covers all P leaves' grad/hess/count stats when
+    ``axis_name`` is set — the per-level histogram allreduce.
+    """
+    N, F = Xb.shape
+    B = int(total_bins)
+    P = int(num_cols)
+    C = _chunk_rows(N, F, B, rows_per_chunk)
+    pad = (-N) % C
+    if pad:
+        Xb = jnp.pad(Xb, ((0, pad), (0, 0)))
+        g = jnp.pad(g, (0, pad))
+        h = jnp.pad(h, (0, pad))
+        sel = jnp.pad(sel, (0, pad), constant_values=P)
+    n_chunks = (N + pad) // C
+
+    Xc = Xb.reshape(n_chunks, C, F)
+    gc = g.astype(jnp.float32).reshape(n_chunks, C)
+    hc = h.astype(jnp.float32).reshape(n_chunks, C)
+    sc = sel.astype(jnp.int32).reshape(n_chunks, C)
+    iota_b = jnp.arange(B, dtype=jnp.int32)
+    iota_p = jnp.arange(P, dtype=jnp.int32)
+
+    def body(acc, chunk):
+        xc, gk, hk, sk = chunk
+        onehot = (xc.astype(jnp.int32)[:, :, None] == iota_b).astype(jnp.float32)
+        onesel = (sk[None, :] == iota_p[:, None]).astype(jnp.float32)  # (P, C)
+        w = jnp.stack([onesel * gk[None, :], onesel * hk[None, :], onesel])
+        part = jax.lax.dot_general(
+            w.reshape(3 * P, C), onehot.reshape(C, F * B),
+            (((1,), (0,)), ((), ())),
+            precision=jax.lax.Precision.HIGHEST,
+            preferred_element_type=jnp.float32,
+        )
+        return acc + part, None
+
+    acc0 = jnp.zeros((3 * P, F * B), jnp.float32)
+    if axis_name is not None:
+        acc0 = jax.lax.pcast(acc0, axis_name, to="varying")
+    acc, _ = jax.lax.scan(body, acc0, (Xc, gc, hc, sc))
+    hist = acc.reshape(3, P, F, B).transpose(1, 0, 2, 3)
+    if axis_name is not None:
+        hist = jax.lax.psum(hist, axis_name)
+    return hist

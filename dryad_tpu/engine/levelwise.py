@@ -1,0 +1,225 @@
+"""Level-wise (depth-wise) tree grower — the TPU throughput path.
+
+The generic grower (grower.py) mirrors the reference's one-split-at-a-time
+control flow, which costs one full masked histogram pass per split — the
+MXU pads the 3-row weight matrix to 128 rows, so per-split passes waste
+~40x of the matrix unit.  Growing level-synchronously amortizes that: every
+leaf of a level lands in one ``build_hist_multi`` call whose weight matrix
+carries 3 columns per leaf, so a whole level of histograms costs roughly
+ONE pass over the rows (SURVEY.md §7 step 6; the classic GPU engines get
+the same effect from atomics — this is the MXU-shaped equivalent).
+
+Semantics replicate ``cpu/trainer.py`` depth-wise growth exactly: within a
+level, splits are applied in best-gain-first order (stable, first-slot
+tie-break) until the ``num_leaves`` budget runs out; the left child keeps
+the parent's slot, right children take consecutive slot ids in execution
+order; child stats come from the parent-histogram prefix; the smaller child
+is histogrammed directly, the larger derived by subtraction.
+
+Distribution: identical contract to grower.py — call under ``shard_map``
+with rows sharded; the single per-level fused psum inside
+``build_hist_multi`` is the only collective.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from dryad_tpu.config import Params
+from dryad_tpu.engine.grower import finalize_leaf_values, pack_cat_bitset, root_stats
+from dryad_tpu.engine.histogram import build_hist, build_hist_multi
+from dryad_tpu.engine.split import NEG_INF, find_best_split
+
+
+def grow_tree_levelwise(
+    params: Params,
+    total_bins: int,
+    Xb: jnp.ndarray,
+    g: jnp.ndarray,
+    h: jnp.ndarray,
+    bag_mask: jnp.ndarray,
+    feat_mask: jnp.ndarray,
+    is_cat_feat: jnp.ndarray,
+    *,
+    has_cat: bool = False,
+    axis_name: str | None = None,
+) -> dict[str, Any]:
+    p = params
+    N, F = Xb.shape
+    B = int(total_bins)
+    L = p.effective_num_leaves
+    M = p.max_nodes
+    depth_cap = p.max_depth
+    assert depth_cap > 0, "levelwise growth requires max_depth > 0"
+
+    def best(hist, G, H, C, allow):
+        return find_best_split(
+            hist, G, H, C,
+            lambda_l2=p.lambda_l2,
+            min_child_weight=p.min_child_weight,
+            min_data_in_leaf=p.min_data_in_leaf,
+            min_split_gain=p.min_split_gain,
+            feat_mask=feat_mask,
+            is_cat_feat=is_cat_feat,
+            allow=allow,
+            has_cat=has_cat,
+        )
+
+    # ---- root (shared canonical construction) --------------------------------
+    row_slot = jnp.where(bag_mask, 0, L).astype(jnp.int32)
+    hist0 = build_hist(Xb, g, h, row_slot == 0, B,
+                       rows_per_chunk=p.rows_per_chunk, axis_name=axis_name)
+    G0, H0, C0 = root_stats(hist0)
+    root = best(hist0, G0, H0, C0,
+                (jnp.int32(0) < depth_cap) & (C0 >= 2 * p.min_data_in_leaf))
+    Bc = root.cat_mask.shape[0]
+
+    slot_node = jnp.full((L,), -1, jnp.int32).at[0].set(0)
+    slot_gain = jnp.full((L,), NEG_INF, jnp.float32).at[0].set(root.gain)
+    slot_G = jnp.zeros((L,), jnp.float32).at[0].set(G0)
+    slot_H = jnp.zeros((L,), jnp.float32).at[0].set(H0)
+    slot_C = jnp.zeros((L,), jnp.float32).at[0].set(C0)
+    slot_depth = jnp.zeros((L,), jnp.int32)
+    sp_feature = jnp.full((L,), -1, jnp.int32).at[0].set(root.feature)
+    sp_thresh = jnp.zeros((L,), jnp.int32).at[0].set(root.threshold)
+    sp_GL = jnp.zeros((L,), jnp.float32).at[0].set(root.g_left)
+    sp_HL = jnp.zeros((L,), jnp.float32).at[0].set(root.h_left)
+    sp_CL = jnp.zeros((L,), jnp.float32).at[0].set(root.c_left)
+    sp_catmask = jnp.zeros((L, Bc), bool).at[0].set(root.cat_mask)
+    hists = jnp.zeros((L, 3, F, B), jnp.float32).at[0].set(hist0)
+
+    feature = jnp.full((M,), -1, jnp.int32)
+    threshold = jnp.zeros((M,), jnp.int32)
+    left = jnp.zeros((M,), jnp.int32)
+    right = jnp.zeros((M,), jnp.int32)
+    is_cat_arr = jnp.zeros((M,), bool)
+    cat_nodes = jnp.zeros((M, Bc), bool)
+    num_nodes = jnp.int32(1)
+    splits_done = jnp.int32(0)
+    max_depth = jnp.int32(0)
+
+    # ---- levels (static unroll: per-level shapes differ) ---------------------
+    for d in range(depth_cap):
+        P = min(1 << d, L - 1)
+        at_level = (slot_depth == d) & (slot_gain > NEG_INF) & (slot_node >= 0)
+        # gain-descending order, stable => lowest slot id wins ties, exactly
+        # the CPU trainer's repeated first-max argmax sequence
+        order = jnp.argsort(jnp.where(at_level, -slot_gain, jnp.inf), stable=True)
+        cand = order[:P].astype(jnp.int32)
+        budget_left = (L - 1) - splits_done
+        do = at_level[cand] & (jnp.arange(P) < budget_left)
+        n_do = jnp.sum(do.astype(jnp.int32))
+
+        sj = cand
+        parent_node = slot_node[sj]
+        sf = sp_feature[sj]
+        thr = sp_thresh[sj]
+        GL, HL, CL = sp_GL[sj], sp_HL[sj], sp_CL[sj]
+        Gp, Hp, Cp = slot_G[sj], slot_H[sj], slot_C[sj]
+        GR, HR, CR = Gp - GL, Hp - HL, Cp - CL
+        cat_split = (is_cat_feat[jnp.maximum(sf, 0)] & do) if has_cat else jnp.zeros((P,), bool)
+
+        # slot/node allocation in execution (gain) order, as the CPU does
+        ks = splits_done + jnp.cumsum(do.astype(jnp.int32)) - do.astype(jnp.int32)
+        right_slot = jnp.where(do, ks + 1, L).astype(jnp.int32)
+        left_id = jnp.where(do, num_nodes + 2 * (ks - splits_done), 0).astype(jnp.int32)
+        right_id = left_id + 1
+
+        pidx = jnp.where(do, parent_node, M)
+        feature = feature.at[pidx].set(sf, mode="drop")
+        threshold = threshold.at[pidx].set(jnp.where(cat_split, 0, thr), mode="drop")
+        left = left.at[pidx].set(left_id, mode="drop")
+        right = right.at[pidx].set(right_id, mode="drop")
+        is_cat_arr = is_cat_arr.at[pidx].set(cat_split, mode="drop")
+        cat_nodes = cat_nodes.at[pidx].set(
+            jnp.where(cat_split[:, None], sp_catmask[sj], False), mode="drop"
+        )
+
+        # ---- row partition: every splitting leaf in one vectorized pass -----
+        slot_do = jnp.zeros((L,), bool).at[jnp.where(do, sj, L)].set(True, mode="drop")
+        slot_right = jnp.full((L,), L, jnp.int32).at[
+            jnp.where(do, sj, L)].set(right_slot, mode="drop")
+        rs = jnp.minimum(row_slot, L - 1)
+        row_do = slot_do[rs] & (row_slot < L)
+        rf = jnp.maximum(sp_feature[rs], 0)
+        bins_rf = jnp.take_along_axis(Xb, rf[:, None].astype(jnp.int32), axis=1)[:, 0]
+        bins_rf = bins_rf.astype(jnp.int32)
+        go_left = bins_rf <= sp_thresh[rs]
+        if has_cat:
+            cat_row = sp_catmask[rs, jnp.minimum(bins_rf, Bc - 1)]
+            go_left = jnp.where(is_cat_feat[rf], cat_row, go_left)
+        row_slot = jnp.where(row_do & ~go_left, slot_right[rs], row_slot)
+
+        # ---- one batched histogram pass for all smaller children ------------
+        left_smaller = CL <= CR
+        small_slot = jnp.where(left_smaller, sj, right_slot)
+        large_slot = jnp.where(left_smaller, right_slot, sj)
+        colof = jnp.full((L + 1,), P, jnp.int32).at[
+            jnp.where(do, small_slot, L)].set(jnp.arange(P, dtype=jnp.int32), mode="drop")
+        smallsel = colof[jnp.minimum(row_slot, L)]
+        hist_small = build_hist_multi(
+            Xb, g, h, smallsel, P, B,
+            rows_per_chunk=p.rows_per_chunk, axis_name=axis_name,
+        )
+        if p.hist_subtraction:
+            hist_large = hists[sj] - hist_small
+        else:
+            largesel = jnp.full((L + 1,), P, jnp.int32).at[
+                jnp.where(do, large_slot, L)].set(jnp.arange(P, dtype=jnp.int32), mode="drop")
+            hist_large = build_hist_multi(
+                Xb, g, h, largesel[jnp.minimum(row_slot, L)], P, B,
+                rows_per_chunk=p.rows_per_chunk, axis_name=axis_name,
+            )
+        ls = left_smaller[:, None, None, None]
+        hist_l = jnp.where(ls, hist_small, hist_large)
+        hist_r = jnp.where(ls, hist_large, hist_small)
+        hists = hists.at[jnp.where(do, sj, L)].set(hist_l, mode="drop")
+        hists = hists.at[jnp.where(do, right_slot, L)].set(hist_r, mode="drop")
+
+        # ---- children stats + their best splits (vmapped finder) ------------
+        ch_slot = jnp.concatenate([sj, right_slot])
+        ch_do = jnp.concatenate([do, do])
+        ch_node = jnp.concatenate([left_id, right_id])
+        ch_hist = jnp.concatenate([hist_l, hist_r])
+        ch_G = jnp.concatenate([GL, GR])
+        ch_H = jnp.concatenate([HL, HR])
+        ch_C = jnp.concatenate([CL, CR])
+        allow = ch_do & (d + 1 < depth_cap) & (ch_C >= 2 * p.min_data_in_leaf)
+        res = jax.vmap(best, in_axes=(0, 0, 0, 0, 0))(ch_hist, ch_G, ch_H, ch_C, allow)
+
+        cidx = jnp.where(ch_do, ch_slot, L)
+        slot_node = slot_node.at[cidx].set(ch_node, mode="drop")
+        slot_gain = slot_gain.at[cidx].set(res.gain, mode="drop")
+        slot_G = slot_G.at[cidx].set(ch_G, mode="drop")
+        slot_H = slot_H.at[cidx].set(ch_H, mode="drop")
+        slot_C = slot_C.at[cidx].set(ch_C, mode="drop")
+        slot_depth = slot_depth.at[cidx].set(d + 1, mode="drop")
+        sp_feature = sp_feature.at[cidx].set(res.feature, mode="drop")
+        sp_thresh = sp_thresh.at[cidx].set(res.threshold, mode="drop")
+        sp_GL = sp_GL.at[cidx].set(res.g_left, mode="drop")
+        sp_HL = sp_HL.at[cidx].set(res.h_left, mode="drop")
+        sp_CL = sp_CL.at[cidx].set(res.c_left, mode="drop")
+        sp_catmask = sp_catmask.at[cidx].set(res.cat_mask, mode="drop")
+
+        splits_done = splits_done + n_do
+        num_nodes = num_nodes + 2 * n_do
+        max_depth = jnp.where(n_do > 0, jnp.int32(d + 1), max_depth)
+
+    # ---- finalize leaf values + node bitsets (shared helpers) ----------------
+    value = finalize_leaf_values(p, M, slot_node, slot_G, slot_H,
+                                 jnp.zeros((M,), jnp.float32))
+    cat_bitset = pack_cat_bitset(cat_nodes, M)
+
+    return {
+        "feature": feature,
+        "threshold": threshold,
+        "left": left,
+        "right": right,
+        "value": value,
+        "is_cat": is_cat_arr,
+        "cat_bitset": cat_bitset,
+        "max_depth": max_depth,
+    }

@@ -1,0 +1,87 @@
+"""Device predict: level-synchronous tree traversal under jit.
+
+Bit-identity contract (BASELINE.json:5): traversal decisions compare integer
+bin ids — exact on any backend — and leaf-value accumulation runs in fp32 in
+the same per-class tree order as ``cpu/predict.py`` (a ``lax.scan`` over
+boosting iterations), so CPU and TPU raw scores are bit-identical given the
+same model, not merely close.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def tree_leaves(tree: dict, Xb: jnp.ndarray, depth_bound) -> jnp.ndarray:
+    """Leaf node id reached by every row in one tree (arrays shaped (M, ...)).
+
+    ``depth_bound`` may be a Python int (static unroll bound) or a traced
+    scalar (the grower's measured depth) — ``fori_loop`` accepts both.
+    """
+    N = Xb.shape[0]
+    if isinstance(depth_bound, int):
+        depth_bound = max(depth_bound, 1)
+    else:
+        depth_bound = jnp.maximum(depth_bound, 1)
+
+    def body(_, node):
+        f = tree["feature"][node]                      # (N,)
+        internal = f >= 0
+        fc = jnp.where(internal, f, 0).astype(jnp.int32)
+        bins = jnp.take_along_axis(Xb, fc[:, None], axis=1)[:, 0].astype(jnp.int32)
+        num_left = bins <= tree["threshold"][node]
+        bs = tree["cat_bitset"]
+        word = bs[node, jnp.minimum(bins >> 5, bs.shape[1] - 1)]
+        cat_left = ((word >> (bins & 31).astype(jnp.uint32)) & 1) > 0
+        go_left = jnp.where(tree["is_cat"][node], cat_left, num_left)
+        nxt = jnp.where(go_left, tree["left"][node], tree["right"][node])
+        return jnp.where(internal, nxt, node)
+
+    # derive the init from Xb so it inherits Xb's varying axes under shard_map
+    node0 = (Xb[:, 0] * 0).astype(jnp.int32)
+    return jax.lax.fori_loop(0, depth_bound, body, node0)
+
+
+@partial(jax.jit, static_argnames=("depth_bound",))
+def _accumulate(trees: dict, Xb: jnp.ndarray, init: jnp.ndarray, depth_bound: int):
+    """Raw scores (N, K): scan boosting iterations, vmap the K class trees.
+
+    ``trees`` arrays are shaped (n_iter, K, M, ...); per class the additions
+    happen in iteration order — the exact fp32 summation order of the CPU
+    reference path.
+    """
+    N = Xb.shape[0]
+    K = trees["feature"].shape[1]
+    score0 = jnp.broadcast_to(init.astype(jnp.float32), (N, K))
+
+    def step(score, tree_k):
+        leaves = jax.vmap(lambda tr: tree_leaves(tr, Xb, depth_bound))(tree_k)  # (K, N)
+        delta = jnp.take_along_axis(tree_k["value"], leaves, axis=1)            # (K, N)
+        return score + delta.T, None
+
+    score, _ = jax.lax.scan(step, score0, trees)
+    return score
+
+
+def predict_binned_device(
+    booster, Xb, num_iteration: Optional[int] = None
+) -> jnp.ndarray:
+    """``dryad.predict`` device backend on pre-binned rows → raw scores (N, K)."""
+    K = booster.num_outputs
+    if num_iteration is None:
+        n_iter = booster.best_iteration if booster.best_iteration > 0 else booster.num_iterations
+    else:
+        n_iter = min(num_iteration, booster.num_iterations)
+    ta = booster.tree_arrays()
+    T = n_iter * K
+    trees = {
+        k: jnp.asarray(v[:T]).reshape((n_iter, K) + v.shape[1:])
+        for k, v in ta.items()
+    }
+    Xb = jnp.asarray(Xb)
+    init = jnp.asarray(booster.init_score)
+    return _accumulate(trees, Xb, init, max(booster.max_depth_seen, 1))

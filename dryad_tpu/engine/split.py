@@ -1,0 +1,103 @@
+"""Device split-gain scan — TPU equivalent of the reference's CUDA split
+kernel (BASELINE.json:5; SURVEY.md §2 #6).
+
+Vectorized over the whole (feature, bin) grid at once: per-feature prefix
+sums of the histogram (cumsum), the Newton gain formula on both sides, a
+validity mask (min_data_in_leaf / min_child_weight / feature sampling), and
+one flat argmax with first-index tie-breaking — semantics identical to
+``dryad_tpu.cpu.histogram.find_best_split`` (the parity oracle), modulo fp32
+vs f64 accumulation (documented tolerance, SURVEY.md §7 hard part c).
+
+Categorical features use the LightGBM-style sorted-subset scan: bins ordered
+by g/(h + smooth), the best prefix of that order becomes the left membership
+set, returned as a (B,) bool mask (the host converts it to the node bitset).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+NEG_INF = float("-inf")  # plain float: a jnp scalar here would init the backend at import
+CAT_SMOOTH = 10.0  # matches cpu/histogram.py find_best_split default
+
+
+class SplitResult(NamedTuple):
+    gain: jnp.ndarray       # f32 scalar; -inf when no valid split exists
+    feature: jnp.ndarray    # i32
+    threshold: jnp.ndarray  # i32: numerical bin id / categorical prefix length
+    g_left: jnp.ndarray     # f32
+    h_left: jnp.ndarray     # f32
+    c_left: jnp.ndarray     # f32
+    cat_mask: jnp.ndarray   # (B,) bool — left membership set (cat splits only)
+
+
+def find_best_split(
+    hist: jnp.ndarray,          # (3, F, B) f32
+    G: jnp.ndarray,
+    H: jnp.ndarray,
+    C: jnp.ndarray,
+    *,
+    lambda_l2: float,
+    min_child_weight: float,
+    min_data_in_leaf: int,
+    min_split_gain: float,
+    feat_mask: jnp.ndarray,      # (F,) bool
+    is_cat_feat: jnp.ndarray,    # (F,) bool
+    allow: jnp.ndarray,          # scalar bool: depth/min-data pre-check
+    has_cat: bool = True,        # static: skip the sorted-subset machinery
+) -> SplitResult:
+    hg, hh, hc = hist[0], hist[1], hist[2]
+    F, B = hg.shape
+    iota = jnp.arange(B, dtype=jnp.int32)
+
+    if has_cat:
+        # categorical scan order: bins sorted by g/(h+smooth); empty bins last
+        ratio = jnp.where(hc > 0, hg / (hh + CAT_SMOOTH), jnp.inf)
+        cat_order = jnp.argsort(ratio, axis=1, stable=True).astype(jnp.int32)
+        order = jnp.where(is_cat_feat[:, None], cat_order, iota[None, :])
+        hg_o = jnp.take_along_axis(hg, order, axis=1)
+        hh_o = jnp.take_along_axis(hh, order, axis=1)
+        hc_o = jnp.take_along_axis(hc, order, axis=1)
+    else:
+        hg_o, hh_o, hc_o = hg, hh, hc
+
+    GL = jnp.cumsum(hg_o, axis=1)
+    HL = jnp.cumsum(hh_o, axis=1)
+    CL = jnp.cumsum(hc_o, axis=1)
+    GR, HR, CR = G - GL, H - HL, C - CL
+
+    valid = (
+        (CL >= min_data_in_leaf)
+        & (CR >= min_data_in_leaf)
+        & (HL >= min_child_weight)
+        & (HR >= min_child_weight)
+        & feat_mask[:, None]
+    )
+    parent_score = G * G / (H + lambda_l2)
+    gain = 0.5 * (GL * GL / (HL + lambda_l2) + GR * GR / (HR + lambda_l2) - parent_score)
+    gain = jnp.where(valid, gain, NEG_INF)
+
+    flat = jnp.argmax(gain.ravel()).astype(jnp.int32)  # first-max tie-break
+    best_gain = gain.ravel()[flat]
+    f = flat // B
+    t = flat % B
+    ok = allow & jnp.isfinite(best_gain) & (best_gain > min_split_gain)
+
+    if has_cat:
+        # left membership for categorical: bins whose rank in `order` is <= t
+        inv_order = jnp.zeros((B,), jnp.int32).at[order[f]].set(iota)
+        cat_mask = (inv_order <= t) & is_cat_feat[f] & ok
+    else:
+        cat_mask = jnp.zeros((1,), bool)
+
+    return SplitResult(
+        gain=jnp.where(ok, best_gain, NEG_INF),
+        feature=jnp.where(ok, f, -1).astype(jnp.int32),
+        threshold=t.astype(jnp.int32),
+        g_left=GL[f, t],
+        h_left=HL[f, t],
+        c_left=CL[f, t],
+        cat_mask=cat_mask,
+    )

@@ -1,0 +1,219 @@
+"""``dryad.train`` device backend: the boosting loop driving the compiled
+grower (SURVEY.md §3 train call stack).
+
+Orchestration (objective dispatch, bagging draw, early stopping, callbacks,
+resume) stays on the host — it is O(1) per iteration; every O(N) step
+(grad/hess, histogramming, partition, traversal, score update) runs on
+device under one jit program per (shapes, params) pair.
+
+Bagging/colsample masks come from the same host-side Philox draw as the CPU
+reference trainer (``cpu/trainer.py::sample_masks``), so sampling can never
+break cross-backend parity.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dryad_tpu.booster import Booster, empty_tree_arrays
+from dryad_tpu.config import Params
+from dryad_tpu.cpu.trainer import sample_masks
+from dryad_tpu.dataset import Dataset
+from dryad_tpu.engine.grower import grow_any
+from dryad_tpu.engine.predict import _accumulate, tree_leaves
+from dryad_tpu.objectives import get_objective
+
+
+@partial(jax.jit, static_argnames=("params", "total_bins", "has_cat"))
+def _grow_and_apply(params, total_bins, has_cat, Xb, g, h, bag_mask, feat_mask,
+                    is_cat_feat, score_k):
+    """Grow one tree and apply its leaf deltas to the training scores."""
+    tree = grow_any(
+        params, total_bins, Xb, g, h, bag_mask, feat_mask, is_cat_feat,
+        has_cat=has_cat,
+    )
+    leaves = tree_leaves(tree, Xb, tree["max_depth"])
+    return tree, score_k + tree["value"][leaves]
+
+
+@jax.jit
+def _apply_tree(tree, Xb, score_k):
+    """Apply an already-grown tree to another row set (validation scores)."""
+    leaves = tree_leaves(tree, Xb, tree["max_depth"])
+    return score_k + tree["value"][leaves]
+
+
+def train_device(
+    params: Params,
+    data: Dataset,
+    valid: Optional[Dataset] = None,
+    *,
+    num_trees: Optional[int] = None,
+    init_booster: Optional[Booster] = None,
+    callback: Optional[Callable[[int, dict], None]] = None,
+    mesh=None,
+) -> Booster:
+    """Device trainer.  With ``mesh`` set, rows are sharded over the mesh's
+    data axis and histograms allreduced by psum (engine/distributed.py)."""
+    p = params.validate()
+    obj = get_objective(p)
+    N, F = data.X_binned.shape
+    K = p.num_outputs
+    B = data.mapper.total_bins
+    is_cat_np = data.mapper.is_categorical
+    has_cat = bool(is_cat_np.any())
+    T = (num_trees if num_trees is not None else p.num_trees) * K
+
+    Xb_np, y_np = data.X_binned, data.y
+    w_np = data.weight
+    pad = 0
+    if mesh is not None:
+        from dryad_tpu.engine.distributed import padded_rows, shard_rows
+
+        Np = padded_rows(N, mesh.devices.size)
+        pad = Np - N
+        if pad:
+            Xb_np = np.pad(Xb_np, ((0, pad), (0, 0)))
+            y_np = np.pad(y_np, (0, pad))
+            if w_np is not None:
+                w_np = np.pad(w_np, (0, pad))
+        Xb, y = shard_rows(mesh, jnp.asarray(Xb_np), jnp.asarray(y_np))
+        weight = shard_rows(mesh, jnp.asarray(w_np))[0] if w_np is not None else None
+    else:
+        Xb = jnp.asarray(Xb_np)
+        y = jnp.asarray(y_np)
+        weight = jnp.asarray(w_np) if w_np is not None else None
+    NP = N + pad
+    is_cat_feat = jnp.asarray(is_cat_np)
+    qoff = data.query_offsets
+
+    out = empty_tree_arrays(T, p.max_nodes)
+    init = np.asarray(obj.init_score(data.y, data.weight), np.float32).reshape(-1)
+    score = jnp.broadcast_to(jnp.asarray(init), (NP, K)).astype(jnp.float32)
+    max_depth_seen = 0
+
+    start_iter = 0
+    if init_booster is not None:
+        prev = init_booster
+        if prev.params.max_nodes != p.max_nodes or prev.num_outputs != K:
+            raise ValueError(
+                "init_booster is incompatible: num_leaves/max_depth/num_class must match"
+            )
+        if prev.num_total_trees > T:
+            raise ValueError("new num_trees must cover the init_booster's iterations")
+        prev_trees = {
+            k: jnp.asarray(v).reshape((prev.num_iterations, K) + v.shape[1:])
+            for k, v in prev.tree_arrays().items()
+        }
+        # same fp32 order as the CPU replay: broadcast(new init) += each tree
+        score = _accumulate(prev_trees, Xb, jnp.asarray(init), max(prev.max_depth_seen, 1))
+        for k_arr in out:
+            out[k_arr][: prev.num_total_trees] = prev.tree_arrays()[k_arr]
+        start_iter = prev.num_iterations
+        max_depth_seen = prev.max_depth_seen
+
+    vXb = jnp.asarray(valid.X_binned) if valid is not None else None
+    vscore = (
+        jnp.broadcast_to(jnp.asarray(init), (valid.num_rows, K)).astype(jnp.float32)
+        if valid is not None
+        else None
+    )
+    if valid is not None and init_booster is not None:
+        vscore = _accumulate(prev_trees, vXb, jnp.asarray(init), max(prev.max_depth_seen, 1))
+    best_iteration, best_value, stale = -1, None, 0
+
+    ones_rows = np.ones((NP,), bool)
+    ones_feat = jnp.ones((F,), bool)
+
+    rank_plan = None
+    if p.objective == "lambdarank":
+        from dryad_tpu.engine.lambdarank import PaddingPlan
+
+        rank_plan = PaddingPlan(np.asarray(qoff))  # loop-invariant scatter plan
+
+    for it in range(start_iter, T // K):
+        if p.objective == "lambdarank":
+            # ragged per-query pairwise work on padded per-query segments
+            # (engine/lambdarank.py); pad rows beyond N get zero gradients
+            from dryad_tpu.engine.lambdarank import grad_hess_ranking
+
+            w_rank = None if weight is None else weight[:N]
+            g_all, h_all = grad_hess_ranking(obj, score[:N, 0], y[:N], w_rank, qoff,
+                                             plan=rank_plan)
+            if pad:
+                g_all = jnp.pad(g_all, (0, pad))
+                h_all = jnp.pad(h_all, (0, pad))
+            g_all, h_all = g_all[:, None], h_all[:, None]
+        elif K > 1:
+            g_all, h_all = obj.grad_hess_jax(score, y, weight)
+        else:
+            g_all, h_all = obj.grad_hess_jax(score[:, 0], y, weight)
+            g_all, h_all = g_all[:, None], h_all[:, None]
+
+        row_mask_np, feat_mask_np = sample_masks(p, it, N, F)
+        bag_np = ones_rows if row_mask_np is None else np.pad(row_mask_np, (0, pad))
+        if pad:
+            bag_np = bag_np.copy()
+            bag_np[N:] = False
+        fmask = ones_feat if feat_mask_np is None else jnp.asarray(feat_mask_np)
+        bag = jnp.asarray(bag_np)
+
+        for k in range(K):
+            t = it * K + k
+            if mesh is not None:
+                from dryad_tpu.engine.distributed import grow_and_apply_sharded
+
+                tree, new_col = grow_and_apply_sharded(
+                    p, B, has_cat, mesh, Xb, g_all[:, k], h_all[:, k], bag,
+                    fmask, is_cat_feat, score[:, k],
+                )
+            else:
+                tree, new_col = _grow_and_apply(
+                    p, B, has_cat, Xb, g_all[:, k], h_all[:, k], bag, fmask,
+                    is_cat_feat, score[:, k],
+                )
+            score = score.at[:, k].set(new_col)
+            max_depth_seen = max(max_depth_seen, int(tree["max_depth"]))
+            for key in ("feature", "threshold", "left", "right", "value",
+                        "is_cat", "cat_bitset"):
+                out[key][t] = np.asarray(tree[key])
+            if valid is not None:
+                vscore = vscore.at[:, k].set(_apply_tree(tree, vXb, vscore[:, k]))
+
+        info: dict = {"iteration": it}
+        if valid is not None:
+            from dryad_tpu.metrics import evaluate_raw
+
+            vs = np.asarray(vscore)
+            name, value, higher = evaluate_raw(
+                p.objective, p.metric, valid.y, vs if K > 1 else vs[:, 0],
+                valid.query_offsets, p.ndcg_at,
+            )
+            info[f"valid_{name}"] = value
+            improved = best_value is None or (value > best_value if higher else value < best_value)
+            if improved:
+                best_iteration, best_value, stale = it + 1, value, 0
+            else:
+                stale += 1
+            if p.early_stopping_rounds and stale >= p.early_stopping_rounds:
+                if callback is not None:
+                    callback(it, info)
+                T = (it + 1) * K
+                break
+        if callback is not None:
+            callback(it, info)
+
+    for key in out:
+        out[key] = out[key][:T]
+    return Booster(
+        p, data.mapper,
+        out["feature"], out["threshold"], out["left"], out["right"], out["value"],
+        out["is_cat"], out["cat_bitset"],
+        init, max_depth_seen,
+        best_iteration=best_iteration,
+    )

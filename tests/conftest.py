@@ -1,7 +1,9 @@
 """Test env: force JAX onto 8 virtual CPU devices (SURVEY.md §4).
 
-Must run before any jax import: the same shard_map/psum code paths that run
-on a real TPU pod then execute in CI with no TPU attached.
+The same shard_map/psum code paths that run on a real TPU pod then execute
+in CI with no TPU attached.  The environment may pin JAX_PLATFORMS to the
+TPU plugin, so the env var alone is not enough — the config update below
+overrides it even after the plugin registers.
 """
 
 import os
@@ -10,3 +12,7 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,207 @@
+"""Device-engine parity vs the CPU canonical trainer (SURVEY.md §4 keystone).
+
+The CPU trainer accumulates histograms in f64, the device engine in fp32 on
+the matmul path; on continuous data the gain argmax agrees and the grown
+trees are structurally identical.  Leaf values may differ by fp32 rounding
+of G/H sums (asserted to 1e-2 absolute, typically ~1e-4)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import dryad_tpu as dryad
+from dryad_tpu.datasets import covertype_like, higgs_like, mslr_like
+
+pytestmark = pytest.mark.engine
+
+
+def _structure_equal(a, b):
+    for k in ("feature", "threshold", "left", "right", "is_cat", "cat_bitset"):
+        np.testing.assert_array_equal(
+            a.tree_arrays()[k], b.tree_arrays()[k], err_msg=f"tree array {k!r} diverged"
+        )
+
+
+def _train_both(params, ds, valid=None):
+    b_cpu = dryad.train(params, ds, valid_sets=[valid] if valid else None, backend="cpu")
+    b_dev = dryad.train(params, ds, valid_sets=[valid] if valid else None, backend="tpu")
+    return b_cpu, b_dev
+
+
+def test_binary_parity():
+    X, y = higgs_like(2500)
+    ds = dryad.Dataset(X, y, max_bins=64)
+    params = dict(objective="binary", num_trees=8, num_leaves=15, max_bins=64,
+                  learning_rate=0.2)
+    b_cpu, b_dev = _train_both(params, ds)
+    _structure_equal(b_cpu, b_dev)
+    assert b_cpu.max_depth_seen == b_dev.max_depth_seen
+    np.testing.assert_allclose(b_cpu.value, b_dev.value, atol=1e-2)
+
+
+def test_regression_parity():
+    rng = np.random.Generator(np.random.Philox(3))
+    X = rng.normal(size=(2000, 12)).astype(np.float32)
+    y = (X[:, 0] * 2 + np.sin(X[:, 1]) + rng.normal(size=2000) * 0.1).astype(np.float32)
+    ds = dryad.Dataset(X, y, max_bins=32)
+    params = dict(objective="regression", num_trees=6, num_leaves=12, max_bins=32)
+    b_cpu, b_dev = _train_both(params, ds)
+    _structure_equal(b_cpu, b_dev)
+
+
+def test_multiclass_parity():
+    X, y = covertype_like(2500, num_features=20)
+    ds = dryad.Dataset(X, y, max_bins=48)
+    params = dict(objective="multiclass", num_class=7, num_trees=4, num_leaves=10,
+                  max_bins=48)
+    b_cpu, b_dev = _train_both(params, ds)
+    _structure_equal(b_cpu, b_dev)
+    acc_c = (b_cpu.predict(X).argmax(1) == y).mean()
+    acc_d = (b_dev.predict(X).argmax(1) == y).mean()
+    assert abs(acc_c - acc_d) < 0.02
+
+
+def test_categorical_and_bagging_parity():
+    rng = np.random.Generator(np.random.Philox(5))
+    n = 2000
+    cat = rng.integers(0, 12, size=n).astype(np.float32)
+    Xnum = rng.normal(size=(n, 5)).astype(np.float32)
+    X = np.column_stack([cat, Xnum])
+    y = ((cat % 3 == 0).astype(np.float32) * 1.5 + Xnum[:, 0]
+         + rng.normal(size=n) * 0.3 > 0.5).astype(np.float32)
+    ds = dryad.Dataset(X, y, categorical_features=[0], max_bins=32)
+    params = dict(objective="binary", num_trees=6, num_leaves=8, max_bins=32,
+                  categorical_features=[0], subsample=0.8, colsample=0.8, seed=9)
+    b_cpu, b_dev = _train_both(params, ds)
+    _structure_equal(b_cpu, b_dev)
+    # the chosen categorical split must actually appear
+    assert b_cpu.is_cat.any()
+
+
+def test_depthwise_parity():
+    X, y = higgs_like(2000)
+    ds = dryad.Dataset(X, y, max_bins=32)
+    params = dict(objective="binary", num_trees=5, num_leaves=16, max_depth=4,
+                  growth="depthwise", max_bins=32)
+    b_cpu, b_dev = _train_both(params, ds)
+    _structure_equal(b_cpu, b_dev)
+    assert b_dev.max_depth_seen <= 4
+
+
+def test_lambdarank_parity():
+    X, y, group = mslr_like(num_queries=60, docs_per_query=(5, 30), num_features=16)
+    ds = dryad.Dataset(X, y, group=group, max_bins=32)
+    params = dict(objective="lambdarank", num_trees=5, num_leaves=8, max_bins=32)
+    b_cpu, b_dev = _train_both(params, ds)
+    # λ-gradients are fp32 on device vs f64 on host: allow rare structural
+    # divergence but demand matching ranking quality
+    from dryad_tpu.metrics import ndcg_at_k
+
+    qoff = ds.query_offsets
+    nc = ndcg_at_k(y, b_cpu.predict(X, raw_score=True), qoff, 10)
+    nd = ndcg_at_k(y, b_dev.predict(X, raw_score=True), qoff, 10)
+    assert abs(nc - nd) < 0.02
+    assert nd > 0.6
+
+
+def test_early_stopping_and_best_iteration_device():
+    X, y = higgs_like(3000)
+    ds = dryad.Dataset(X[:2000], y[:2000], max_bins=32)
+    vds = ds.bind(X[2000:], y[2000:])
+    params = dict(objective="binary", num_trees=40, num_leaves=8, max_bins=32,
+                  learning_rate=0.3, early_stopping_rounds=5)
+    b = dryad.train(params, ds, valid_sets=[vds], backend="tpu")
+    assert b.best_iteration > 0
+    assert b.num_iterations <= 40
+
+
+def test_resume_device_matches_straight_run():
+    X, y = higgs_like(2000)
+    ds = dryad.Dataset(X, y, max_bins=32)
+    base = dict(objective="binary", num_leaves=8, max_bins=32, learning_rate=0.2)
+    full = dryad.train(dict(base, num_trees=10), ds, backend="tpu")
+    half = dryad.train(dict(base, num_trees=5), ds, backend="tpu")
+    resumed = dryad.train(dict(base, num_trees=10), ds, backend="tpu",
+                          init_booster=half)
+    _structure_equal(full, resumed)
+    np.testing.assert_allclose(full.value, resumed.value, atol=1e-2)
+
+
+def test_predict_bit_identity_cpu_vs_device():
+    X, y = higgs_like(2000)
+    ds = dryad.Dataset(X, y, max_bins=64)
+    b = dryad.train(dict(objective="binary", num_trees=10, num_leaves=15,
+                         max_bins=64), ds, backend="cpu")
+    p_cpu = b.predict(X, raw_score=True, backend="cpu")
+    p_dev = b.predict(X, raw_score=True, backend="tpu")
+    np.testing.assert_array_equal(p_cpu, p_dev)  # bit-identical, BASELINE.json:5
+
+
+def test_predict_bit_identity_multiclass():
+    X, y = covertype_like(1500, num_features=15)
+    ds = dryad.Dataset(X, y, max_bins=32)
+    b = dryad.train(dict(objective="multiclass", num_class=7, num_trees=3,
+                         num_leaves=8, max_bins=32), ds, backend="cpu")
+    p_cpu = b.predict(X, raw_score=True, backend="cpu")
+    p_dev = b.predict(X, raw_score=True, backend="tpu")
+    np.testing.assert_array_equal(p_cpu, p_dev)
+
+
+def test_depthwise_budget_pressure_parity():
+    """num_leaves budget cuts a level mid-way: gain-order application must
+    match the CPU trainer's repeated-argmax sequence exactly."""
+    X, y = higgs_like(3000)
+    ds = dryad.Dataset(X, y, max_bins=32)
+    params = dict(objective="binary", num_trees=4, num_leaves=21, max_depth=6,
+                  growth="depthwise", max_bins=32, min_data_in_leaf=5)
+    b_cpu, b_dev = _train_both(params, ds)
+    _structure_equal(b_cpu, b_dev)
+
+
+def test_depthwise_categorical_bagging_parity():
+    rng = np.random.Generator(np.random.Philox(11))
+    n = 2500
+    cat = rng.integers(0, 9, size=n).astype(np.float32)
+    Xnum = rng.normal(size=(n, 4)).astype(np.float32)
+    X = np.column_stack([cat, Xnum])
+    y = ((cat % 2 == 0) * 1.2 + Xnum[:, 0] + rng.normal(size=n) * 0.3 > 0.6).astype(np.float32)
+    ds = dryad.Dataset(X, y, categorical_features=[0], max_bins=32)
+    params = dict(objective="binary", num_trees=5, num_leaves=16, max_depth=4,
+                  growth="depthwise", max_bins=32, categorical_features=[0],
+                  subsample=0.8, seed=3)
+    b_cpu, b_dev = _train_both(params, ds)
+    _structure_equal(b_cpu, b_dev)
+
+
+def test_weighted_training_parity():
+    """Sample weights must flow through grads, histograms, and leaf values
+    identically on both backends (and predict must reflect them)."""
+    rng = np.random.Generator(np.random.Philox(13))
+    X, y = higgs_like(2000)
+    w = rng.uniform(0.25, 4.0, size=2000).astype(np.float32)
+    ds = dryad.Dataset(X, y, weight=w, max_bins=32)
+    params = dict(objective="binary", num_trees=5, num_leaves=10, max_bins=32)
+    b_cpu, b_dev = _train_both(params, ds)
+    _structure_equal(b_cpu, b_dev)
+    # weights actually change the model
+    ds_u = dryad.Dataset(X, y, max_bins=32)
+    b_unw = dryad.train(params, ds_u, backend="cpu")
+    assert not np.array_equal(b_cpu.feature, b_unw.feature) or not np.allclose(
+        b_cpu.value, b_unw.value)
+
+
+def test_weighted_lambdarank_device():
+    X, y, group = mslr_like(num_queries=30, docs_per_query=(4, 20), num_features=8)
+    rng = np.random.Generator(np.random.Philox(17))
+    w = rng.uniform(0.5, 2.0, size=y.shape[0]).astype(np.float32)
+    ds = dryad.Dataset(X, y, weight=w, group=group, max_bins=32)
+    params = dict(objective="lambdarank", num_trees=3, num_leaves=6, max_bins=32)
+    b = dryad.train(params, ds, backend="tpu")
+    assert np.isfinite(b.value).all()
+
+
+def test_weight_length_validated():
+    X, y = higgs_like(500)
+    with pytest.raises(ValueError, match="weight length"):
+        dryad.Dataset(X, y, weight=np.ones(10, np.float32))
