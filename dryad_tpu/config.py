@@ -96,6 +96,11 @@ class Params:
     hist_subtraction: bool = True
     rows_per_chunk: int = 65536  # row-tile for the chunked histogram scan
     deterministic: bool = True
+    # exact: fp32 MXU passes, keeps gain-argmax parity with the CPU ref.
+    # fast: single-pass bf16 MXU (~6x histogram speedup); counts stay exact
+    # (f32 accumulation of 0/1 products), grad/hess sums carry ~0.4%/elem
+    # rounding — tree structures may differ slightly, model quality doesn't.
+    hist_precision: str = "exact"
 
     # ---- derived -----------------------------------------------------------
     @property
@@ -136,6 +141,8 @@ class Params:
             raise ValueError("subsample/colsample must be in (0, 1]")
         if self.hist_backend not in ("auto", "xla", "pallas"):
             raise ValueError("hist_backend must be auto|xla|pallas")
+        if self.hist_precision not in ("exact", "fast"):
+            raise ValueError("hist_precision must be exact|fast")
         return self
 
     def replace(self, **kw: Any) -> "Params":

@@ -30,6 +30,17 @@ import jax
 import jax.numpy as jnp
 
 
+def _resolve_precision(precision: str):
+    """exact -> HIGHEST (6-pass fp32 MXU; the default would round the f32
+    operands to bf16 and break gain-argmax parity with the CPU reference).
+    fast -> DEFAULT (single-pass bf16, ~6x; counts stay exact because the
+    0/1 products accumulate in f32)."""
+    import jax as _jax
+
+    return (_jax.lax.Precision.HIGHEST if precision == "exact"
+            else _jax.lax.Precision.DEFAULT)
+
+
 def _chunk_rows(num_rows: int, num_features: int, total_bins: int,
                 rows_per_chunk: int, elem_budget: int = 1 << 26) -> int:
     """Row-chunk size: respect the caller's cap and a one-hot element budget."""
@@ -47,6 +58,7 @@ def build_hist(
     *,
     rows_per_chunk: int = 65536,
     axis_name: str | None = None,
+    precision: str = "exact",
 ) -> jnp.ndarray:
     """Masked per-(feature, bin) sums -> (3, F, B) fp32: grad, hess, count.
 
@@ -56,6 +68,7 @@ def build_hist(
     """
     N, F = Xb.shape
     B = int(total_bins)
+    prec = _resolve_precision(precision)
     C = _chunk_rows(N, F, B, rows_per_chunk)
     pad = (-N) % C
     if pad:
@@ -79,12 +92,10 @@ def build_hist(
     def body(acc, chunk):
         xc, wc = chunk
         onehot = (xc.astype(jnp.int32)[:, :, None] == iota).astype(jnp.float32)
-        # HIGHEST precision: the default lets XLA round the f32 operands to
-        # bf16 on the MXU, which breaks gain-argmax parity with the CPU ref
         part = jax.lax.dot_general(
             wc, onehot.reshape(C, F * B),
             (((1,), (0,)), ((), ())),
-            precision=jax.lax.Precision.HIGHEST,
+            precision=prec,
             preferred_element_type=jnp.float32,
         )
         return acc + part, None
@@ -116,6 +127,7 @@ def build_hist_multi(
     *,
     rows_per_chunk: int = 65536,
     axis_name: str | None = None,
+    precision: str = "exact",
 ) -> jnp.ndarray:
     """Histograms for ``num_cols`` leaves in ONE pass -> (P, 3, F, B) fp32.
 
@@ -131,6 +143,7 @@ def build_hist_multi(
     N, F = Xb.shape
     B = int(total_bins)
     P = int(num_cols)
+    prec = _resolve_precision(precision)
     C = _chunk_rows(N, F, B, rows_per_chunk)
     pad = (-N) % C
     if pad:
@@ -155,7 +168,7 @@ def build_hist_multi(
         part = jax.lax.dot_general(
             w.reshape(3 * P, C), onehot.reshape(C, F * B),
             (((1,), (0,)), ((), ())),
-            precision=jax.lax.Precision.HIGHEST,
+            precision=prec,
             preferred_element_type=jnp.float32,
         )
         return acc + part, None
@@ -165,6 +178,123 @@ def build_hist_multi(
         acc0 = jax.lax.pcast(acc0, axis_name, to="varying")
     acc, _ = jax.lax.scan(body, acc0, (Xc, gc, hc, sc))
     hist = acc.reshape(3, P, F, B).transpose(1, 0, 2, 3)
+    if axis_name is not None:
+        hist = jax.lax.psum(hist, axis_name)
+    return hist
+
+
+def _segment_tile(num_rows: int, num_cols: int) -> int:
+    """Tile size for the segmented builder: bound per-leaf padding overhead
+    (each leaf wastes < one tile) while keeping tiles MXU-friendly."""
+    t = 128
+    while t < 1024 and t * 4 * num_cols < num_rows:
+        t *= 2
+    return t
+
+
+def build_hist_segmented(
+    Xb: jnp.ndarray,
+    g: jnp.ndarray,
+    h: jnp.ndarray,
+    sel: jnp.ndarray,
+    num_cols: int,
+    total_bins: int,
+    *,
+    rows_per_chunk: int = 65536,
+    axis_name: str | None = None,
+    precision: str = "exact",
+) -> jnp.ndarray:
+    """Histograms for ``num_cols`` leaves -> (P, 3, F, B) fp32, O(N·F·B) work.
+
+    The dense ``build_hist_multi`` weight matrix makes every row pay for
+    every leaf column (3P·N·F·B MACs) — fine for a handful of leaves, fatal
+    at depth 8.  Here rows are *sorted by leaf* so each leaf occupies
+    contiguous tiles, every tile's (3, T) @ (T, F*B) matmul serves exactly
+    one leaf, and per-tile results scatter to leaves with one tiny matmul.
+    Work: 3·(N + P·T)·F·B MACs per level — leaf-count independent, the same
+    asymptotics the reference's CUDA scatter-add kernel gets from atomics.
+
+    ``sel`` (N,) in [0, P]; P drops the row.  Deterministic: stable sort +
+    fixed tile accumulation order.
+    """
+    N, F = Xb.shape
+    B = int(total_bins)
+    P = int(num_cols)
+    prec = _resolve_precision(precision)
+    T = _segment_tile(N, P)
+    n_tiles = N // T + P + 1  # worst case: every leaf wastes < 1 tile
+
+    sel = sel.astype(jnp.int32)
+    order = jnp.argsort(sel, stable=True)
+    sel_sorted = sel[order]
+    # per-leaf [start, end) in sorted order via binary search
+    start = jnp.searchsorted(sel_sorted, jnp.arange(P + 1, dtype=jnp.int32),
+                             side="left").astype(jnp.int32)
+    counts = start[1:] - start[:-1]                       # (P,)
+    leaf_tiles = (counts + (T - 1)) // T
+    seg_base = jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                                jnp.cumsum(leaf_tiles).astype(jnp.int32)])
+
+    # destination slot of sorted position i: its leaf's tile range, packed
+    pos = jnp.arange(N, dtype=jnp.int32)
+    l_of = jnp.minimum(sel_sorted, P - 1)
+    in_leaf = pos - start[l_of]
+    dest = jnp.where(sel_sorted < P, seg_base[l_of] * T + in_leaf, n_tiles * T)
+    buf = jnp.full((n_tiles * T,), N, jnp.int32).at[dest].set(order.astype(jnp.int32),
+                                                             mode="drop")
+    # tile -> leaf map (P for empty tiles)
+    tile_leaf = jnp.searchsorted(seg_base[1:], jnp.arange(n_tiles, dtype=jnp.int32),
+                                 side="right").astype(jnp.int32)
+
+    # gather rows (sentinel N -> zero row)
+    Xp = jnp.concatenate([Xb, jnp.zeros((1, F), Xb.dtype)])
+    gp = jnp.concatenate([g.astype(jnp.float32), jnp.zeros((1,), jnp.float32)])
+    hp = jnp.concatenate([h.astype(jnp.float32), jnp.zeros((1,), jnp.float32)])
+    Xt = Xp[buf].reshape(n_tiles, T, F)
+    gt = gp[buf].reshape(n_tiles, T)
+    ht = hp[buf].reshape(n_tiles, T)
+    valid = (buf < N).astype(jnp.float32).reshape(n_tiles, T)
+
+    # chunk tiles so the one-hot temporary stays bounded
+    tiles_per_chunk = max(1, _chunk_rows(n_tiles * T, F, B, rows_per_chunk) // T)
+    cpad = (-n_tiles) % tiles_per_chunk
+    if cpad:
+        Xt = jnp.pad(Xt, ((0, cpad), (0, 0), (0, 0)))
+        gt = jnp.pad(gt, ((0, cpad), (0, 0)))
+        ht = jnp.pad(ht, ((0, cpad), (0, 0)))
+        valid = jnp.pad(valid, ((0, cpad), (0, 0)))
+    nc = (n_tiles + cpad) // tiles_per_chunk
+    iota_b = jnp.arange(B, dtype=jnp.int32)
+
+    def body(_, chunk):
+        xc, gk, hk, vk = chunk                      # (Tc, T, ...)
+        onehot = (xc.astype(jnp.int32)[..., None] == iota_b).astype(jnp.float32)
+        w = jnp.stack([gk * vk, hk * vk, vk], axis=1)      # (Tc, 3, T)
+        part = jax.lax.dot_general(
+            w, onehot.reshape(xc.shape[0], T, F * B),
+            (((2,), (1,)), ((0,), (0,))),
+            precision=prec,
+            preferred_element_type=jnp.float32,
+        )                                           # (Tc, 3, F*B)
+        return None, part
+
+    _, tile_hists = jax.lax.scan(
+        body, None,
+        (Xt.reshape(nc, tiles_per_chunk, T, F),
+         gt.reshape(nc, tiles_per_chunk, T),
+         ht.reshape(nc, tiles_per_chunk, T),
+         valid.reshape(nc, tiles_per_chunk, T)),
+    )
+    tile_hists = tile_hists.reshape(n_tiles + cpad, 3 * F * B)[:n_tiles]
+
+    # scatter tiles -> leaves: one (P, n_tiles) x (n_tiles, 3FB) matmul
+    onehot_tl = (tile_leaf[None, :] == jnp.arange(P, dtype=jnp.int32)[:, None])
+    hist = jax.lax.dot_general(
+        onehot_tl.astype(jnp.float32), tile_hists,
+        (((1,), (0,)), ((), ())),
+        precision=prec,
+        preferred_element_type=jnp.float32,
+    ).reshape(P, 3, F, B)
     if axis_name is not None:
         hist = jax.lax.psum(hist, axis_name)
     return hist

@@ -30,7 +30,7 @@ import jax.numpy as jnp
 
 from dryad_tpu.config import Params
 from dryad_tpu.engine.grower import finalize_leaf_values, pack_cat_bitset, root_stats
-from dryad_tpu.engine.histogram import build_hist, build_hist_multi
+from dryad_tpu.engine.histogram import build_hist, build_hist_segmented
 from dryad_tpu.engine.split import NEG_INF, find_best_split
 
 
@@ -71,7 +71,8 @@ def grow_tree_levelwise(
     # ---- root (shared canonical construction) --------------------------------
     row_slot = jnp.where(bag_mask, 0, L).astype(jnp.int32)
     hist0 = build_hist(Xb, g, h, row_slot == 0, B,
-                       rows_per_chunk=p.rows_per_chunk, axis_name=axis_name)
+                       rows_per_chunk=p.rows_per_chunk, axis_name=axis_name,
+                       precision=p.hist_precision)
     G0, H0, C0 = root_stats(hist0)
     root = best(hist0, G0, H0, C0,
                 (jnp.int32(0) < depth_cap) & (C0 >= 2 * p.min_data_in_leaf))
@@ -101,9 +102,36 @@ def grow_tree_levelwise(
     splits_done = jnp.int32(0)
     max_depth = jnp.int32(0)
 
-    # ---- levels (static unroll: per-level shapes differ) ---------------------
-    for d in range(depth_cap):
-        P = min(1 << d, L - 1)
+    # ---- levels: ONE traced body under fori_loop -----------------------------
+    # A Python unroll over levels multiplies the XLA program by depth_cap and
+    # makes remote compilation pathologically slow; instead every level runs
+    # the same fixed-width program (P = widest level), with inactive
+    # candidate columns masked out.  The MXU pads the weight matrix's N
+    # dimension to 128 anyway, so the uniform width costs little extra.
+    P = min(1 << (depth_cap - 1), L - 1)
+
+    st = {
+        "row_slot": row_slot, "slot_node": slot_node, "slot_gain": slot_gain,
+        "slot_G": slot_G, "slot_H": slot_H, "slot_C": slot_C,
+        "slot_depth": slot_depth, "sp_feature": sp_feature,
+        "sp_thresh": sp_thresh, "sp_GL": sp_GL, "sp_HL": sp_HL,
+        "sp_CL": sp_CL, "sp_catmask": sp_catmask, "hists": hists,
+        "feature": feature, "threshold": threshold, "left": left,
+        "right": right, "is_cat": is_cat_arr, "cat_nodes": cat_nodes,
+        "num_nodes": num_nodes, "splits_done": splits_done,
+        "max_depth": max_depth,
+    }
+    def level_body(d, st):
+        (row_slot, slot_node, slot_gain, slot_G, slot_H, slot_C, slot_depth,
+         sp_feature, sp_thresh, sp_GL, sp_HL, sp_CL, sp_catmask, hists,
+         feature, threshold, left, right, is_cat_arr, cat_nodes,
+         num_nodes, splits_done, max_depth) = (
+            st["row_slot"], st["slot_node"], st["slot_gain"], st["slot_G"],
+            st["slot_H"], st["slot_C"], st["slot_depth"], st["sp_feature"],
+            st["sp_thresh"], st["sp_GL"], st["sp_HL"], st["sp_CL"],
+            st["sp_catmask"], st["hists"], st["feature"], st["threshold"],
+            st["left"], st["right"], st["is_cat"], st["cat_nodes"],
+            st["num_nodes"], st["splits_done"], st["max_depth"])
         at_level = (slot_depth == d) & (slot_gain > NEG_INF) & (slot_node >= 0)
         # gain-descending order, stable => lowest slot id wins ties, exactly
         # the CPU trainer's repeated first-max argmax sequence
@@ -160,9 +188,10 @@ def grow_tree_levelwise(
         colof = jnp.full((L + 1,), P, jnp.int32).at[
             jnp.where(do, small_slot, L)].set(jnp.arange(P, dtype=jnp.int32), mode="drop")
         smallsel = colof[jnp.minimum(row_slot, L)]
-        hist_small = build_hist_multi(
+        hist_small = build_hist_segmented(
             Xb, g, h, smallsel, P, B,
             rows_per_chunk=p.rows_per_chunk, axis_name=axis_name,
+            precision=p.hist_precision,
         )
         if p.hist_subtraction:
             hist_large = hists[sj] - hist_small
@@ -172,6 +201,7 @@ def grow_tree_levelwise(
             hist_large = build_hist_multi(
                 Xb, g, h, largesel[jnp.minimum(row_slot, L)], P, B,
                 rows_per_chunk=p.rows_per_chunk, axis_name=axis_name,
+            precision=p.hist_precision,
             )
         ls = left_smaller[:, None, None, None]
         hist_l = jnp.where(ls, hist_small, hist_large)
@@ -206,20 +236,34 @@ def grow_tree_levelwise(
 
         splits_done = splits_done + n_do
         num_nodes = num_nodes + 2 * n_do
-        max_depth = jnp.where(n_do > 0, jnp.int32(d + 1), max_depth)
+        max_depth = jnp.where(n_do > 0, (d + 1).astype(jnp.int32), max_depth)
+
+        return {
+            "row_slot": row_slot, "slot_node": slot_node,
+            "slot_gain": slot_gain, "slot_G": slot_G, "slot_H": slot_H,
+            "slot_C": slot_C, "slot_depth": slot_depth,
+            "sp_feature": sp_feature, "sp_thresh": sp_thresh, "sp_GL": sp_GL,
+            "sp_HL": sp_HL, "sp_CL": sp_CL, "sp_catmask": sp_catmask,
+            "hists": hists, "feature": feature, "threshold": threshold,
+            "left": left, "right": right, "is_cat": is_cat_arr,
+            "cat_nodes": cat_nodes, "num_nodes": num_nodes,
+            "splits_done": splits_done, "max_depth": max_depth,
+        }
+
+    st = jax.lax.fori_loop(0, depth_cap, level_body, st)
 
     # ---- finalize leaf values + node bitsets (shared helpers) ----------------
-    value = finalize_leaf_values(p, M, slot_node, slot_G, slot_H,
-                                 jnp.zeros((M,), jnp.float32))
-    cat_bitset = pack_cat_bitset(cat_nodes, M)
+    value = finalize_leaf_values(p, M, st["slot_node"], st["slot_G"],
+                                 st["slot_H"], jnp.zeros((M,), jnp.float32))
+    cat_bitset = pack_cat_bitset(st["cat_nodes"], M)
 
     return {
-        "feature": feature,
-        "threshold": threshold,
-        "left": left,
-        "right": right,
+        "feature": st["feature"],
+        "threshold": st["threshold"],
+        "left": st["left"],
+        "right": st["right"],
         "value": value,
-        "is_cat": is_cat_arr,
+        "is_cat": st["is_cat"],
         "cat_bitset": cat_bitset,
-        "max_depth": max_depth,
+        "max_depth": st["max_depth"],
     }

@@ -1,0 +1,199 @@
+// dryad_tpu native host layer: quantile sketch, binning, CSR ingest, predict.
+//
+// The reference keeps its data layer in native code (BASELINE.json:5 —
+// "categorical and sparse binning, quantile sketching" are engine-side
+// CUDA/C++); the TPU build keeps the same split: device compute in
+// XLA/Pallas, host data preparation in C++ behind ctypes.
+//
+// BIT-IDENTITY CONTRACT: every routine here must reproduce the canonical
+// numpy implementation in dryad_tpu/data/sketch.py bit for bit — the numpy
+// path is the spec, this is the fast path.  Tests diff them exhaustively
+// (tests/test_native.py).  All float work is float32 with the same op
+// order as numpy.
+//
+// Build: make -C dryad_tpu/native  (g++ -O3 -shared; zero dependencies).
+
+#include <algorithm>
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// Numerical quantile sketch: reproduce _sketch_numerical (data/sketch.py).
+//   col: n float32 values (may contain NaN/inf)
+//   out_edges: caller-allocated buffer of size max_bins
+//   returns number of edges written (k); total bins = k + 2
+// ---------------------------------------------------------------------------
+int64_t sketch_numerical(const float* col, int64_t n, int64_t max_bins,
+                         float* out_edges) {
+    std::vector<float> finite;
+    finite.reserve(n);
+    for (int64_t i = 0; i < n; ++i) {
+        if (std::isfinite(col[i])) finite.push_back(col[i]);
+    }
+    if (finite.empty()) return 0;
+    std::sort(finite.begin(), finite.end());
+    // distinct values (np.unique = sort + adjacent dedup)
+    std::vector<float> distinct;
+    distinct.reserve(finite.size());
+    for (float v : finite) {
+        if (distinct.empty() || distinct.back() != v) distinct.push_back(v);
+    }
+    const int64_t max_edges = max_bins - 2;
+    int64_t k = 0;
+    if ((int64_t)distinct.size() - 1 <= max_edges) {
+        // midpoints between neighbours, float32 arithmetic like numpy:
+        // (a + b) * 0.5f
+        for (size_t i = 0; i + 1 < distinct.size(); ++i) {
+            out_edges[k++] = (distinct[i] + distinct[i + 1]) * 0.5f;
+        }
+    } else {
+        // equal-frequency positions over the sorted sample, deduplicated
+        const int64_t sz = (int64_t)finite.size();
+        float prev = 0.0f;
+        bool has_prev = false;
+        for (int64_t i = 1; i <= max_edges; ++i) {
+            const int64_t pos = (i * sz) / (max_edges + 1);
+            const float e = finite[pos];
+            if (!has_prev || e != prev) {   // np.unique on ascending picks
+                out_edges[k++] = e;
+                prev = e;
+                has_prev = true;
+            }
+        }
+    }
+    return k;
+}
+
+// ---------------------------------------------------------------------------
+// Numerical binning: out[i] = 1 + lower_bound(edges, x) ; NaN -> 0.
+// Matches transform_column's searchsorted(side='left') + missing rule.
+// ---------------------------------------------------------------------------
+void bin_numerical(const float* col, int64_t n, const float* edges,
+                   int64_t n_edges, int32_t* out) {
+    const float* lo = edges;
+    const float* hi = edges + n_edges;
+    for (int64_t i = 0; i < n; ++i) {
+        const float x = col[i];
+        if (std::isnan(x)) {
+            out[i] = 0;
+        } else {
+            out[i] = 1 + (int32_t)(std::lower_bound(lo, hi, x) - lo);
+        }
+    }
+}
+
+// ---------------------------------------------------------------------------
+// Categorical binning: sorted vocab lookup; miss/unseen -> overflow bin;
+// NaN -> 0.  Matches transform_column's categorical branch.
+// ---------------------------------------------------------------------------
+void bin_categorical(const float* col, int64_t n, const float* cat_values,
+                     const int32_t* cat_bins, int64_t n_cats,
+                     int32_t overflow_bin, int32_t* out) {
+    const float* lo = cat_values;
+    const float* hi = cat_values + n_cats;
+    for (int64_t i = 0; i < n; ++i) {
+        const float x = col[i];
+        if (std::isnan(x)) {
+            out[i] = 0;
+            continue;
+        }
+        if (n_cats == 0) {
+            out[i] = overflow_bin;
+            continue;
+        }
+        const float* it = std::lower_bound(lo, hi, x);
+        if (it != hi && *it == x) {
+            out[i] = cat_bins[it - lo];
+        } else {
+            out[i] = overflow_bin;
+        }
+    }
+}
+
+// ---------------------------------------------------------------------------
+// Dense matrix binning, column-parallel-friendly layout.
+//   X: (n, F) row-major float32;  edge data packed per feature.
+//   edges_flat + edge_offsets[f..f+1]: feature f's edges
+//   catv_flat/catb_flat + cat_offsets: categorical vocab (empty for numeric)
+//   is_cat: per-feature flag;  overflow: per-feature overflow bin id
+//   out: (n, F) row-major uint16
+// ---------------------------------------------------------------------------
+void bin_matrix(const float* X, int64_t n, int64_t F,
+                const float* edges_flat, const int64_t* edge_offsets,
+                const float* catv_flat, const int32_t* catb_flat,
+                const int64_t* cat_offsets, const uint8_t* is_cat,
+                const int32_t* overflow, uint16_t* out) {
+    std::vector<float> colbuf(n);
+    std::vector<int32_t> outbuf(n);
+    for (int64_t f = 0; f < F; ++f) {
+        for (int64_t i = 0; i < n; ++i) colbuf[i] = X[i * F + f];
+        if (is_cat[f]) {
+            bin_categorical(colbuf.data(), n, catv_flat + cat_offsets[f],
+                            catb_flat + cat_offsets[f],
+                            cat_offsets[f + 1] - cat_offsets[f], overflow[f],
+                            outbuf.data());
+        } else {
+            bin_numerical(colbuf.data(), n, edges_flat + edge_offsets[f],
+                          edge_offsets[f + 1] - edge_offsets[f], outbuf.data());
+        }
+        for (int64_t i = 0; i < n; ++i) out[i * F + f] = (uint16_t)outbuf[i];
+    }
+}
+
+// ---------------------------------------------------------------------------
+// Vectorized single-tree traversal on binned rows (CPU predict hot loop).
+// Mirrors cpu/predict.py::predict_tree_leaves: compare bin ids, categorical
+// bitset membership, self-loop at leaves.
+// ---------------------------------------------------------------------------
+void tree_leaves(const uint16_t* Xb, int64_t n, int64_t F,
+                 const int32_t* feature, const int32_t* threshold,
+                 const int32_t* left, const int32_t* right,
+                 const uint8_t* is_cat, const uint32_t* cat_bitset,
+                 int64_t cat_words, int64_t depth_bound, int32_t* out_leaf) {
+    for (int64_t i = 0; i < n; ++i) {
+        int32_t node = 0;
+        for (int64_t d = 0; d < depth_bound; ++d) {
+            const int32_t f = feature[node];
+            if (f < 0) break;
+            const int32_t b = (int32_t)Xb[i * F + f];
+            bool go_left;
+            if (is_cat[node]) {
+                int64_t w = b >> 5;
+                if (w > cat_words - 1) w = cat_words - 1;
+                go_left = (cat_bitset[node * cat_words + w] >> (b & 31)) & 1u;
+            } else {
+                go_left = b <= threshold[node];
+            }
+            node = go_left ? left[node] : right[node];
+        }
+        out_leaf[i] = node;
+    }
+}
+
+// Full-booster predict accumulation: score[i*K + k] += value[t][leaf].
+void predict_accumulate(const uint16_t* Xb, int64_t n, int64_t F,
+                        const int32_t* feature, const int32_t* threshold,
+                        const int32_t* left, const int32_t* right,
+                        const uint8_t* is_cat, const uint32_t* cat_bitset,
+                        const float* value, int64_t num_trees, int64_t max_nodes,
+                        int64_t cat_words, int64_t K, int64_t depth_bound,
+                        float* score) {
+    std::vector<int32_t> leaves(n);
+    for (int64_t t = 0; t < num_trees; ++t) {
+        const int64_t off = t * max_nodes;
+        tree_leaves(Xb, n, F, feature + off, threshold + off, left + off,
+                    right + off, is_cat + off, cat_bitset + off * cat_words,
+                    cat_words, depth_bound, leaves.data());
+        const float* vt = value + off;
+        const int64_t k = t % K;
+        for (int64_t i = 0; i < n; ++i) {
+            score[i * K + k] += vt[leaves[i]];
+        }
+    }
+}
+
+}  // extern "C"

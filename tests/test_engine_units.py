@@ -133,3 +133,26 @@ def test_lambdarank_device_matches_host():
     # device is fp32, host f64: observed max |Δ| ~5e-5 on unit-scale λ sums
     np.testing.assert_allclose(np.asarray(g_dev), np.asarray(g_host), rtol=1e-3, atol=2e-4)
     np.testing.assert_allclose(np.asarray(h_dev), np.asarray(h_host), rtol=1e-3, atol=2e-4)
+
+
+def test_segmented_histogram_matches_multi_and_cpu():
+    from dryad_tpu.engine.histogram import build_hist_multi, build_hist_segmented
+    import jax
+
+    rng = np.random.Generator(np.random.Philox(9))
+    n, F, B, P = 6000, 5, 33, 7
+    Xb = rng.integers(0, B, size=(n, F)).astype(np.uint8)
+    g = rng.normal(size=n).astype(np.float32)
+    h = rng.uniform(0.1, 1.0, size=n).astype(np.float32)
+    sel = rng.integers(0, P + 1, size=n).astype(np.int32)  # includes drops
+    multi = np.asarray(jax.jit(build_hist_multi, static_argnames=("num_cols", "total_bins"))(
+        jnp.asarray(Xb), jnp.asarray(g), jnp.asarray(h), jnp.asarray(sel), P, B))
+    seg = np.asarray(jax.jit(build_hist_segmented, static_argnames=("num_cols", "total_bins"))(
+        jnp.asarray(Xb), jnp.asarray(g), jnp.asarray(h), jnp.asarray(sel), P, B))
+    np.testing.assert_array_equal(seg[:, 2], multi[:, 2])  # counts exact
+    np.testing.assert_allclose(seg, multi, rtol=2e-5, atol=2e-4)
+    # vs CPU oracle per column
+    for col in range(P):
+        rows = np.nonzero(sel == col)[0].astype(np.int64)
+        ref = build_hist_cpu(Xb, g, h, rows, B)
+        np.testing.assert_allclose(seg[col], ref, rtol=2e-5, atol=2e-4)
