@@ -53,8 +53,15 @@ def predict_binned_cpu(
         n_iter = booster.best_iteration if booster.best_iteration > 0 else booster.num_iterations
     else:
         n_iter = min(num_iteration, booster.num_iterations)
-    score = np.broadcast_to(booster.init_score, (N, K)).astype(np.float32).copy()
     trees = booster.tree_arrays()
+    from dryad_tpu import native
+
+    score = native.predict_accumulate(
+        Xb, trees, booster.init_score, n_iter * K, K, booster.max_depth_seen
+    )
+    if score is not None:
+        return score
+    score = np.broadcast_to(booster.init_score, (N, K)).astype(np.float32).copy()
     for t in range(n_iter * K):
         leaves = predict_tree_leaves(trees, Xb, t, booster.max_depth_seen)
         score[:, t % K] += booster.value[t, leaves]

@@ -53,6 +53,19 @@ class FeatureBins:
 
 
 def _sketch_numerical(col: np.ndarray, max_bins: int) -> FeatureBins:
+    from dryad_tpu import native
+
+    edges = native.sketch_numerical(col, max_bins)
+    if edges is not None:
+        return FeatureBins(
+            False, edges, np.empty(0, np.float32), np.empty(0, np.int32),
+            int(edges.size) + 2,
+        )
+    return _sketch_numerical_np(col, max_bins)
+
+
+def _sketch_numerical_np(col: np.ndarray, max_bins: int) -> FeatureBins:
+    """Pure-numpy canonical sketch — the bit-exact spec the native path must match."""
     finite = col[np.isfinite(col)]
     if finite.size == 0:
         edges = np.empty((0,), np.float32)

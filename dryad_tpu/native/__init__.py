@@ -1,0 +1,169 @@
+"""ctypes bridge to the native host layer (``src/dryad_native.cpp``).
+
+The reference keeps sketching/binning/predict hot loops in native code
+(BASELINE.json:5); here they live in a zero-dependency shared object built
+with ``make -C dryad_tpu/native`` and loaded through ctypes (the image has
+no pybind11).  The pure-numpy implementations in ``data/sketch.py`` /
+``cpu/predict.py`` remain the bit-exact *spec*; this module is the fast
+path and must match them bit for bit (tests/test_native.py diffs them).
+
+Loading is lazy and failure-tolerant: if the .so is absent we try one
+quiet ``make``; if the toolchain is missing, ``available()`` is False and
+every caller falls back to numpy.  ``DRYAD_NATIVE=0`` disables the native
+path outright.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_HERE, "libdryad_native.so")
+
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+_i64 = ctypes.c_int64
+_f32p = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
+_i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+_i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+_u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
+_u16p = np.ctypeslib.ndpointer(np.uint16, flags="C_CONTIGUOUS")
+_u32p = np.ctypeslib.ndpointer(np.uint32, flags="C_CONTIGUOUS")
+
+
+def _build() -> bool:
+    try:
+        res = subprocess.run(
+            ["make", "-C", _HERE],
+            capture_output=True,
+            timeout=120,
+        )
+        return res.returncode == 0 and os.path.exists(_SO)
+    except (OSError, subprocess.TimeoutExpired):
+        return False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    if _tried:
+        return _lib
+    _tried = True
+    if os.environ.get("DRYAD_NATIVE", "1") == "0":
+        return None
+    src = os.path.join(_HERE, "src", "dryad_native.cpp")
+    stale = (
+        os.path.exists(_SO)
+        and os.path.exists(src)
+        and os.path.getmtime(src) > os.path.getmtime(_SO)
+    )
+    if (not os.path.exists(_SO) or stale) and not _build() and not os.path.exists(_SO):
+        return None
+    try:
+        lib = ctypes.CDLL(_SO)
+
+        lib.sketch_numerical.restype = _i64
+        lib.sketch_numerical.argtypes = [_f32p, _i64, _i64, _f32p]
+        lib.bin_matrix.restype = None
+        lib.bin_matrix.argtypes = [
+            _f32p, _i64, _i64, _f32p, _i64p, _f32p, _i32p, _i64p, _u8p, _i32p,
+            _u16p,
+        ]
+        lib.predict_accumulate.restype = None
+        lib.predict_accumulate.argtypes = [
+            _u16p, _i64, _i64, _i32p, _i32p, _i32p, _i32p, _u8p, _u32p, _f32p,
+            _i64, _i64, _i64, _i64, _i64, _f32p,
+        ]
+    except (OSError, AttributeError):
+        # stale/incompatible binary: fall back to numpy rather than crash
+        return None
+    _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def sketch_numerical(col: np.ndarray, max_bins: int) -> Optional[np.ndarray]:
+    """Native numerical quantile sketch -> ascending float32 edges, or None."""
+    lib = _load()
+    if lib is None:
+        return None
+    col = np.ascontiguousarray(col, np.float32)
+    out = np.empty(max(int(max_bins), 2), np.float32)
+    k = lib.sketch_numerical(col, col.size, int(max_bins), out)
+    return out[:k].copy()
+
+
+def bin_matrix(X: np.ndarray, mapper) -> Optional[np.ndarray]:
+    """Native dense binning through a frozen BinMapper, or None."""
+    lib = _load()
+    if lib is None:
+        return None
+    X = np.ascontiguousarray(X, np.float32)
+    n, F = X.shape
+    feats = mapper.features
+
+    edge_offsets = np.zeros(F + 1, np.int64)
+    cat_offsets = np.zeros(F + 1, np.int64)
+    for f, fb in enumerate(feats):
+        edge_offsets[f + 1] = edge_offsets[f] + fb.edges.size
+        cat_offsets[f + 1] = cat_offsets[f] + fb.cat_values.size
+    edges_flat = np.empty(max(int(edge_offsets[-1]), 1), np.float32)
+    catv_flat = np.empty(max(int(cat_offsets[-1]), 1), np.float32)
+    catb_flat = np.empty(max(int(cat_offsets[-1]), 1), np.int32)
+    for f, fb in enumerate(feats):
+        edges_flat[edge_offsets[f] : edge_offsets[f + 1]] = fb.edges
+        catv_flat[cat_offsets[f] : cat_offsets[f + 1]] = fb.cat_values
+        catb_flat[cat_offsets[f] : cat_offsets[f + 1]] = fb.cat_bins
+    is_cat = mapper.is_categorical.astype(np.uint8)
+    overflow = np.array([fb.overflow_bin for fb in feats], np.int32)
+
+    out = np.empty((n, F), np.uint16)
+    lib.bin_matrix(
+        X, n, F, edges_flat, edge_offsets, catv_flat, catb_flat, cat_offsets,
+        is_cat, overflow, out,
+    )
+    return out.astype(mapper.bin_dtype, copy=False)
+
+
+def predict_accumulate(
+    Xb: np.ndarray,
+    trees: dict[str, np.ndarray],
+    init_score: np.ndarray,
+    num_trees: int,
+    K: int,
+    depth_bound: int,
+) -> Optional[np.ndarray]:
+    """Native booster predict: (N, K) raw scores, or None."""
+    lib = _load()
+    if lib is None:
+        return None
+    Xb = np.ascontiguousarray(Xb, np.uint16)
+    n, F = Xb.shape
+    feature = np.ascontiguousarray(trees["feature"], np.int32)
+    max_nodes = feature.shape[1]
+    cat_bitset = np.ascontiguousarray(trees["cat_bitset"], np.uint32)
+    cat_words = cat_bitset.shape[2]
+    score = np.broadcast_to(
+        np.asarray(init_score, np.float32), (n, K)
+    ).astype(np.float32, order="C")
+    lib.predict_accumulate(
+        Xb, n, F,
+        feature,
+        np.ascontiguousarray(trees["threshold"], np.int32),
+        np.ascontiguousarray(trees["left"], np.int32),
+        np.ascontiguousarray(trees["right"], np.int32),
+        np.ascontiguousarray(trees["is_cat"], np.uint8),
+        cat_bitset,
+        np.ascontiguousarray(trees["value"], np.float32),
+        int(num_trees), max_nodes, cat_words, int(K), max(int(depth_bound), 1),
+        score,
+    )
+    return score

@@ -1,0 +1,155 @@
+"""Native C++ host layer vs the pure-numpy canonical spec — bit-for-bit.
+
+The native .so (dryad_tpu/native) is the fast path for sketching, binning,
+and CPU predict; the numpy implementations are the spec (BASELINE.json:5
+bit-identity contract).  Every test here diffs the two exactly.
+"""
+
+import numpy as np
+import pytest
+
+from dryad_tpu import native
+from dryad_tpu.data.sketch import (
+    BinMapper,
+    _sketch_categorical,
+    _sketch_numerical_np,
+    sketch_features,
+)
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native library unavailable (no toolchain?)"
+)
+
+
+def _random_cols(rng):
+    n = 4096
+    yield "uniform", rng.standard_normal(n).astype(np.float32)
+    yield "heavy-ties", rng.integers(0, 7, n).astype(np.float32)
+    yield "constant", np.full(n, 3.25, np.float32)
+    col = rng.standard_normal(n).astype(np.float32)
+    col[rng.random(n) < 0.3] = np.nan
+    yield "nan-mixed", col
+    col2 = rng.standard_normal(n).astype(np.float32)
+    col2[:16] = np.inf
+    col2[16:32] = -np.inf
+    yield "inf-tails", col2
+    yield "all-nan", np.full(n, np.nan, np.float32)
+    yield "tiny", rng.standard_normal(3).astype(np.float32)
+    yield "denormal-range", (rng.standard_normal(n) * 1e-38).astype(np.float32)
+
+
+@pytest.mark.parametrize("max_bins", [16, 256])
+def test_sketch_numerical_bitwise(max_bins):
+    rng = np.random.default_rng(0)
+    for name, col in _random_cols(rng):
+        want = _sketch_numerical_np(col, max_bins)
+        got = native.sketch_numerical(col, max_bins)
+        np.testing.assert_array_equal(
+            got, want.edges, err_msg=f"sketch mismatch on {name}"
+        )
+
+
+def test_bin_matrix_bitwise():
+    rng = np.random.default_rng(1)
+    n, F = 2000, 9
+    X = rng.standard_normal((n, F)).astype(np.float32)
+    X[:, 2] = rng.integers(0, 40, n)            # categorical
+    X[:, 5] = rng.integers(0, 500, n)           # categorical with overflow
+    X[rng.random((n, F)) < 0.05] = np.nan
+    X[:7, 0] = np.inf
+    mapper = sketch_features(X, max_bins=64, categorical_features=(2, 5))
+    want = mapper.transform(X)
+    got = native.bin_matrix(X, mapper)
+    assert got.dtype == want.dtype
+    np.testing.assert_array_equal(got, want)
+
+
+def test_bin_matrix_bitwise_uint16():
+    rng = np.random.default_rng(2)
+    n = 3000
+    X = rng.standard_normal((n, 3)).astype(np.float32)
+    mapper = sketch_features(X, max_bins=1024)
+    assert mapper.bin_dtype == np.uint16
+    np.testing.assert_array_equal(native.bin_matrix(X, mapper), mapper.transform(X))
+
+
+def test_predict_bitwise():
+    import dryad_tpu as dryad
+
+    rng = np.random.default_rng(3)
+    n = 1500
+    X = rng.standard_normal((n, 6)).astype(np.float32)
+    X[:, 1] = rng.integers(0, 12, n)
+    y = (X[:, 0] + (X[:, 1] > 5) > 0).astype(np.float32)
+    ds = dryad.Dataset(X, y, max_bins=32, categorical_features=(1,))
+    booster = dryad.train(
+        dict(objective="binary", num_trees=12, num_leaves=15, max_bins=32),
+        ds, backend="cpu",
+    )
+    Xb = ds.mapper.transform(X)
+    want_score = native.predict_accumulate(
+        Xb, booster.tree_arrays(), booster.init_score,
+        booster.num_total_trees, booster.num_outputs, booster.max_depth_seen,
+    )
+    from dryad_tpu.cpu.predict import predict_tree_leaves
+
+    score = np.broadcast_to(booster.init_score, (n, 1)).astype(np.float32).copy()
+    trees = booster.tree_arrays()
+    for t in range(booster.num_total_trees):
+        leaves = predict_tree_leaves(trees, Xb, t, booster.max_depth_seen)
+        score[:, 0] += booster.value[t, leaves]
+    np.testing.assert_array_equal(want_score, score)
+
+
+def test_predict_multiclass_bitwise():
+    import dryad_tpu as dryad
+
+    rng = np.random.default_rng(4)
+    n = 900
+    X = rng.standard_normal((n, 5)).astype(np.float32)
+    y = rng.integers(0, 3, n).astype(np.float32)
+    ds = dryad.Dataset(X, y, max_bins=32)
+    booster = dryad.train(
+        dict(objective="multiclass", num_class=3, num_trees=5, num_leaves=7,
+             max_bins=32),
+        ds, backend="cpu",
+    )
+    Xb = ds.mapper.transform(X)
+    got = native.predict_accumulate(
+        Xb, booster.tree_arrays(), booster.init_score,
+        booster.num_total_trees, booster.num_outputs, booster.max_depth_seen,
+    )
+    from dryad_tpu.cpu.predict import predict_tree_leaves
+
+    want = np.broadcast_to(booster.init_score, (n, 3)).astype(np.float32).copy()
+    trees = booster.tree_arrays()
+    for t in range(booster.num_total_trees):
+        leaves = predict_tree_leaves(trees, Xb, t, booster.max_depth_seen)
+        want[:, t % 3] += booster.value[t, leaves]
+    np.testing.assert_array_equal(got, want)
+
+
+def test_sketch_csr_parity_with_dense():
+    """CSR ingest (native-accelerated sketch inside) ≡ dense ingest."""
+    import dryad_tpu as dryad
+
+    rng = np.random.default_rng(5)
+    n, F = 800, 12
+    X = np.zeros((n, F), np.float32)
+    mask = rng.random((n, F)) < 0.2
+    X[mask] = rng.standard_normal(int(mask.sum())).astype(np.float32)
+    indptr = np.concatenate([[0], np.cumsum(mask.sum(1))]).astype(np.int64)
+    indices = np.nonzero(mask)[1].astype(np.int64)
+    values = X[mask]
+    y = (X.sum(1) > 0).astype(np.float32)
+    ds_dense = dryad.Dataset(X, y, max_bins=32)
+    ds_csr = dryad.Dataset(None, y, csr=(indptr, indices, values, F), max_bins=32)
+    np.testing.assert_array_equal(ds_dense.X_binned, ds_csr.X_binned)
+
+
+def test_categorical_sketch_unchanged():
+    """Categorical sketching stays on the numpy path — sanity anchor."""
+    rng = np.random.default_rng(6)
+    col = rng.integers(0, 50, 2000).astype(np.float32)
+    fb = _sketch_categorical(col, 32)
+    assert fb.is_categorical and fb.n_bins <= 32
