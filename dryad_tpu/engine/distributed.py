@@ -55,19 +55,22 @@ def shard_rows(mesh: Mesh, *arrays):
     return tuple(out)
 
 
-@partial(jax.jit, static_argnames=("params", "total_bins", "has_cat", "mesh"))
-def grow_and_apply_sharded(params: Params, total_bins: int, has_cat: bool,
-                           mesh: Mesh, Xb, g, h, bag_mask, feat_mask,
-                           is_cat_feat, score_k):
-    """One sharded tree-grow + score update; tree comes back replicated."""
+def grow_sharded(params: Params, total_bins: int, has_cat: bool,
+                 mesh: Mesh, Xb, g, h, bag_mask, feat_mask, is_cat_feat):
+    """One sharded tree grow; returns (replicated tree, row-sharded leaves).
 
-    def step(Xb_l, g_l, h_l, bag_l, fmask, iscat, score_l):
+    Called inside the device train step's jit: the tree arrays come back
+    replicated, the per-row leaf assignment keeps the row sharding so the
+    caller's score update stays shard-local.
+    """
+
+    def run(Xb_l, g_l, h_l, bag_l, fmask, iscat):
         tree = grow_any(
             params, total_bins, Xb_l, g_l, h_l, bag_l, fmask, iscat,
             has_cat=has_cat, axis_name=AXIS,
         )
         leaves = tree_leaves(tree, Xb_l, tree["max_depth"])
-        return tree, score_l + tree["value"][leaves]
+        return tree, leaves
 
     row = P(AXIS)
     row2 = P(AXIS, None)
@@ -77,7 +80,7 @@ def grow_and_apply_sharded(params: Params, total_bins: int, has_cat: bool,
         "value": rep, "is_cat": rep, "cat_bitset": rep, "max_depth": rep,
     }
     return jax.shard_map(
-        step, mesh=mesh,
-        in_specs=(row2, row, row, row, rep, rep, row),
+        run, mesh=mesh,
+        in_specs=(row2, row, row, row, rep, rep),
         out_specs=(tree_specs, row),
-    )(Xb, g, h, bag_mask, feat_mask, is_cat_feat, score_k)
+    )(Xb, g, h, bag_mask, feat_mask, is_cat_feat)

@@ -133,7 +133,7 @@ def grow_tree(
         return build_hist(
             Xb, g, h, mask, B,
             rows_per_chunk=p.rows_per_chunk, axis_name=axis_name,
-            precision=p.hist_precision,
+            precision=p.hist_precision, backend=p.hist_backend,
         )
 
     # ---- root ---------------------------------------------------------------

@@ -30,6 +30,22 @@ import jax
 import jax.numpy as jnp
 
 
+_PALLAS_PLATFORMS = ("tpu", "axon")  # axon: the tunneled-TPU plugin platform
+
+
+def resolve_backend(backend: str, *, segmented: bool = False) -> str:
+    """auto -> the measured winner per path: the Pallas kernel for the
+    leaf-segmented level pass on a TPU (1.7x over the XLA matmul), XLA for
+    the single-mask pass (where the Pallas prep overhead eats the kernel
+    win), on CPU (Pallas would run interpreted) and on any non-TPU
+    accelerator (the kernel uses TPU-only Mosaic features)."""
+    if backend == "auto":
+        if jax.default_backend() not in _PALLAS_PLATFORMS:
+            return "xla"
+        return "pallas" if segmented else "xla"
+    return backend
+
+
 def _resolve_precision(precision: str):
     """exact -> HIGHEST (6-pass fp32 MXU; the default would round the f32
     operands to bf16 and break gain-argmax parity with the CPU reference).
@@ -59,6 +75,7 @@ def build_hist(
     rows_per_chunk: int = 65536,
     axis_name: str | None = None,
     precision: str = "exact",
+    backend: str = "xla",
 ) -> jnp.ndarray:
     """Masked per-(feature, bin) sums -> (3, F, B) fp32: grad, hess, count.
 
@@ -66,6 +83,13 @@ def build_hist(
     being histogrammed — the replacement for gathering a dynamic row list,
     which XLA's static-shape model rules out).
     """
+    if resolve_backend(backend) == "pallas":
+        from dryad_tpu.engine import pallas_hist
+
+        if pallas_hist.supports(total_bins):
+            return pallas_hist.build_hist_pallas(
+                Xb, g, h, mask, total_bins, axis_name=axis_name
+            )
     N, F = Xb.shape
     B = int(total_bins)
     prec = _resolve_precision(precision)
@@ -203,6 +227,7 @@ def build_hist_segmented(
     rows_per_chunk: int = 65536,
     axis_name: str | None = None,
     precision: str = "exact",
+    backend: str = "xla",
 ) -> jnp.ndarray:
     """Histograms for ``num_cols`` leaves -> (P, 3, F, B) fp32, O(N·F·B) work.
 
@@ -217,6 +242,13 @@ def build_hist_segmented(
     ``sel`` (N,) in [0, P]; P drops the row.  Deterministic: stable sort +
     fixed tile accumulation order.
     """
+    if resolve_backend(backend, segmented=True) == "pallas":
+        from dryad_tpu.engine import pallas_hist
+
+        if pallas_hist.supports(total_bins):
+            return pallas_hist.build_hist_segmented_pallas(
+                Xb, g, h, sel, num_cols, total_bins, axis_name=axis_name
+            )
     N, F = Xb.shape
     B = int(total_bins)
     P = int(num_cols)

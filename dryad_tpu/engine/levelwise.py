@@ -72,7 +72,7 @@ def grow_tree_levelwise(
     row_slot = jnp.where(bag_mask, 0, L).astype(jnp.int32)
     hist0 = build_hist(Xb, g, h, row_slot == 0, B,
                        rows_per_chunk=p.rows_per_chunk, axis_name=axis_name,
-                       precision=p.hist_precision)
+                       precision=p.hist_precision, backend=p.hist_backend)
     G0, H0, C0 = root_stats(hist0)
     root = best(hist0, G0, H0, C0,
                 (jnp.int32(0) < depth_cap) & (C0 >= 2 * p.min_data_in_leaf))
@@ -191,7 +191,7 @@ def grow_tree_levelwise(
         hist_small = build_hist_segmented(
             Xb, g, h, smallsel, P, B,
             rows_per_chunk=p.rows_per_chunk, axis_name=axis_name,
-            precision=p.hist_precision,
+            precision=p.hist_precision, backend=p.hist_backend,
         )
         if p.hist_subtraction:
             hist_large = hists[sj] - hist_small

@@ -1,0 +1,333 @@
+"""Pallas TPU histogram kernel — the native-kernel equivalent of the
+reference's CUDA per-feature histogram builder (BASELINE.json:5; SURVEY.md
+§2 #5, §7 step 3).
+
+Why a hand-written kernel beats the XLA one-hot matmul (engine/histogram.py):
+
+* **No HBM one-hot.**  XLA materializes the (rows, F*B) one-hot operand in
+  HBM (hundreds of MB per chunk); here it is built in VMEM per row tile and
+  consumed by the MXU inside the same kernel step.
+* **Exact fp32 in one MXU pass.**  The MXU multiplies bf16 and accumulates
+  f32.  The one-hot operand is 0/1 — exact in bf16 — so splitting each f32
+  grad/hess into three bf16 limbs (truncated 8+8+8 mantissa bits) makes the
+  products exact.  The XLA path needs ``Precision.HIGHEST`` (six passes)
+  for the same accuracy because it cannot know one operand is exact.
+  grad-hi/mid/lo, hess-hi/mid/lo and count ride as rows of one weight
+  matrix, so "exact" costs exactly what "fast" would.
+* **Leaf-segmented accumulation in VMEM.**  Rows arrive pre-grouped by
+  leaf (tiles of one leaf are consecutive); the output block index is the
+  tile's leaf id (scalar-prefetched), so Pallas keeps one leaf's partial
+  histogram resident in VMEM across its tiles and spills it exactly once.
+
+Hard-won lowering constraints baked into the design (measured on v5e):
+
+* The MXU contraction must have a 128-row operand: ``w (8, T) @ onehot``
+  lowers ~4x slower than ``w (128, T) @ onehot`` sliced back to 8 rows.
+* Weight limbs must be split with *bitmask truncation*: the naive
+  ``x - f32(bf16(x))`` is folded to zero by XLA's excess-precision
+  simplifier under jit, and ``lax.reduce_precision`` lowers ~30x slower
+  than bitwise ops here.
+* Row tiles of 256 hit a pathological Mosaic path (~5x); use 512.
+* Bin ids stay in their natural ``(T, F)`` gather layout — the lane-dim
+  tiling rule is satisfied by feature-chunking the array in XLA
+  (``(n_fb, n_tiles, T, Fc)``) instead of transposing to feature-major.
+
+Grid layout: ``(feature_chunks, row_tiles)`` — row tiles innermost so the
+revisited output block (leaf, chunk) stays in VMEM while a leaf's tiles
+stream through.  Feature chunking bounds the VMEM one-hot for wide data
+(Epsilon: 2000 features — BASELINE.json:9).
+
+The kernel is pure accumulation; the surrounding XLA program does the
+cheap O(N) bookkeeping (leaf bucketing, gathers, weight limb splitting)
+and the cross-device ``psum`` that replaces the reference's NCCL allreduce.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# weight rows: g_hi g_mid g_lo h_hi h_mid h_lo count (+ pad to the MXU tile)
+_WROWS = 8
+_MXU_M = 128          # weight rows padded to a full MXU tile (see module doc)
+_LANE_BUDGET = 8192   # max Fc*Bp one-hot lanes per chunk (8 MB bf16 at T=512)
+_TILE_ROWS = 512      # rows per tile (MXU K dim; 256 lowers pathologically)
+# cap: Fc floors at 8 for sublane alignment, so Bp must satisfy
+# 8 * Bp <= _LANE_BUDGET or the per-step one-hot exceeds the VMEM budget
+_MAX_PALLAS_BINS = 1024
+
+
+def supports(total_bins: int) -> bool:
+    return int(total_bins) <= _MAX_PALLAS_BINS
+
+
+def _interpret() -> bool:
+    return jax.default_backend() == "cpu"
+
+
+def _pow2_bins(B: int) -> int:
+    """Bin dim padded to a power of two (>=16) for lane alignment."""
+    return max(16, 1 << (B - 1).bit_length())
+
+
+def _feature_chunk(F: int, Bp: int) -> int:
+    """Features per chunk: bound one-hot lanes; keep Fc a multiple of 8
+    (sublane alignment — a ragged feature dim forces Mosaic relayouts that
+    cost orders of magnitude) and Fc*Bp a multiple of 128 (lane rule)."""
+    step = max(8, 128 // Bp)
+    budget = max(step, (_LANE_BUDGET // Bp) // step * step)
+    if F <= budget:
+        return ((F + step - 1) // step) * step
+    return budget
+
+
+def _split3(x: jnp.ndarray):
+    """f32 -> three bf16 limbs whose f32 sum reconstructs x exactly.
+
+    Implemented by masking mantissa bits (truncation split), for two
+    reasons: (a) XLA's excess-precision simplifier folds the naive
+    ``x - f32(bf16(x))`` to zero inside jit, silently deleting the mid/lo
+    limbs; (b) ``lax.reduce_precision`` survives jit but lowers ~30x slower
+    than bitwise ops on this backend.  Masking the low 16 mantissa bits is
+    exact, the residuals are exact f32 subtractions, and after two
+    truncations the final residual fits bf16 exactly.
+    """
+    mask16 = jnp.uint32(0xFFFF0000)
+    u = jax.lax.bitcast_convert_type(x, jnp.uint32)
+    hi = jax.lax.bitcast_convert_type(u & mask16, jnp.float32)
+    r1 = x - hi
+    u1 = jax.lax.bitcast_convert_type(r1, jnp.uint32)
+    mid = jax.lax.bitcast_convert_type(u1 & mask16, jnp.float32)
+    lo = (r1 - mid).astype(jnp.bfloat16)
+    return hi.astype(jnp.bfloat16), mid.astype(jnp.bfloat16), lo
+
+
+def _pack_weights(g: jnp.ndarray, h: jnp.ndarray, valid: jnp.ndarray) -> jnp.ndarray:
+    """(n_tiles, T) f32 grad/hess + validity -> (n_tiles, 128, T) bf16 rows."""
+    v = valid.astype(jnp.float32)
+    gv = g.astype(jnp.float32) * v
+    hv = h.astype(jnp.float32) * v
+    cnt = v.astype(jnp.bfloat16)
+    w = jnp.stack([*_split3(gv), *_split3(hv), cnt], axis=-2)
+    return jnp.pad(w, ((0, 0), (0, _MXU_M - w.shape[-2]), (0, 0)))
+
+
+def _hist_kernel(tile_leaf_ref, tile_first_ref, x_ref, w_ref, o_ref, *,
+                 padded_bins: int):
+    """One (feature-chunk, row-tile) step: w (128,T) @ one-hot (T, Fc*Bp)."""
+    i = pl.program_id(1)
+    x = x_ref[0, 0]                                # (T, Fc) int32
+    T, Fc = x.shape
+    Bp = padded_bins
+    iota_b = jax.lax.broadcasted_iota(jnp.int32, (T, Fc, Bp), 2)
+    onehot = (x[:, :, None] == iota_b).astype(jnp.bfloat16).reshape(T, Fc * Bp)
+    part = jax.lax.dot_general(
+        w_ref[0], onehot,
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )[:_WROWS]                                     # (8, Fc*Bp)
+
+    @pl.when(tile_first_ref[i] == 1)
+    def _():
+        o_ref[0] = part
+
+    @pl.when(tile_first_ref[i] == 0)
+    def _():
+        o_ref[0] = o_ref[0] + part
+
+
+@functools.partial(
+    jax.jit, static_argnames=("num_cols", "total_bins", "num_features")
+)
+def _hist_tiles(Xt, Wt, tile_leaf, tile_first, *, num_cols: int,
+                total_bins: int, num_features: int) -> jnp.ndarray:
+    """Core pallas_call: leaf-grouped tiles -> (P, 3, F, B) f32 histograms.
+
+    Xt (n_fb, n_tiles, T, Fc) int32 bin ids (feature-chunked, -padded),
+    Wt (n_tiles, 128, T) bf16 weight limb rows, tile_leaf (n_tiles,)
+    monotone non-decreasing leaf per tile, tile_first (n_tiles,) 1 on a
+    leaf's first tile.  Every leaf in [0, P) must own at least one tile so
+    its output block is written.
+    """
+    n_fb, n_tiles, T, Fc = Xt.shape
+    B = int(total_bins)
+    P = int(num_cols)
+    F = int(num_features)
+    Bp = _pow2_bins(B)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(n_fb, n_tiles),
+        in_specs=[
+            pl.BlockSpec((1, 1, T, Fc), lambda j, i, tl, tf: (j, i, 0, 0)),
+            pl.BlockSpec((1, _MXU_M, T), lambda j, i, tl, tf: (i, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, _WROWS, Fc * Bp),
+                               lambda j, i, tl, tf: (tl[i], 0, j)),
+    )
+    out = pl.pallas_call(
+        functools.partial(_hist_kernel, padded_bins=Bp),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((P, _WROWS, n_fb * Fc * Bp), jnp.float32),
+        interpret=_interpret(),
+    )(tile_leaf, tile_first, Xt, Wt)
+
+    out = out.reshape(P, _WROWS, n_fb * Fc, Bp)[:, :, :F, :B]
+    hg = out[:, 0] + out[:, 1] + out[:, 2]
+    hh = out[:, 3] + out[:, 4] + out[:, 5]
+    hc = out[:, 6]
+    return jnp.stack([hg, hh, hc], axis=1)         # (P, 3, F, B)
+
+
+def _tiles_from_rows(X_rows: jnp.ndarray, n_tiles: int, T: int, B: int) -> jnp.ndarray:
+    """(n_tiles*T, F) gathered bin rows -> feature-chunked (n_fb, n_tiles, T, Fc).
+
+    For narrow data (one chunk) this is a pure reshape — no transpose, the
+    gather layout feeds the kernel directly.
+    """
+    F = X_rows.shape[-1]
+    Fc = _feature_chunk(F, _pow2_bins(B))
+    fpad = (-F) % Fc
+    if fpad:
+        X_rows = jnp.pad(X_rows, ((0, 0), (0, fpad)))
+    n_fb = (F + fpad) // Fc
+    Xt = X_rows.reshape(n_tiles, T, n_fb, Fc)
+    return Xt.transpose(2, 0, 1, 3)  # identity layout-move when n_fb == 1
+
+
+def build_hist_pallas(
+    Xb: jnp.ndarray,
+    g: jnp.ndarray,
+    h: jnp.ndarray,
+    mask: jnp.ndarray,
+    total_bins: int,
+    *,
+    axis_name: str | None = None,
+) -> jnp.ndarray:
+    """Single-leaf masked histogram -> (3, F, B) f32 (root / leaf-wise path).
+
+    Rows stream in natural order (no leaf bucketing needed); masked-out rows
+    ride along with zero weight limbs.
+    """
+    N, F = Xb.shape
+    B = int(total_bins)
+    T = _TILE_ROWS
+    pad = (-N) % T
+    Xp = jnp.pad(Xb.astype(jnp.int32), ((0, pad), (0, 0)))
+    gp = jnp.pad(g.astype(jnp.float32), (0, pad))
+    hp = jnp.pad(h.astype(jnp.float32), (0, pad))
+    mp = jnp.pad(mask, (0, pad))
+    n_tiles = (N + pad) // T
+
+    Xt = _tiles_from_rows(Xp, n_tiles, T, B)
+    Wt = _pack_weights(gp.reshape(n_tiles, T), hp.reshape(n_tiles, T),
+                       mp.reshape(n_tiles, T))
+    tile_leaf = jnp.zeros((n_tiles,), jnp.int32)
+    tile_first = jnp.zeros((n_tiles,), jnp.int32).at[0].set(1)
+
+    hist = _hist_tiles(
+        Xt, Wt, tile_leaf, tile_first,
+        num_cols=1, total_bins=B, num_features=F,
+    )[0]
+    if axis_name is not None:
+        hist = jax.lax.psum(hist, axis_name)
+    return hist
+
+
+def tile_plan(sel: jnp.ndarray, N: int, P: int, T: int):
+    """Bucket rows by leaf into fixed tiles.
+
+    Returns (buf, tile_leaf, tile_first): ``buf`` (n_tiles*T,) row ids with
+    sentinel N for padding slots; ``tile_leaf`` monotone leaf per tile
+    (every leaf owns >= 1 tile); ``tile_first`` marks each leaf's first
+    tile.  Deterministic: stable sort by leaf, fixed slot order.
+    """
+    n_tiles = N // T + P + 1
+    sel = sel.astype(jnp.int32)
+    order = jnp.argsort(sel, stable=True)
+    sel_sorted = sel[order]
+    start = jnp.searchsorted(sel_sorted, jnp.arange(P + 1, dtype=jnp.int32),
+                             side="left").astype(jnp.int32)
+    counts = start[1:] - start[:-1]                       # (P,)
+    # every leaf gets >= 1 tile so its (pallas) output block is initialized
+    leaf_tiles = jnp.maximum((counts + (T - 1)) // T, 1)
+    seg_base = jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                                jnp.cumsum(leaf_tiles).astype(jnp.int32)])
+
+    pos = jnp.arange(N, dtype=jnp.int32)
+    l_of = jnp.minimum(sel_sorted, P - 1)
+    in_leaf = pos - start[l_of]
+    dest = jnp.where(sel_sorted < P, seg_base[l_of] * T + in_leaf, n_tiles * T)
+    buf = jnp.full((n_tiles * T,), N, jnp.int32).at[dest].set(
+        order.astype(jnp.int32), mode="drop")
+    tile_leaf = jnp.searchsorted(seg_base[1:], jnp.arange(n_tiles, dtype=jnp.int32),
+                                 side="right").astype(jnp.int32)
+    tile_leaf = jnp.minimum(tile_leaf, P - 1)             # clamp trailing pad tiles
+    tile_first = jnp.concatenate([
+        jnp.ones((1,), jnp.int32),
+        (tile_leaf[1:] != tile_leaf[:-1]).astype(jnp.int32),
+    ])
+    return buf, tile_leaf, tile_first
+
+
+def hist_from_plan(
+    Xb: jnp.ndarray,
+    g: jnp.ndarray,
+    h: jnp.ndarray,
+    buf: jnp.ndarray,
+    tile_leaf: jnp.ndarray,
+    tile_first: jnp.ndarray,
+    num_cols: int,
+    total_bins: int,
+    *,
+    axis_name: str | None = None,
+) -> jnp.ndarray:
+    """Histogram leaf-grouped rows given a precomputed tile plan."""
+    N, F = Xb.shape
+    B = int(total_bins)
+    T = _TILE_ROWS
+    n_tiles = buf.shape[0] // T
+
+    Xp = jnp.concatenate([Xb.astype(jnp.int32), jnp.zeros((1, F), jnp.int32)])
+    gp = jnp.concatenate([g.astype(jnp.float32), jnp.zeros((1,), jnp.float32)])
+    hp = jnp.concatenate([h.astype(jnp.float32), jnp.zeros((1,), jnp.float32)])
+    Xt = _tiles_from_rows(Xp[buf], n_tiles, T, B)
+    valid = (buf < N).reshape(n_tiles, T)
+    Wt = _pack_weights(gp[buf].reshape(n_tiles, T), hp[buf].reshape(n_tiles, T),
+                       valid)
+
+    hist = _hist_tiles(
+        Xt, Wt, tile_leaf, tile_first,
+        num_cols=int(num_cols), total_bins=B, num_features=F,
+    )
+    if axis_name is not None:
+        hist = jax.lax.psum(hist, axis_name)
+    return hist
+
+
+def build_hist_segmented_pallas(
+    Xb: jnp.ndarray,
+    g: jnp.ndarray,
+    h: jnp.ndarray,
+    sel: jnp.ndarray,
+    num_cols: int,
+    total_bins: int,
+    *,
+    axis_name: str | None = None,
+) -> jnp.ndarray:
+    """Per-leaf histograms for a whole tree level -> (P, 3, F, B) f32.
+
+    ``sel`` (N,) in [0, P]; P drops the row.  O(N·F·B) MXU work independent
+    of leaf count — the TPU analog of the CUDA kernel's atomic scatter-add
+    asymptotics.
+    """
+    N = Xb.shape[0]
+    buf, tile_leaf, tile_first = tile_plan(sel, N, int(num_cols), _TILE_ROWS)
+    return hist_from_plan(
+        Xb, g, h, buf, tile_leaf, tile_first, num_cols, total_bins,
+        axis_name=axis_name,
+    )

@@ -6,6 +6,14 @@ resume) stays on the host — it is O(1) per iteration; every O(N) step
 (grad/hess, histogramming, partition, traversal, score update) runs on
 device under one jit program per (shapes, params) pair.
 
+**No per-iteration host↔device synchronization.**  Through a remote device
+tunnel a single small fetch costs ~100 ms — an order of magnitude more than
+growing the tree — so the trained tree arrays live on device (written into
+preallocated (T, ...) output buffers with donated in-place updates) and are
+fetched exactly once when training ends.  Iterations therefore dispatch
+asynchronously and pipeline; the only forced syncs are per-iteration metric
+evaluation when a validation set is supplied.
+
 Bagging/colsample masks come from the same host-side Philox draw as the CPU
 reference trainer (``cpu/trainer.py::sample_masks``), so sampling can never
 break cross-backend parity.
@@ -20,7 +28,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from dryad_tpu.booster import Booster, empty_tree_arrays
+from dryad_tpu.booster import CAT_WORDS, Booster
 from dryad_tpu.config import Params
 from dryad_tpu.cpu.trainer import sample_masks
 from dryad_tpu.dataset import Dataset
@@ -28,24 +36,89 @@ from dryad_tpu.engine.grower import grow_any
 from dryad_tpu.engine.predict import _accumulate, tree_leaves
 from dryad_tpu.objectives import get_objective
 
+_TREE_KEYS = ("feature", "threshold", "left", "right", "value", "is_cat",
+              "cat_bitset")
 
-@partial(jax.jit, static_argnames=("params", "total_bins", "has_cat"))
-def _grow_and_apply(params, total_bins, has_cat, Xb, g, h, bag_mask, feat_mask,
-                    is_cat_feat, score_k):
-    """Grow one tree and apply its leaf deltas to the training scores."""
-    tree = grow_any(
-        params, total_bins, Xb, g, h, bag_mask, feat_mask, is_cat_feat,
-        has_cat=has_cat,
-    )
-    leaves = tree_leaves(tree, Xb, tree["max_depth"])
-    return tree, score_k + tree["value"][leaves]
+
+@partial(jax.jit, static_argnames=("p", "B", "has_cat", "mesh"),
+         donate_argnums=(4, 5))
+def _step_jit(p, B, has_cat, mesh, out, score, Xb, g_all, h_all, bag, fmask,
+              is_cat_feat, t, k):
+    """One (iteration, class) tree: grow, record into slot t, update scores.
+
+    Module-level jit keyed on the static (params, bins, mesh) triple — the
+    compiled program is reused across ``train_device`` calls (a closure-local
+    jit would recompile per call and dwarf the training itself).  ``out`` and
+    ``score`` are donated: the tree tables update in place on device.
+    """
+    out = dict(out)
+    g = jnp.take(g_all, k, axis=1)
+    h = jnp.take(h_all, k, axis=1)
+    if mesh is not None:
+        from dryad_tpu.engine.distributed import grow_sharded
+
+        tree, leaves = grow_sharded(
+            p, B, has_cat, mesh, Xb, g, h, bag, fmask, is_cat_feat
+        )
+    else:
+        tree = grow_any(p, B, Xb, g, h, bag, fmask, is_cat_feat,
+                        has_cat=has_cat)
+        leaves = tree_leaves(tree, Xb, tree["max_depth"])
+    col = jnp.take(score, k, axis=1) + tree["value"][leaves]
+    score = jax.lax.dynamic_update_index_in_dim(score, col, k, axis=1)
+    for key in _TREE_KEYS:
+        out[key] = out[key].at[t].set(tree[key])
+    out["max_depth"] = out["max_depth"].at[t].set(tree["max_depth"])
+    return out, score
+
+
+@partial(jax.jit, static_argnames=("p", "N", "K", "pad", "rank_Q", "rank_S"))
+def _grads_jit(p, N, K, pad, score, y, weight, qoff, rank_row_ids,
+               rank_col_ids, rank_Q, rank_S):
+    """Per-iteration grad/hess (N+pad, K) from the pre-iteration score.
+
+    All K class trees of one boosting iteration share this single pass —
+    exactly the CPU reference's semantics.  Module-level jit: reused across
+    ``train_device`` calls.
+    """
+    obj = get_objective(p)
+    if p.objective == "lambdarank":
+        from dryad_tpu.engine.lambdarank import PaddingPlan, grad_hess_ranking
+
+        plan = PaddingPlan.__new__(PaddingPlan)
+        plan.Q, plan.S = rank_Q, rank_S
+        plan.row_ids, plan.col_ids = rank_row_ids, rank_col_ids
+        w_rank = None if weight is None else weight[:N]
+        g, h = grad_hess_ranking(obj, score[:N, 0], y[:N], w_rank, qoff,
+                                 plan=plan)
+        if pad:
+            g = jnp.pad(g, (0, pad))
+            h = jnp.pad(h, (0, pad))
+        return g[:, None], h[:, None]
+    if K > 1:
+        return obj.grad_hess_jax(score, y, weight)
+    g, h = obj.grad_hess_jax(score[:, 0], y, weight)
+    return g[:, None], h[:, None]
 
 
 @jax.jit
-def _apply_tree(tree, Xb, score_k):
-    """Apply an already-grown tree to another row set (validation scores)."""
-    leaves = tree_leaves(tree, Xb, tree["max_depth"])
-    return score_k + tree["value"][leaves]
+def _apply_valid_jit(out, t, vXb, vs_col, depth_bound):
+    tree = {key: out[key][t] for key in _TREE_KEYS}
+    leaves = tree_leaves(tree, vXb, depth_bound)
+    return vs_col + tree["value"][leaves]
+
+
+def _empty_out_device(T: int, M: int, cat_words: int) -> dict:
+    return {
+        "feature": jnp.full((T, M), -1, jnp.int32),
+        "threshold": jnp.zeros((T, M), jnp.int32),
+        "left": jnp.zeros((T, M), jnp.int32),
+        "right": jnp.zeros((T, M), jnp.int32),
+        "value": jnp.zeros((T, M), jnp.float32),
+        "is_cat": jnp.zeros((T, M), bool),
+        "cat_bitset": jnp.zeros((T, M, cat_words), jnp.uint32),
+        "max_depth": jnp.zeros((T,), jnp.int32),
+    }
 
 
 def train_device(
@@ -72,6 +145,7 @@ def train_device(
     Xb_np, y_np = data.X_binned, data.y
     w_np = data.weight
     pad = 0
+    shard_rows = None
     if mesh is not None:
         from dryad_tpu.engine.distributed import padded_rows, shard_rows
 
@@ -92,12 +166,39 @@ def train_device(
     is_cat_feat = jnp.asarray(is_cat_np)
     qoff = data.query_offsets
 
-    out = empty_tree_arrays(T, p.max_nodes)
     init = np.asarray(obj.init_score(data.y, data.weight), np.float32).reshape(-1)
     score = jnp.broadcast_to(jnp.asarray(init), (NP, K)).astype(jnp.float32)
-    max_depth_seen = 0
+    if mesh is not None:
+        score = shard_rows(mesh, score)[0]
 
+    rank_row = rank_col = None
+    rank_Q = rank_S = 0
+    qoff_j = None
+    if p.objective == "lambdarank":
+        from dryad_tpu.engine.lambdarank import PaddingPlan
+
+        rank_plan = PaddingPlan(np.asarray(qoff))  # loop-invariant scatter plan
+        rank_row, rank_col = rank_plan.row_ids, rank_plan.col_ids
+        rank_Q, rank_S = rank_plan.Q, rank_plan.S
+        qoff_j = jnp.asarray(qoff)
+
+    # static jit key: strip fields that cannot affect the compiled programs
+    # so e.g. a warmup run with fewer trees reuses the same executables
+    p_key = p.replace(num_trees=1, early_stopping_rounds=0, metric="")
+
+    def grads(score):
+        return _grads_jit(p_key, N, K, pad, score, y, weight, qoff_j,
+                          rank_row, rank_col, rank_Q, rank_S)
+
+    def step(out, score, g_all, h_all, bag, fmask, t, k):
+        return _step_jit(p_key, B, has_cat, mesh, out, score, Xb, g_all, h_all,
+                         bag, fmask, is_cat_feat, t, k)
+
+    # ---- resume / warm start -------------------------------------------------
+    out = _empty_out_device(T, p.max_nodes, CAT_WORDS)
     start_iter = 0
+    max_depth_prev = 0
+    prev_trees = None
     if init_booster is not None:
         prev = init_booster
         if prev.params.max_nodes != p.max_nodes or prev.num_outputs != K:
@@ -107,15 +208,17 @@ def train_device(
         if prev.num_total_trees > T:
             raise ValueError("new num_trees must cover the init_booster's iterations")
         prev_trees = {
-            k: jnp.asarray(v).reshape((prev.num_iterations, K) + v.shape[1:])
-            for k, v in prev.tree_arrays().items()
+            key: jnp.asarray(v).reshape((prev.num_iterations, K) + v.shape[1:])
+            for key, v in prev.tree_arrays().items()
         }
         # same fp32 order as the CPU replay: broadcast(new init) += each tree
-        score = _accumulate(prev_trees, Xb, jnp.asarray(init), max(prev.max_depth_seen, 1))
-        for k_arr in out:
-            out[k_arr][: prev.num_total_trees] = prev.tree_arrays()[k_arr]
+        score = _accumulate(prev_trees, Xb, jnp.asarray(init),
+                            max(prev.max_depth_seen, 1))
+        for key in _TREE_KEYS:
+            out[key] = out[key].at[: prev.num_total_trees].set(
+                jnp.asarray(prev.tree_arrays()[key]))
         start_iter = prev.num_iterations
-        max_depth_seen = prev.max_depth_seen
+        max_depth_prev = prev.max_depth_seen
 
     vXb = jnp.asarray(valid.X_binned) if valid is not None else None
     vscore = (
@@ -124,96 +227,71 @@ def train_device(
         else None
     )
     if valid is not None and init_booster is not None:
-        vscore = _accumulate(prev_trees, vXb, jnp.asarray(init), max(prev.max_depth_seen, 1))
+        vscore = _accumulate(prev_trees, vXb, jnp.asarray(init),
+                             max(max_depth_prev, 1))
     best_iteration, best_value, stale = -1, None, 0
 
-    ones_rows = np.ones((NP,), bool)
+    # pad rows are bagged out permanently: they must never touch a histogram
+    ones_rows = jnp.asarray(np.pad(np.ones((N,), bool), (0, pad)))
+    if mesh is not None:
+        ones_rows = shard_rows(mesh, ones_rows)[0]
     ones_feat = jnp.ones((F,), bool)
 
-    rank_plan = None
-    if p.objective == "lambdarank":
-        from dryad_tpu.engine.lambdarank import PaddingPlan
-
-        rank_plan = PaddingPlan(np.asarray(qoff))  # loop-invariant scatter plan
-
+    # ---- boosting loop: async dispatch, zero per-iteration syncs -------------
     for it in range(start_iter, T // K):
-        if p.objective == "lambdarank":
-            # ragged per-query pairwise work on padded per-query segments
-            # (engine/lambdarank.py); pad rows beyond N get zero gradients
-            from dryad_tpu.engine.lambdarank import grad_hess_ranking
-
-            w_rank = None if weight is None else weight[:N]
-            g_all, h_all = grad_hess_ranking(obj, score[:N, 0], y[:N], w_rank, qoff,
-                                             plan=rank_plan)
-            if pad:
-                g_all = jnp.pad(g_all, (0, pad))
-                h_all = jnp.pad(h_all, (0, pad))
-            g_all, h_all = g_all[:, None], h_all[:, None]
-        elif K > 1:
-            g_all, h_all = obj.grad_hess_jax(score, y, weight)
-        else:
-            g_all, h_all = obj.grad_hess_jax(score[:, 0], y, weight)
-            g_all, h_all = g_all[:, None], h_all[:, None]
-
         row_mask_np, feat_mask_np = sample_masks(p, it, N, F)
-        bag_np = ones_rows if row_mask_np is None else np.pad(row_mask_np, (0, pad))
-        if pad:
-            bag_np = bag_np.copy()
-            bag_np[N:] = False
+        if row_mask_np is None:
+            bag = ones_rows
+        else:
+            bag_np = np.pad(row_mask_np, (0, pad))
+            bag = jnp.asarray(bag_np)
+            if mesh is not None:
+                bag = shard_rows(mesh, bag)[0]
         fmask = ones_feat if feat_mask_np is None else jnp.asarray(feat_mask_np)
-        bag = jnp.asarray(bag_np)
 
+        g_all, h_all = grads(score)
         for k in range(K):
             t = it * K + k
-            if mesh is not None:
-                from dryad_tpu.engine.distributed import grow_and_apply_sharded
-
-                tree, new_col = grow_and_apply_sharded(
-                    p, B, has_cat, mesh, Xb, g_all[:, k], h_all[:, k], bag,
-                    fmask, is_cat_feat, score[:, k],
-                )
-            else:
-                tree, new_col = _grow_and_apply(
-                    p, B, has_cat, Xb, g_all[:, k], h_all[:, k], bag, fmask,
-                    is_cat_feat, score[:, k],
-                )
-            score = score.at[:, k].set(new_col)
-            max_depth_seen = max(max_depth_seen, int(tree["max_depth"]))
-            for key in ("feature", "threshold", "left", "right", "value",
-                        "is_cat", "cat_bitset"):
-                out[key][t] = np.asarray(tree[key])
+            out, score = step(out, score, g_all, h_all, bag, fmask, t, k)
             if valid is not None:
-                vscore = vscore.at[:, k].set(_apply_tree(tree, vXb, vscore[:, k]))
+                vscore = vscore.at[:, k].set(
+                    _apply_valid_jit(out, t, vXb, vscore[:, k],
+                                     out["max_depth"][t])
+                )
 
         info: dict = {"iteration": it}
+        stop = False
         if valid is not None:
             from dryad_tpu.metrics import evaluate_raw
 
-            vs = np.asarray(vscore)
+            vs = np.asarray(vscore)  # forced sync: metric eval on host
             name, value, higher = evaluate_raw(
                 p.objective, p.metric, valid.y, vs if K > 1 else vs[:, 0],
                 valid.query_offsets, p.ndcg_at,
             )
             info[f"valid_{name}"] = value
-            improved = best_value is None or (value > best_value if higher else value < best_value)
+            improved = best_value is None or (
+                value > best_value if higher else value < best_value)
             if improved:
                 best_iteration, best_value, stale = it + 1, value, 0
             else:
                 stale += 1
             if p.early_stopping_rounds and stale >= p.early_stopping_rounds:
-                if callback is not None:
-                    callback(it, info)
-                T = (it + 1) * K
-                break
+                stop = True
         if callback is not None:
             callback(it, info)
+        if stop:
+            T = (it + 1) * K
+            break
 
-    for key in out:
-        out[key] = out[key][:T]
+    # ---- the single end-of-training fetch ------------------------------------
+    host = {key: np.asarray(out[key][:T]) for key in _TREE_KEYS}
+    depths = np.asarray(out["max_depth"][:T])
+    max_depth_seen = max(int(depths.max(initial=0)), max_depth_prev)
     return Booster(
         p, data.mapper,
-        out["feature"], out["threshold"], out["left"], out["right"], out["value"],
-        out["is_cat"], out["cat_bitset"],
+        host["feature"], host["threshold"], host["left"], host["right"],
+        host["value"], host["is_cat"], host["cat_bitset"],
         init, max_depth_seen,
         best_iteration=best_iteration,
     )

@@ -1,0 +1,48 @@
+"""Reliable device-side histogram timing: loop inside ONE jit program.
+
+Per-call host timing through the axon tunnel is wildly unreliable (parts
+measure slower than their sum).  Here K dependent iterations run under one
+lax.fori_loop inside one jit, so wall-clock/K is true device time.
+"""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import dryad_tpu as dryad
+from dryad_tpu.datasets import higgs_like
+from dryad_tpu.engine.histogram import build_hist, build_hist_segmented
+
+N, F, B = 200_000, 28, 256
+K = 10
+
+
+def loop_time(step, init=0.0):
+    """step: scalar f32 -> scalar f32 (must consume + produce dependency)."""
+    f = jax.jit(lambda s0: jax.lax.fori_loop(0, K, lambda i, s: step(s), s0))
+    _ = float(f(jnp.float32(init)))          # compile + warm
+    t0 = time.perf_counter()
+    _ = float(f(jnp.float32(init)))
+    return (time.perf_counter() - t0) / K
+
+
+def main():
+    X, y = higgs_like(N, seed=7)
+    ds = dryad.Dataset(X, y, max_bins=B)
+    Xb = jnp.asarray(ds.X_binned)
+    g0 = jax.random.normal(jax.random.PRNGKey(0), (N,), jnp.float32)
+    h0 = jnp.abs(g0) + 0.1
+    mask = jnp.ones((N,), bool)
+    sel = jax.random.randint(jax.random.PRNGKey(1), (N,), 0, 128).astype(jnp.int32)
+
+    for backend in ("xla", "pallas"):
+        t1 = loop_time(lambda s: build_hist(
+            Xb, g0 + s, h0, mask, B, backend=backend)[0, 0, 0] * 1e-30)
+        t2 = loop_time(lambda s: build_hist_segmented(
+            Xb, g0 + s, h0, sel, 128, B, backend=backend)[0, 0, 0, 0] * 1e-30)
+        print(f"{backend:7s} single: {t1*1e3:7.2f} ms   seg P=128: {t2*1e3:7.2f} ms")
+
+
+if __name__ == "__main__":
+    main()

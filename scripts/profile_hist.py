@@ -1,0 +1,67 @@
+"""Microbenchmark: where does a depth-8 boosting iteration spend its time?
+
+Times each device stage of the levelwise grower in isolation on the
+Higgs-200k shape (N=200k, F=28, B=256): single-leaf histogram, per-level
+segmented histogram (P=128), split scan, argsort, predict traversal.
+"""
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import dryad_tpu as dryad
+from dryad_tpu.datasets import higgs_like
+from dryad_tpu.engine.histogram import build_hist, build_hist_multi, build_hist_segmented
+from dryad_tpu.engine.split import find_best_split
+
+
+def timeit(fn, *args, n=5, **kw):
+    out = fn(*args, **kw)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = fn(*args, **kw)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / n
+
+
+def main():
+    N, F, B = 200_000, 28, 256
+    X, y = higgs_like(N, seed=7)
+    ds = dryad.Dataset(X, y, max_bins=B)
+    Xb = jnp.asarray(ds.X_binned)
+    key = jax.random.PRNGKey(0)
+    g = jax.random.normal(key, (N,), jnp.float32)
+    h = jnp.abs(g) + 0.1
+    mask = jnp.ones((N,), bool)
+    sel128 = jax.random.randint(jax.random.PRNGKey(1), (N,), 0, 128).astype(jnp.int32)
+
+    f_single = jax.jit(lambda m: build_hist(Xb, g, h, m, B))
+    f_single_fast = jax.jit(lambda m: build_hist(Xb, g, h, m, B, precision="fast"))
+    f_seg = jax.jit(lambda s: build_hist_segmented(Xb, g, h, s, 128, B))
+    f_seg_fast = jax.jit(lambda s: build_hist_segmented(Xb, g, h, s, 128, B, precision="fast"))
+    f_multi = jax.jit(lambda s: build_hist_multi(Xb, g, h, s, 16, B))
+    f_sort = jax.jit(lambda s: jnp.argsort(s, stable=True))
+    hist = f_single(mask)
+
+    f_split = jax.jit(lambda hh: find_best_split(
+        hh, hh[0].sum(), hh[1].sum(), hh[2].sum(),
+        lambda_l2=1.0, min_child_weight=1e-3, min_data_in_leaf=20,
+        min_split_gain=0.0, feat_mask=jnp.ones((F,), bool),
+        is_cat_feat=jnp.zeros((F,), bool), allow=jnp.bool_(True), has_cat=False))
+
+    print(f"devices: {jax.devices()}")
+    print(f"single-leaf hist (exact):    {timeit(f_single, mask)*1e3:8.2f} ms")
+    print(f"single-leaf hist (fast):     {timeit(f_single_fast, mask)*1e3:8.2f} ms")
+    print(f"segmented P=128 (exact):     {timeit(f_seg, sel128)*1e3:8.2f} ms")
+    print(f"segmented P=128 (fast):      {timeit(f_seg_fast, sel128)*1e3:8.2f} ms")
+    print(f"multi dense P=16 (exact):    {timeit(f_multi, sel128 % 16)*1e3:8.2f} ms")
+    print(f"argsort 200k:                {timeit(f_sort, sel128)*1e3:8.2f} ms")
+    print(f"split scan (full tree hist): {timeit(f_split, hist)*1e3:8.2f} ms")
+
+
+if __name__ == "__main__":
+    main()

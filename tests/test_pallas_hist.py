@@ -1,0 +1,90 @@
+"""Pallas histogram kernel vs the XLA one-hot matmul oracle (SURVEY.md §4:
+Pallas interpret-mode checks stand in for GPU sanitizers)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dryad_tpu.engine.histogram import build_hist, build_hist_segmented
+from dryad_tpu.engine.pallas_hist import (
+    _split3,
+    build_hist_pallas,
+    build_hist_segmented_pallas,
+)
+
+
+def _data(n=1000, f=5, b=16, seed=0):
+    rng = np.random.default_rng(seed)
+    Xb = rng.integers(0, b, size=(n, f), dtype=np.uint8)
+    g = rng.normal(size=n).astype(np.float32)
+    h = (np.abs(rng.normal(size=n)) + 0.1).astype(np.float32)
+    return jnp.asarray(Xb), jnp.asarray(g), jnp.asarray(h)
+
+
+def test_split3_reconstructs_f32():
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(
+        np.concatenate([
+            rng.normal(size=1000) * 10.0 ** rng.integers(-20, 20, size=1000),
+            [0.0, 1.0, -1.0, 1e-30, 1e30],
+        ]).astype(np.float32)
+    )
+    hi, mid, lo = _split3(x)
+    rec = hi.astype(jnp.float32) + mid.astype(jnp.float32) + lo.astype(jnp.float32)
+    np.testing.assert_allclose(np.asarray(rec), np.asarray(x), rtol=1e-7)
+
+
+def test_masked_hist_matches_xla():
+    Xb, g, h = _data()
+    mask = jnp.asarray(np.random.default_rng(2).random(1000) < 0.7)
+    ref = build_hist(Xb, g, h, mask, 16)
+    out = build_hist_pallas(Xb, g, h, mask, 16)
+    assert out.shape == ref.shape == (3, 5, 16)
+    np.testing.assert_array_equal(np.asarray(out[2]), np.asarray(ref[2]))  # counts exact
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-4)
+
+
+def test_segmented_hist_matches_xla():
+    Xb, g, h = _data(n=3000, f=7, b=32, seed=3)
+    P = 6
+    sel_np = np.random.default_rng(4).integers(0, P + 1, size=3000)  # P = dropped
+    sel = jnp.asarray(sel_np.astype(np.int32))
+    ref = build_hist_segmented(Xb, g, h, sel, P, 32)
+    out = build_hist_segmented_pallas(Xb, g, h, sel, P, 32)
+    assert out.shape == ref.shape == (P, 3, 7, 32)
+    np.testing.assert_array_equal(np.asarray(out[:, 2]), np.asarray(ref[:, 2]))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-4)
+
+
+def test_segmented_hist_empty_and_single_leaf():
+    Xb, g, h = _data(n=500, f=3, b=8, seed=5)
+    P = 4
+    sel = jnp.asarray(np.full(500, 2, np.int32))  # all rows in leaf 2
+    out = np.asarray(build_hist_segmented_pallas(Xb, g, h, sel, P, 8))
+    assert out.shape == (P, 3, 3, 8)
+    np.testing.assert_array_equal(out[[0, 1, 3]], 0.0)  # empty leaves are zero
+    assert out[2, 2].sum(axis=1) == pytest.approx(500)
+
+
+def test_wide_features_blocking():
+    # force multiple feature blocks: F*B > lane budget
+    Xb, g, h = _data(n=600, f=40, b=128, seed=6)
+    mask = jnp.ones((600,), bool)
+    ref = build_hist(Xb, g, h, mask, 128)
+    out = build_hist_pallas(Xb, g, h, mask, 128)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-4)
+
+
+def test_train_with_pallas_backend_matches_xla_trees():
+    import dryad_tpu as dryad
+    from dryad_tpu.datasets import higgs_like
+
+    X, y = higgs_like(4000, seed=9)
+    ds = dryad.Dataset(X, y, max_bins=32)
+    base = dict(objective="binary", num_trees=5, num_leaves=15, max_bins=32,
+                growth="depthwise", max_depth=4)
+    b_xla = dryad.train(dict(base, hist_backend="xla"), ds, backend="tpu")
+    b_pl = dryad.train(dict(base, hist_backend="pallas"), ds, backend="tpu")
+    np.testing.assert_array_equal(b_xla.feature, b_pl.feature)
+    np.testing.assert_array_equal(b_xla.threshold, b_pl.threshold)
+    np.testing.assert_allclose(b_xla.value, b_pl.value, atol=1e-4)
