@@ -88,3 +88,20 @@ def test_train_with_pallas_backend_matches_xla_trees():
     np.testing.assert_array_equal(b_xla.feature, b_pl.feature)
     np.testing.assert_array_equal(b_xla.threshold, b_pl.threshold)
     np.testing.assert_allclose(b_xla.value, b_pl.value, atol=1e-4)
+
+
+def test_train_pallas_with_bagging_matches_xla_trees():
+    # exercises the segmented pallas path with an out-of-bag slot
+    import dryad_tpu as dryad
+    from dryad_tpu.datasets import higgs_like
+
+    X, y = higgs_like(4000, seed=11)
+    ds = dryad.Dataset(X, y, max_bins=32)
+    base = dict(objective="binary", num_trees=4, num_leaves=15, max_bins=32,
+                growth="depthwise", max_depth=4, subsample=0.7, seed=5,
+                min_data_in_leaf=5)
+    b_xla = dryad.train(dict(base, hist_backend="xla"), ds, backend="tpu")
+    b_pl = dryad.train(dict(base, hist_backend="pallas"), ds, backend="tpu")
+    np.testing.assert_array_equal(b_xla.feature, b_pl.feature)
+    np.testing.assert_array_equal(b_xla.threshold, b_pl.threshold)
+    np.testing.assert_allclose(b_xla.value, b_pl.value, atol=1e-4)
