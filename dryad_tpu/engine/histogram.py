@@ -228,6 +228,7 @@ def build_hist_segmented(
     axis_name: str | None = None,
     precision: str = "exact",
     backend: str = "xla",
+    rows_bound: int | None = None,
 ) -> jnp.ndarray:
     """Histograms for ``num_cols`` leaves -> (P, 3, F, B) fp32, O(N·F·B) work.
 
@@ -247,14 +248,16 @@ def build_hist_segmented(
 
         if pallas_hist.supports(total_bins):
             return pallas_hist.build_hist_segmented_pallas(
-                Xb, g, h, sel, num_cols, total_bins, axis_name=axis_name
+                Xb, g, h, sel, num_cols, total_bins, axis_name=axis_name,
+                rows_bound=rows_bound,
             )
     N, F = Xb.shape
     B = int(total_bins)
     P = int(num_cols)
     prec = _resolve_precision(precision)
     T = _segment_tile(N, P)
-    n_tiles = N // T + P + 1  # worst case: every leaf wastes < 1 tile
+    bound = N if rows_bound is None else min(int(rows_bound), N)
+    n_tiles = bound // T + P + 1  # worst case: every leaf wastes < 1 tile
 
     sel = sel.astype(jnp.int32)
     order = jnp.argsort(sel, stable=True)

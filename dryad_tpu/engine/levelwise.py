@@ -185,13 +185,22 @@ def grow_tree_levelwise(
         left_smaller = CL <= CR
         small_slot = jnp.where(left_smaller, sj, right_slot)
         large_slot = jnp.where(left_smaller, right_slot, sj)
+        # non-do candidates scatter to L+1 (out of bounds, dropped) so
+        # colof[L] stays P and out-of-bag rows are never selected
         colof = jnp.full((L + 1,), P, jnp.int32).at[
-            jnp.where(do, small_slot, L)].set(jnp.arange(P, dtype=jnp.int32), mode="drop")
+            jnp.where(do, small_slot, L + 1)].set(
+                jnp.arange(P, dtype=jnp.int32), mode="drop")
         smallsel = colof[jnp.minimum(row_slot, L)]
+        # Single device, smaller children cover at most half the rows
+        # (min(left,right) <= parent/2, parents disjoint) -> half the tile
+        # grid.  Under shard_map the smaller child is chosen on GLOBAL
+        # counts and one shard's share of it may exceed half that shard,
+        # so no bound applies there.
         hist_small = build_hist_segmented(
             Xb, g, h, smallsel, P, B,
             rows_per_chunk=p.rows_per_chunk, axis_name=axis_name,
             precision=p.hist_precision, backend=p.hist_backend,
+            rows_bound=(N // 2 + 1) if axis_name is None else None,
         )
         if p.hist_subtraction:
             hist_large = hists[sj] - hist_small

@@ -238,15 +238,23 @@ def build_hist_pallas(
     return hist
 
 
-def tile_plan(sel: jnp.ndarray, N: int, P: int, T: int):
+def tile_plan(sel: jnp.ndarray, N: int, P: int, T: int,
+              rows_bound: int | None = None):
     """Bucket rows by leaf into fixed tiles.
 
     Returns (buf, tile_leaf, tile_first): ``buf`` (n_tiles*T,) row ids with
     sentinel N for padding slots; ``tile_leaf`` monotone leaf per tile
     (every leaf owns >= 1 tile); ``tile_first`` marks each leaf's first
     tile.  Deterministic: stable sort by leaf, fixed slot order.
+
+    ``rows_bound`` caps the total selected rows when the caller can prove a
+    tighter bound than N — the level-wise grower histograms only smaller
+    children, which cover at most half the rows, halving the static tile
+    count (and the kernel's grid).  Rows beyond the bound would be silently
+    dropped, so only pass a mathematically guaranteed bound.
     """
-    n_tiles = N // T + P + 1
+    bound = N if rows_bound is None else min(int(rows_bound), N)
+    n_tiles = bound // T + P + 1
     sel = sel.astype(jnp.int32)
     order = jnp.argsort(sel, stable=True)
     sel_sorted = sel[order]
@@ -318,6 +326,7 @@ def build_hist_segmented_pallas(
     total_bins: int,
     *,
     axis_name: str | None = None,
+    rows_bound: int | None = None,
 ) -> jnp.ndarray:
     """Per-leaf histograms for a whole tree level -> (P, 3, F, B) f32.
 
@@ -326,7 +335,8 @@ def build_hist_segmented_pallas(
     asymptotics.
     """
     N = Xb.shape[0]
-    buf, tile_leaf, tile_first = tile_plan(sel, N, int(num_cols), _TILE_ROWS)
+    buf, tile_leaf, tile_first = tile_plan(sel, N, int(num_cols), _TILE_ROWS,
+                                           rows_bound=rows_bound)
     return hist_from_plan(
         Xb, g, h, buf, tile_leaf, tile_first, num_cols, total_bins,
         axis_name=axis_name,
