@@ -31,23 +31,54 @@ def train(
     backend: str = "auto",
     init_booster: Optional[Booster] = None,
     callback=None,
+    callbacks=None,
+    checkpoint_dir: Optional[str] = None,
+    checkpoint_every: int = 10,
+    resume: bool = False,
     **kw: Any,
 ) -> Booster:
-    """Train a booster.  backend: 'auto' (TPU if available), 'tpu', 'cpu'."""
+    """Train a booster.  backend: 'auto' (TPU if available), 'tpu', 'cpu'.
+
+    ``checkpoint_dir`` enables periodic atomic checkpoints every
+    ``checkpoint_every`` iterations; with ``resume=True`` training continues
+    from the newest checkpoint in that directory (reproducing the
+    uninterrupted run bit for bit — see dryad_tpu/checkpoint.py).
+    ``callbacks`` is a list of ``fn(iteration, info)`` (see
+    dryad_tpu/callbacks.py); ``callback`` remains as a single-function alias.
+    """
     p = make_params(params, **kw)
     if train_set is None:
         raise ValueError("train_set is required")
     valid = valid_sets[0] if valid_sets else None
     if backend == "auto":
         backend = "tpu" if (_accelerator_present() and _engine_present()) else "cpu"
+
+    checkpointer = None
+    if checkpoint_dir is not None:
+        from dryad_tpu.checkpoint import Checkpointer
+
+        checkpointer = Checkpointer(checkpoint_dir, every=checkpoint_every)
+        if resume and init_booster is None:
+            latest = checkpointer.latest()
+            if latest is not None:
+                init_booster = latest[0]
+    elif resume:
+        raise ValueError("resume=True requires checkpoint_dir")
+
+    from dryad_tpu.callbacks import combine
+
+    cb = combine(([callback] if callback else []) + list(callbacks or []))
+
     if backend == "cpu":
         from dryad_tpu.cpu.trainer import train_cpu
 
-        return train_cpu(p, train_set, valid, init_booster=init_booster, callback=callback)
+        return train_cpu(p, train_set, valid, init_booster=init_booster,
+                         callback=cb, checkpointer=checkpointer)
     if backend == "tpu":
         from dryad_tpu.engine.train import train_device
 
-        return train_device(p, train_set, valid, init_booster=init_booster, callback=callback)
+        return train_device(p, train_set, valid, init_booster=init_booster,
+                            callback=cb, checkpointer=checkpointer)
     raise ValueError(f"unknown backend {backend!r}")
 
 

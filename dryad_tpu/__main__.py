@@ -1,0 +1,172 @@
+"""Command-line front end (SURVEY.md §5 config/flag system).
+
+    python -m dryad_tpu train   --config params.json --data X.npy --label y.npy \
+        [--valid Xv.npy --valid-label yv.npy] [--model out.dryad] \
+        [--checkpoint-dir DIR --checkpoint-every N --resume] \
+        [--log-jsonl metrics.jsonl] [--backend auto|tpu|cpu] [--quiet]
+    python -m dryad_tpu predict --model m.dryad --data X.npy --out preds.npy [--raw]
+    python -m dryad_tpu dump    --model m.dryad [--out model.json]
+
+Data formats: ``.npy`` (dense float matrix), ``.npz`` with keys
+``indptr/indices/values/num_features`` (CSR sparse), or ``.csv``
+(comma-separated, no header).  Params JSON accepts the same names/aliases as
+``dryad.train`` (config.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+import numpy as np
+
+
+def _load_matrix(path: str):
+    """-> dense ndarray, or ('csr', (indptr, indices, values, num_features))."""
+    if path.endswith(".npy"):
+        return np.load(path)
+    if path.endswith(".npz"):
+        z = np.load(path)
+        if "indptr" in z.files:
+            return ("csr", (z["indptr"], z["indices"], z["values"],
+                            int(z["num_features"])))
+        return z[z.files[0]]
+    if path.endswith(".csv"):
+        return np.loadtxt(path, delimiter=",", dtype=np.float32, ndmin=2)
+    raise SystemExit(f"unsupported data format: {path} (use .npy/.npz/.csv)")
+
+
+def _load_vector(path: str) -> np.ndarray:
+    return np.asarray(_load_matrix(path)).reshape(-1)
+
+
+def _make_dataset(data_path, label_path, group_path, params, mapper=None):
+    import dryad_tpu as dryad
+
+    y = _load_vector(label_path) if label_path else None
+    group = _load_vector(group_path).astype(np.int64) if group_path else None
+    X = _load_matrix(data_path)
+    kw = dict(
+        weight=None, group=group,
+        categorical_features=params.categorical_features if params else (),
+        max_bins=params.max_bins if params else 256,
+        mapper=mapper,
+    )
+    if isinstance(X, tuple) and X[0] == "csr":
+        return dryad.Dataset(None, y, csr=X[1], **kw)
+    return dryad.Dataset(X, y, **kw)
+
+
+def cmd_train(args) -> int:
+    import dryad_tpu as dryad
+    from dryad_tpu.callbacks import JsonlLogger, log_evaluation
+    from dryad_tpu.config import Params
+
+    params = Params.from_json(args.config) if args.config else dryad.Params()
+    ds = _make_dataset(args.data, args.label, args.group, params)
+    valid_sets = None
+    if args.valid:
+        vds = _make_dataset(args.valid, args.valid_label, args.valid_group,
+                            params, mapper=ds.mapper)
+        valid_sets = [vds]
+
+    callbacks = []
+    if not args.quiet:
+        callbacks.append(log_evaluation(period=args.log_period))
+    logger = None
+    if args.log_jsonl:
+        logger = JsonlLogger(args.log_jsonl)
+        callbacks.append(logger)
+
+    booster = dryad.train(
+        params, ds, valid_sets,
+        backend=args.backend,
+        callbacks=callbacks,
+        checkpoint_dir=args.checkpoint_dir,
+        checkpoint_every=args.checkpoint_every,
+        resume=args.resume,
+    )
+    if logger is not None:
+        logger.close()
+    if args.model:
+        booster.save(args.model)
+        if not args.quiet:
+            print(f"saved {booster.num_iterations} iterations -> {args.model}")
+    return 0
+
+
+def cmd_predict(args) -> int:
+    import dryad_tpu as dryad
+
+    booster = dryad.Booster.load(args.model)
+    X = _load_matrix(args.data)
+    if isinstance(X, tuple) and X[0] == "csr":
+        from dryad_tpu.data.binning import bin_csr
+
+        indptr, indices, values, nf = X[1]
+        Xb = bin_csr(indptr, indices, values, nf, booster.mapper)
+        preds = booster.predict_binned(Xb, raw_score=args.raw,
+                                       backend=args.backend)
+    else:
+        preds = booster.predict(np.asarray(X, np.float32), raw_score=args.raw,
+                                backend=args.backend)
+    np.save(args.out, preds)
+    print(f"wrote predictions {preds.shape} -> {args.out}")
+    return 0
+
+
+def cmd_dump(args) -> int:
+    import dryad_tpu as dryad
+
+    booster = dryad.Booster.load(args.model)
+    text = json.dumps(booster.dump_model(), indent=2)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text)
+    else:
+        print(text)
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="dryad_tpu")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    t = sub.add_parser("train", help="train a booster")
+    t.add_argument("--config", help="params JSON file")
+    t.add_argument("--data", required=True)
+    t.add_argument("--label", required=True)
+    t.add_argument("--group", help="query sizes for ranking")
+    t.add_argument("--valid")
+    t.add_argument("--valid-label")
+    t.add_argument("--valid-group")
+    t.add_argument("--model", help="output model path")
+    t.add_argument("--backend", default="auto", choices=["auto", "tpu", "cpu"])
+    t.add_argument("--checkpoint-dir")
+    t.add_argument("--checkpoint-every", type=int, default=10)
+    t.add_argument("--resume", action="store_true")
+    t.add_argument("--log-jsonl", help="per-iteration metrics JSONL path")
+    t.add_argument("--log-period", type=int, default=1)
+    t.add_argument("--quiet", action="store_true")
+    t.set_defaults(fn=cmd_train)
+
+    pr = sub.add_parser("predict", help="predict with a saved model")
+    pr.add_argument("--model", required=True)
+    pr.add_argument("--data", required=True)
+    pr.add_argument("--out", required=True)
+    pr.add_argument("--raw", action="store_true", help="raw scores (no link)")
+    pr.add_argument("--backend", default="cpu", choices=["tpu", "cpu"])
+    pr.set_defaults(fn=cmd_predict)
+
+    d = sub.add_parser("dump", help="dump model structure as JSON")
+    d.add_argument("--model", required=True)
+    d.add_argument("--out")
+    d.set_defaults(fn=cmd_dump)
+
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -50,6 +50,8 @@ class Booster:
         init_score: np.ndarray,
         max_depth_seen: int,
         best_iteration: int = -1,
+        gain: Optional[np.ndarray] = None,
+        train_state: Optional[dict] = None,
     ):
         self.params = params
         self.mapper = mapper
@@ -63,6 +65,11 @@ class Booster:
         self.init_score = np.asarray(init_score, np.float32).reshape(-1)  # (K,) or (1,)
         self.max_depth_seen = int(max_depth_seen)
         self.best_iteration = int(best_iteration)
+        # per-node split gain (0 at leaves); optional for old checkpoints
+        self.gain = (np.zeros_like(value) if gain is None
+                     else np.asarray(gain, np.float32))
+        # loop state a resumed run needs to continue exactly (early stopping)
+        self.train_state = dict(train_state or {})
 
     # ---- shape helpers -----------------------------------------------------
     @property
@@ -86,6 +93,7 @@ class Booster:
             "value": self.value,
             "is_cat": self.is_cat,
             "cat_bitset": self.cat_bitset,
+            "gain": self.gain,
         }
 
     # ---- predict -----------------------------------------------------------
@@ -144,6 +152,7 @@ class Booster:
             value=self.value,
             is_cat=self.is_cat,
             cat_bitset=self.cat_bitset,
+            gain=self.gain,
             init_score=self.init_score,
             meta=np.frombuffer(
                 json.dumps(
@@ -151,6 +160,7 @@ class Booster:
                         "params": self.params.to_dict(),
                         "max_depth_seen": self.max_depth_seen,
                         "best_iteration": self.best_iteration,
+                        "train_state": self.train_state,
                         "format_version": 1,
                     }
                 ).encode(),
@@ -184,16 +194,60 @@ class Booster:
                 z["init_score"],
                 meta["max_depth_seen"],
                 meta.get("best_iteration", -1),
+                gain=z["gain"] if "gain" in z.files else None,
+                train_state=meta.get("train_state"),
             )
 
     # ---- introspection -----------------------------------------------------
     def feature_importance(self, importance_type: str = "split") -> np.ndarray:
-        """Per-feature importance: 'split' counts uses as a split feature."""
+        """Per-feature importance.
+
+        'split': number of times each feature is used as a split.
+        'gain':  total split gain accumulated by each feature.
+        """
         F = self.mapper.num_features
-        used = self.feature[self.feature >= 0]
-        if importance_type != "split":
-            raise NotImplementedError("only 'split' importance in this version")
-        return np.bincount(used, minlength=F).astype(np.int64)
+        internal = self.feature >= 0
+        used = self.feature[internal]
+        if importance_type == "split":
+            return np.bincount(used, minlength=F).astype(np.int64)
+        if importance_type == "gain":
+            return np.bincount(
+                used, weights=self.gain[internal].astype(np.float64), minlength=F
+            )
+        raise ValueError("importance_type must be 'split' or 'gain'")
+
+    def dump_model(self) -> dict:
+        """Structured model dump (JSON-serializable), one dict per tree."""
+        trees = []
+        for t in range(self.num_total_trees):
+            nodes = []
+            n_nodes = int((self.feature[t] >= 0).sum()) * 2 + 1
+            for n in range(n_nodes):
+                f = int(self.feature[t, n])
+                if f >= 0:
+                    nodes.append({
+                        "node": n,
+                        "split_feature": f,
+                        "threshold_bin": int(self.threshold[t, n]),
+                        "is_categorical": bool(self.is_cat[t, n]),
+                        "gain": float(self.gain[t, n]),
+                        "left": int(self.left[t, n]),
+                        "right": int(self.right[t, n]),
+                    })
+                else:
+                    nodes.append({"node": n, "value": float(self.value[t, n])})
+            trees.append({
+                "tree_index": t,
+                "class": t % self.num_outputs,
+                "nodes": nodes,
+            })
+        return {
+            "num_iterations": self.num_iterations,
+            "num_class": self.num_outputs,
+            "init_score": [float(v) for v in self.init_score],
+            "params": self.params.to_dict(),
+            "trees": trees,
+        }
 
 
 def empty_tree_arrays(num_total_trees: int, max_nodes: int) -> dict[str, np.ndarray]:
@@ -205,4 +259,5 @@ def empty_tree_arrays(num_total_trees: int, max_nodes: int) -> dict[str, np.ndar
         "value": np.zeros((num_total_trees, max_nodes), np.float32),
         "is_cat": np.zeros((num_total_trees, max_nodes), bool),
         "cat_bitset": np.zeros((num_total_trees, max_nodes, CAT_WORDS), np.uint32),
+        "gain": np.zeros((num_total_trees, max_nodes), np.float32),
     }

@@ -124,6 +124,7 @@ class _TreeGrower:
             out["threshold"][t, parent] = split.threshold if not split.is_cat else 0
             out["left"][t, parent] = left_id
             out["right"][t, parent] = right_id
+            out["gain"][t, parent] = split.gain
             if split.is_cat:
                 out["is_cat"][t, parent] = True
                 out["cat_bitset"][t, parent] = cat_members_to_bitset(split.cat_members, CAT_WORDS)
@@ -190,6 +191,7 @@ def train_cpu(
     num_trees: Optional[int] = None,
     init_booster: Optional[Booster] = None,
     callback: Optional[Callable[[int, dict], None]] = None,
+    checkpointer=None,
 ) -> Booster:
     """Reference trainer: ``dryad.train`` semantics on the CPU backend."""
     p = params.validate()
@@ -240,6 +242,16 @@ def train_cpu(
         else None
     )
     best_iteration, best_value, stale = -1, None, 0
+    if init_booster is not None:
+        # resume continues the eval/early-stop state exactly where it stopped
+        if valid is not None:
+            for t in range(init_booster.num_total_trees):
+                vleaves = predict_tree_leaves(
+                    init_booster.tree_arrays(), vXb, t, init_booster.max_depth_seen)
+                vscore[:, t % K] += init_booster.value[t, vleaves]
+        best_iteration = init_booster.best_iteration
+        best_value = init_booster.train_state.get("best_value")
+        stale = init_booster.train_state.get("stale", 0)
 
     all_rows = np.arange(N, dtype=np.int64)
     for it in range(start_iter, T // K):
@@ -285,13 +297,26 @@ def train_cpu(
                 break
         if callback is not None:
             callback(it, info)
+        if checkpointer is not None and checkpointer.due(it + 1):
+            checkpointer.save(
+                _make_booster(p, data.mapper, out, (it + 1) * K, init,
+                              max_depth_seen, best_iteration, best_value, stale),
+                it + 1,
+            )
 
-    for key in out:
-        out[key] = out[key][:T]
+    return _make_booster(p, data.mapper, out, T, init, max_depth_seen,
+                         best_iteration, best_value, stale)
+
+
+def _make_booster(p, mapper, out, T, init, max_depth_seen, best_iteration,
+                  best_value=None, stale=0):
     return Booster(
-        p, data.mapper,
-        out["feature"], out["threshold"], out["left"], out["right"], out["value"],
-        out["is_cat"], out["cat_bitset"],
+        p, mapper,
+        out["feature"][:T], out["threshold"][:T], out["left"][:T],
+        out["right"][:T], out["value"][:T],
+        out["is_cat"][:T], out["cat_bitset"][:T],
         init, max_depth_seen,
         best_iteration=best_iteration,
+        gain=out["gain"][:T],
+        train_state={"best_value": best_value, "stale": int(stale)},
     )

@@ -77,7 +77,8 @@ def grow_sharded(params: Params, total_bins: int, has_cat: bool,
     rep = P()
     tree_specs = {
         "feature": rep, "threshold": rep, "left": rep, "right": rep,
-        "value": rep, "is_cat": rep, "cat_bitset": rep, "max_depth": rep,
+        "value": rep, "gain": rep, "is_cat": rep, "cat_bitset": rep,
+        "max_depth": rep,
     }
     return jax.shard_map(
         run, mesh=mesh,

@@ -162,6 +162,7 @@ def grow_tree(
         "left": jnp.zeros((M,), jnp.int32),
         "right": jnp.zeros((M,), jnp.int32),
         "value": jnp.zeros((M,), jnp.float32),
+        "gain": jnp.zeros((M,), jnp.float32),
         "is_cat": jnp.zeros((M,), bool),
         "cat_mask_nodes": jnp.zeros((M, root.cat_mask.shape[0]), bool),
         "num_nodes": jnp.int32(1),
@@ -201,6 +202,7 @@ def grow_tree(
         right_id = left_id + 1
         new_r = jnp.int32(k + 1)
 
+        gain_arr = st["gain"].at[parent].set(st["slot_gain"][s])
         feature = st["feature"].at[parent].set(sf)
         threshold = st["threshold"].at[parent].set(jnp.where(cat_split, 0, thr))
         left = st["left"].at[parent].set(left_id)
@@ -253,6 +255,7 @@ def grow_tree(
             "left": left,
             "right": right,
             "value": st["value"],
+            "gain": gain_arr,
             "is_cat": is_cat_arr,
             "cat_mask_nodes": cat_nodes,
             "num_nodes": st["num_nodes"] + 2,
@@ -281,6 +284,7 @@ def grow_tree(
         "left": st["left"],
         "right": st["right"],
         "value": value,
+        "gain": st["gain"],
         "is_cat": st["is_cat"],
         "cat_bitset": cat_bitset,
         "max_depth": st["max_depth"],

@@ -94,6 +94,7 @@ def grow_tree_levelwise(
 
     feature = jnp.full((M,), -1, jnp.int32)
     threshold = jnp.zeros((M,), jnp.int32)
+    gain_arr = jnp.zeros((M,), jnp.float32)
     left = jnp.zeros((M,), jnp.int32)
     right = jnp.zeros((M,), jnp.int32)
     is_cat_arr = jnp.zeros((M,), bool)
@@ -116,21 +117,21 @@ def grow_tree_levelwise(
         "slot_depth": slot_depth, "sp_feature": sp_feature,
         "sp_thresh": sp_thresh, "sp_GL": sp_GL, "sp_HL": sp_HL,
         "sp_CL": sp_CL, "sp_catmask": sp_catmask, "hists": hists,
-        "feature": feature, "threshold": threshold, "left": left,
-        "right": right, "is_cat": is_cat_arr, "cat_nodes": cat_nodes,
-        "num_nodes": num_nodes, "splits_done": splits_done,
-        "max_depth": max_depth,
+        "feature": feature, "threshold": threshold, "gain": gain_arr,
+        "left": left, "right": right, "is_cat": is_cat_arr,
+        "cat_nodes": cat_nodes, "num_nodes": num_nodes,
+        "splits_done": splits_done, "max_depth": max_depth,
     }
     def level_body(d, st):
         (row_slot, slot_node, slot_gain, slot_G, slot_H, slot_C, slot_depth,
          sp_feature, sp_thresh, sp_GL, sp_HL, sp_CL, sp_catmask, hists,
-         feature, threshold, left, right, is_cat_arr, cat_nodes,
+         feature, threshold, gain_arr, left, right, is_cat_arr, cat_nodes,
          num_nodes, splits_done, max_depth) = (
             st["row_slot"], st["slot_node"], st["slot_gain"], st["slot_G"],
             st["slot_H"], st["slot_C"], st["slot_depth"], st["sp_feature"],
             st["sp_thresh"], st["sp_GL"], st["sp_HL"], st["sp_CL"],
             st["sp_catmask"], st["hists"], st["feature"], st["threshold"],
-            st["left"], st["right"], st["is_cat"], st["cat_nodes"],
+            st["gain"], st["left"], st["right"], st["is_cat"], st["cat_nodes"],
             st["num_nodes"], st["splits_done"], st["max_depth"])
         at_level = (slot_depth == d) & (slot_gain > NEG_INF) & (slot_node >= 0)
         # gain-descending order, stable => lowest slot id wins ties, exactly
@@ -158,6 +159,8 @@ def grow_tree_levelwise(
 
         pidx = jnp.where(do, parent_node, M)
         feature = feature.at[pidx].set(sf, mode="drop")
+        gain_arr = gain_arr.at[pidx].set(
+            jnp.where(do, slot_gain[sj], 0.0), mode="drop")
         threshold = threshold.at[pidx].set(jnp.where(cat_split, 0, thr), mode="drop")
         left = left.at[pidx].set(left_id, mode="drop")
         right = right.at[pidx].set(right_id, mode="drop")
@@ -194,19 +197,22 @@ def grow_tree_levelwise(
         # Single device, smaller children cover at most half the rows
         # (min(left,right) <= parent/2, parents disjoint) -> half the tile
         # grid.  Under shard_map the smaller child is chosen on GLOBAL
-        # counts and one shard's share of it may exceed half that shard,
-        # so no bound applies there.
+        # counts and one shard's share of it may exceed half that shard, so
+        # no bound applies there; ditto above 2^24 rows, where the fp32
+        # histogram counts backing the smaller-child choice stop being exact.
+        bound_ok = axis_name is None and N < (1 << 24)
         hist_small = build_hist_segmented(
             Xb, g, h, smallsel, P, B,
             rows_per_chunk=p.rows_per_chunk, axis_name=axis_name,
             precision=p.hist_precision, backend=p.hist_backend,
-            rows_bound=(N // 2 + 1) if axis_name is None else None,
+            rows_bound=(N // 2 + 1) if bound_ok else None,
         )
         if p.hist_subtraction:
             hist_large = hists[sj] - hist_small
         else:
             largesel = jnp.full((L + 1,), P, jnp.int32).at[
-                jnp.where(do, large_slot, L)].set(jnp.arange(P, dtype=jnp.int32), mode="drop")
+                jnp.where(do, large_slot, L + 1)].set(
+                    jnp.arange(P, dtype=jnp.int32), mode="drop")
             hist_large = build_hist_multi(
                 Xb, g, h, largesel[jnp.minimum(row_slot, L)], P, B,
                 rows_per_chunk=p.rows_per_chunk, axis_name=axis_name,
@@ -254,9 +260,10 @@ def grow_tree_levelwise(
             "sp_feature": sp_feature, "sp_thresh": sp_thresh, "sp_GL": sp_GL,
             "sp_HL": sp_HL, "sp_CL": sp_CL, "sp_catmask": sp_catmask,
             "hists": hists, "feature": feature, "threshold": threshold,
-            "left": left, "right": right, "is_cat": is_cat_arr,
-            "cat_nodes": cat_nodes, "num_nodes": num_nodes,
-            "splits_done": splits_done, "max_depth": max_depth,
+            "gain": gain_arr, "left": left, "right": right,
+            "is_cat": is_cat_arr, "cat_nodes": cat_nodes,
+            "num_nodes": num_nodes, "splits_done": splits_done,
+            "max_depth": max_depth,
         }
 
     st = jax.lax.fori_loop(0, depth_cap, level_body, st)
@@ -272,6 +279,7 @@ def grow_tree_levelwise(
         "left": st["left"],
         "right": st["right"],
         "value": value,
+        "gain": st["gain"],
         "is_cat": st["is_cat"],
         "cat_bitset": cat_bitset,
         "max_depth": st["max_depth"],

@@ -37,7 +37,7 @@ from dryad_tpu.engine.predict import _accumulate, tree_leaves
 from dryad_tpu.objectives import get_objective
 
 _TREE_KEYS = ("feature", "threshold", "left", "right", "value", "is_cat",
-              "cat_bitset")
+              "cat_bitset", "gain")
 
 
 @partial(jax.jit, static_argnames=("p", "B", "has_cat", "mesh"),
@@ -117,8 +117,26 @@ def _empty_out_device(T: int, M: int, cat_words: int) -> dict:
         "value": jnp.zeros((T, M), jnp.float32),
         "is_cat": jnp.zeros((T, M), bool),
         "cat_bitset": jnp.zeros((T, M, cat_words), jnp.uint32),
+        "gain": jnp.zeros((T, M), jnp.float32),
         "max_depth": jnp.zeros((T,), jnp.int32),
     }
+
+
+def _materialize(p, mapper, out, T, init, max_depth_prev, best_iteration,
+                 best_value=None, stale=0) -> Booster:
+    """Fetch the device tree tables (the one forced sync) into a Booster."""
+    host = {key: np.asarray(out[key][:T]) for key in _TREE_KEYS}
+    depths = np.asarray(out["max_depth"][:T])
+    max_depth_seen = max(int(depths.max(initial=0)), max_depth_prev)
+    return Booster(
+        p, mapper,
+        host["feature"], host["threshold"], host["left"], host["right"],
+        host["value"], host["is_cat"], host["cat_bitset"],
+        init, max_depth_seen,
+        best_iteration=best_iteration,
+        gain=host["gain"],
+        train_state={"best_value": best_value, "stale": int(stale)},
+    )
 
 
 def train_device(
@@ -130,6 +148,7 @@ def train_device(
     init_booster: Optional[Booster] = None,
     callback: Optional[Callable[[int, dict], None]] = None,
     mesh=None,
+    checkpointer=None,
 ) -> Booster:
     """Device trainer.  With ``mesh`` set, rows are sharded over the mesh's
     data axis and histograms allreduced by psum (engine/distributed.py)."""
@@ -230,6 +249,11 @@ def train_device(
         vscore = _accumulate(prev_trees, vXb, jnp.asarray(init),
                              max(max_depth_prev, 1))
     best_iteration, best_value, stale = -1, None, 0
+    if init_booster is not None:
+        # resume continues the eval/early-stop state exactly where it stopped
+        best_iteration = init_booster.best_iteration
+        best_value = init_booster.train_state.get("best_value")
+        stale = init_booster.train_state.get("stale", 0)
 
     # pad rows are bagged out permanently: they must never touch a histogram
     ones_rows = jnp.asarray(np.pad(np.ones((N,), bool), (0, pad)))
@@ -280,18 +304,16 @@ def train_device(
                 stop = True
         if callback is not None:
             callback(it, info)
+        if checkpointer is not None and checkpointer.due(it + 1):
+            checkpointer.save(
+                _materialize(p, data.mapper, out, (it + 1) * K, init,
+                             max_depth_prev, best_iteration, best_value, stale),
+                it + 1,
+            )
         if stop:
             T = (it + 1) * K
             break
 
     # ---- the single end-of-training fetch ------------------------------------
-    host = {key: np.asarray(out[key][:T]) for key in _TREE_KEYS}
-    depths = np.asarray(out["max_depth"][:T])
-    max_depth_seen = max(int(depths.max(initial=0)), max_depth_prev)
-    return Booster(
-        p, data.mapper,
-        host["feature"], host["threshold"], host["left"], host["right"],
-        host["value"], host["is_cat"], host["cat_bitset"],
-        init, max_depth_seen,
-        best_iteration=best_iteration,
-    )
+    return _materialize(p, data.mapper, out, T, init, max_depth_prev,
+                        best_iteration, best_value, stale)

@@ -1,0 +1,118 @@
+"""Checkpoint/resume: crash mid-training, resume, reproduce the
+uninterrupted run bit for bit (SURVEY.md §5 failure recovery)."""
+
+import numpy as np
+import pytest
+
+import dryad_tpu as dryad
+from dryad_tpu.checkpoint import Checkpointer
+from dryad_tpu.datasets import higgs_like
+
+PARAMS = dict(objective="binary", num_trees=12, num_leaves=7, max_bins=32,
+              subsample=0.8, seed=3, min_data_in_leaf=5)
+
+
+@pytest.fixture(scope="module")
+def data():
+    X, y = higgs_like(3000, seed=21)
+    return dryad.Dataset(X, y, max_bins=32)
+
+
+@pytest.mark.parametrize("backend", ["cpu", "tpu"])
+def test_kill_and_resume_bit_identical(tmp_path, data, backend):
+    full = dryad.train(PARAMS, data, backend=backend)
+
+    class Crash(RuntimeError):
+        pass
+
+    def crash_at(it, info):
+        if it == 6:
+            raise Crash
+
+    ckdir = str(tmp_path / backend)
+    with pytest.raises(Crash):
+        dryad.train(PARAMS, data, backend=backend, checkpoint_dir=ckdir,
+                    checkpoint_every=3, callback=crash_at)
+
+    ck = Checkpointer(ckdir)
+    latest = ck.latest()
+    assert latest is not None and latest[1] == 6
+
+    resumed = dryad.train(PARAMS, data, backend=backend, checkpoint_dir=ckdir,
+                          checkpoint_every=3, resume=True)
+    assert resumed.num_iterations == full.num_iterations
+    np.testing.assert_array_equal(full.feature, resumed.feature)
+    np.testing.assert_array_equal(full.threshold, resumed.threshold)
+    np.testing.assert_array_equal(
+        full.predict(np.zeros((4, data.num_features), np.float32)),
+        resumed.predict(np.zeros((4, data.num_features), np.float32)),
+    )
+
+
+@pytest.mark.parametrize("backend", ["cpu", "tpu"])
+def test_resume_with_valid_and_early_stopping(tmp_path, data, backend):
+    """Eval metrics, best_iteration and early-stop state must survive resume."""
+    X, y = higgs_like(1200, seed=22)
+    valid = data.bind(X, y)
+    params = dict(PARAMS, early_stopping_rounds=4)
+
+    infos_full = []
+    full = dryad.train(params, data, [valid], backend=backend,
+                       callback=lambda it, info: infos_full.append(info))
+
+    class Crash(RuntimeError):
+        pass
+
+    def crash_at(it, info):
+        if it == 6:
+            raise Crash
+
+    ckdir = str(tmp_path / backend)
+    with pytest.raises(Crash):
+        dryad.train(params, data, [valid], backend=backend,
+                    checkpoint_dir=ckdir, checkpoint_every=3, callback=crash_at)
+
+    infos_res = []
+    resumed = dryad.train(params, data, [valid], backend=backend,
+                          checkpoint_dir=ckdir, checkpoint_every=3, resume=True,
+                          callback=lambda it, info: infos_res.append(info))
+    assert resumed.num_iterations == full.num_iterations
+    assert resumed.best_iteration == full.best_iteration
+    np.testing.assert_array_equal(full.feature, resumed.feature)
+    # post-resume metric stream matches the uninterrupted run's tail
+    tail = {i["iteration"]: i for i in infos_full if i["iteration"] >= 6}
+    for info in infos_res:
+        ref = tail[info["iteration"]]
+        for k, v in info.items():
+            assert v == pytest.approx(ref[k]), (info["iteration"], k)
+
+
+def test_checkpoint_pruning_and_atomicity(tmp_path, data):
+    ckdir = str(tmp_path / "prune")
+    dryad.train(PARAMS, data, backend="cpu", checkpoint_dir=ckdir,
+                checkpoint_every=2)
+    ck = Checkpointer(ckdir)
+    assert len(ck.iterations()) <= 2          # keep=2 default
+    assert ck.iterations()[-1] == 12
+    # no stray tmp files
+    import os
+
+    assert not [f for f in os.listdir(ckdir) if f.endswith(".tmp")]
+
+
+def test_resume_without_checkpoint_dir_raises(data):
+    with pytest.raises(ValueError, match="checkpoint_dir"):
+        dryad.train(PARAMS, data, resume=True, backend="cpu")
+
+
+def test_gain_survives_roundtrip(tmp_path, data):
+    b = dryad.train(PARAMS, data, backend="cpu")
+    assert (b.gain > 0).any()
+    path = str(tmp_path / "m.dryad")
+    b.save(path)
+    b2 = dryad.Booster.load(path)
+    np.testing.assert_array_equal(b.gain, b2.gain)
+    gi = b.feature_importance("gain")
+    assert gi.shape == (data.num_features,) and gi.sum() > 0
+    si = b.feature_importance("split")
+    assert si.sum() == (b.feature >= 0).sum()

@@ -1,0 +1,70 @@
+"""CLI front end: train/predict/dump round trips (SURVEY.md §5)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from dryad_tpu.__main__ import main
+from dryad_tpu.datasets import criteo_like, higgs_like
+from dryad_tpu.metrics import auc
+
+
+@pytest.fixture()
+def paths(tmp_path):
+    X, y = higgs_like(2000, seed=41)
+    np.save(tmp_path / "X.npy", X)
+    np.save(tmp_path / "y.npy", y)
+    np.save(tmp_path / "Xv.npy", X[:500])
+    np.save(tmp_path / "yv.npy", y[:500])
+    cfg = dict(objective="binary", num_trees=10, num_leaves=7, max_bins=32)
+    (tmp_path / "cfg.json").write_text(json.dumps(cfg))
+    return tmp_path
+
+
+def test_train_predict_dump_roundtrip(paths):
+    model = str(paths / "m.dryad")
+    rc = main([
+        "train", "--config", str(paths / "cfg.json"),
+        "--data", str(paths / "X.npy"), "--label", str(paths / "y.npy"),
+        "--valid", str(paths / "Xv.npy"), "--valid-label", str(paths / "yv.npy"),
+        "--model", model, "--backend", "cpu", "--quiet",
+        "--log-jsonl", str(paths / "log.jsonl"),
+    ])
+    assert rc == 0 and os.path.exists(model)
+    lines = [json.loads(line) for line in open(paths / "log.jsonl")]
+    assert len(lines) == 10 and "valid_auc" in lines[0]
+
+    rc = main(["predict", "--model", model, "--data", str(paths / "X.npy"),
+               "--out", str(paths / "p.npy")])
+    assert rc == 0
+    preds = np.load(paths / "p.npy")
+    y = np.load(paths / "y.npy")
+    assert auc(y, preds) > 0.6
+
+    rc = main(["dump", "--model", model, "--out", str(paths / "m.json")])
+    assert rc == 0
+    dump = json.loads((paths / "m.json").read_text())
+    assert dump["num_iterations"] == 10 and len(dump["trees"]) == 10
+
+
+def test_cli_csr_npz_train_predict(tmp_path):
+    (indptr, indices, values, F), y, cat_ids = criteo_like(n=2000, seed=43)
+    np.savez(tmp_path / "X.npz", indptr=indptr, indices=indices,
+             values=values, num_features=F)
+    np.save(tmp_path / "y.npy", y)
+    cfg = dict(objective="binary", num_trees=8, num_leaves=15, max_bins=64,
+               categorical_features=list(cat_ids))
+    (tmp_path / "cfg.json").write_text(json.dumps(cfg))
+    model = str(tmp_path / "m.dryad")
+    rc = main(["train", "--config", str(tmp_path / "cfg.json"),
+               "--data", str(tmp_path / "X.npz"), "--label",
+               str(tmp_path / "y.npy"), "--model", model,
+               "--backend", "cpu", "--quiet"])
+    assert rc == 0
+    rc = main(["predict", "--model", model, "--data", str(tmp_path / "X.npz"),
+               "--out", str(tmp_path / "p.npy")])
+    assert rc == 0
+    preds = np.load(tmp_path / "p.npy")
+    assert preds.shape == (2000,) and auc(y, preds) > 0.55

@@ -1,0 +1,62 @@
+"""sklearn-style estimator surface."""
+
+import numpy as np
+
+from dryad_tpu.datasets import covertype_like, higgs_like, mslr_like
+from dryad_tpu.metrics import auc, ndcg_at_k
+from dryad_tpu.sklearn import DryadClassifier, DryadRanker, DryadRegressor
+
+FAST = dict(num_trees=20, num_leaves=15, max_bins=64, backend="cpu")
+
+
+def test_classifier_binary():
+    X, y = higgs_like(4000, seed=31)
+    clf = DryadClassifier(**FAST).fit(X[:3000], y[:3000])
+    proba = clf.predict_proba(X[3000:])
+    assert proba.shape == (1000, 2)
+    np.testing.assert_allclose(proba.sum(axis=1), 1.0, rtol=1e-5)
+    assert auc(y[3000:], proba[:, 1]) > 0.62
+    pred = clf.predict(X[3000:])
+    assert set(np.unique(pred)) <= set(clf.classes_)
+    assert clf.feature_importances_.shape == (X.shape[1],)
+
+
+def test_classifier_multiclass_with_label_remap():
+    X, y = covertype_like(4000, seed=33)
+    y_lab = y * 10 + 3                       # non-contiguous labels
+    clf = DryadClassifier(**FAST).fit(X, y_lab)
+    proba = clf.predict_proba(X[:100])
+    assert proba.shape == (100, 7)
+    pred = clf.predict(X[:500])
+    assert set(np.unique(pred)) <= set(np.unique(y_lab))
+    assert (pred == y_lab[:500]).mean() > 0.5
+
+
+def test_regressor_with_eval_set():
+    rng = np.random.default_rng(35)
+    X = rng.normal(size=(3000, 10)).astype(np.float32)
+    y = X[:, 0] * 2 + np.sin(X[:, 1]) + rng.normal(scale=0.1, size=3000)
+    reg = DryadRegressor(early_stopping_rounds=5, **FAST)
+    reg.fit(X[:2500], y[:2500], eval_set=(X[2500:], y[2500:]))
+    pred = reg.predict(X[2500:])
+    mse = float(np.mean((pred - y[2500:]) ** 2))
+    assert mse < np.var(y) * 0.5
+    assert reg.best_iteration_ > 0
+
+
+def test_ranker():
+    X, y, group = mslr_like(num_queries=80, seed=37)
+    rk = DryadRanker(**FAST).fit(X, y, group=group)
+    scores = rk.predict(X)
+    qoff = np.concatenate([[0], np.cumsum(group)])
+    n = ndcg_at_k(y, scores, qoff, 10)
+    base = ndcg_at_k(y, np.zeros_like(scores), qoff, 10)
+    assert n > base
+
+
+def test_get_set_params_roundtrip():
+    clf = DryadClassifier(num_trees=7, learning_rate=0.3)
+    p = clf.get_params()
+    assert p["num_trees"] == 7 and p["learning_rate"] == 0.3
+    clf.set_params(num_trees=9, num_class=3)
+    assert clf.num_trees == 9 and clf.extra_params["num_class"] == 3
