@@ -67,6 +67,8 @@ def cmd_train(args) -> int:
     ds = _make_dataset(args.data, args.label, args.group, params)
     valid_sets = None
     if args.valid:
+        if not args.valid_label:
+            raise SystemExit("--valid requires --valid-label")
         vds = _make_dataset(args.valid, args.valid_label, args.valid_group,
                             params, mapper=ds.mapper)
         valid_sets = [vds]
@@ -79,16 +81,18 @@ def cmd_train(args) -> int:
         logger = JsonlLogger(args.log_jsonl)
         callbacks.append(logger)
 
-    booster = dryad.train(
-        params, ds, valid_sets,
-        backend=args.backend,
-        callbacks=callbacks,
-        checkpoint_dir=args.checkpoint_dir,
-        checkpoint_every=args.checkpoint_every,
-        resume=args.resume,
-    )
-    if logger is not None:
-        logger.close()
+    try:
+        booster = dryad.train(
+            params, ds, valid_sets,
+            backend=args.backend,
+            callbacks=callbacks,
+            checkpoint_dir=args.checkpoint_dir,
+            checkpoint_every=args.checkpoint_every,
+            resume=args.resume,
+        )
+    finally:
+        if logger is not None:
+            logger.close()
     if args.model:
         booster.save(args.model)
         if not args.quiet:

@@ -255,6 +255,12 @@ def train_cpu(
 
     all_rows = np.arange(N, dtype=np.int64)
     for it in range(start_iter, T // K):
+        # resuming from a checkpoint taken at the early-stop boundary must
+        # not grow past it (the restored stale counter already says stop)
+        if (valid is not None and p.early_stopping_rounds
+                and stale >= p.early_stopping_rounds):
+            T = it * K
+            break
         if p.objective == "lambdarank":
             grads, hess = obj.grad_hess_np(score[:, 0], y, data.weight, query_offsets=qoff)
             grads, hess = grads[:, None], hess[:, None]

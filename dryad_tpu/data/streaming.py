@@ -1,0 +1,107 @@
+"""Out-of-core ingest: build a Dataset from row chunks without ever holding
+the raw float table in memory (SURVEY.md §7 hard part e — Criteo-1TB).
+
+Two passes over the chunk stream:
+
+1. **Sketch pass** — a deterministic subsample keyed on the global row id
+   (stateless splitmix64 hash, see ``_keyed_uniform``) feeds the canonical
+   sketch.  The kept set depends only on (seed, global row id), never on
+   chunk boundaries, so re-chunking (or sharding across hosts —
+   distributed.sketch_distributed uses the same keying) cannot change the
+   frozen edges.
+2. **Bin pass** — each chunk is binned through the frozen mapper straight
+   into the preallocated uint8/uint16 matrix (4-8x smaller than the floats).
+
+The binned matrix for Criteo-scale data is what must fit: 1e9 rows x 39
+features x 1 byte = 39 GB across a pod — per-host slices of it are what
+``distributed.host_row_range`` hands each worker.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterable, Optional, Sequence
+
+import numpy as np
+
+from dryad_tpu.data.sketch import BinMapper, sketch_features
+
+
+def _keyed_uniform(row_offset: int, n: int, seed: int) -> np.ndarray:
+    """uniform(0,1) per row, a pure function of (seed, global row id).
+
+    Stateless splitmix64 finalizer — unlike a streamed PRNG there is no
+    block structure, so any partitioning of the row range reproduces exactly
+    the same per-row draws (the chunking/sharding invariance the sketch
+    contract needs).
+    """
+    r = np.arange(row_offset, row_offset + n, dtype=np.uint64)
+    z = r + np.uint64(seed) * np.uint64(0x9E3779B97F4A7C15) + np.uint64(
+        0x9E3779B97F4A7C15)
+    z = (z ^ (z >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+    z = (z ^ (z >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+    z = z ^ (z >> np.uint64(31))
+    return (z >> np.uint64(11)).astype(np.float64) * (1.0 / (1 << 53))
+
+
+def sketch_stream(
+    chunks: Callable[[], Iterable[np.ndarray]],
+    total_rows: int,
+    *,
+    max_bins: int = 256,
+    categorical_features: Sequence[int] = (),
+    sample_rows: int = 1 << 20,
+    seed: int = 0,
+) -> BinMapper:
+    """Frozen BinMapper from one streaming pass (deterministic subsample)."""
+    rate = min(1.0, sample_rows / max(total_rows, 1))
+    parts: list[np.ndarray] = []
+    offset = 0
+    for chunk in chunks():
+        chunk = np.asarray(chunk, np.float32)
+        keep = _keyed_uniform(offset, chunk.shape[0], seed) < rate
+        parts.append(np.ascontiguousarray(chunk[keep]))
+        offset += chunk.shape[0]
+    if offset != total_rows:
+        raise ValueError(f"stream yielded {offset} rows, expected {total_rows}")
+    sample = np.concatenate(parts, axis=0)
+    return sketch_features(sample, max_bins=max_bins,
+                           categorical_features=categorical_features)
+
+
+def dataset_from_chunks(
+    chunks: Callable[[], Iterable[np.ndarray]],
+    y: np.ndarray,
+    total_rows: int,
+    num_features: int,
+    *,
+    weight: Optional[np.ndarray] = None,
+    group: Optional[np.ndarray] = None,
+    categorical_features: Sequence[int] = (),
+    max_bins: int = 256,
+    mapper: Optional[BinMapper] = None,
+    sample_rows: int = 1 << 20,
+    seed: int = 0,
+):
+    """Out-of-core Dataset: ``chunks`` is a restartable factory of row-chunk
+    iterables (called twice: sketch pass, bin pass)."""
+    from dryad_tpu.dataset import Dataset
+
+    if mapper is None:
+        mapper = sketch_stream(
+            chunks, total_rows, max_bins=max_bins,
+            categorical_features=categorical_features,
+            sample_rows=sample_rows, seed=seed,
+        )
+    Xb = np.empty((total_rows, num_features), mapper.bin_dtype)
+    offset = 0
+    for chunk in chunks():
+        chunk = np.asarray(chunk, np.float32)
+        Xb[offset : offset + chunk.shape[0]] = mapper.transform(chunk)
+        offset += chunk.shape[0]
+    if offset != total_rows:
+        raise ValueError(f"stream yielded {offset} rows, expected {total_rows}")
+
+    return Dataset.from_binned(
+        Xb, mapper, y, weight=weight, group=group,
+        categorical_features=categorical_features,
+    )

@@ -64,6 +64,37 @@ class Dataset:
         if self.group is not None and int(self.group.sum()) != self.num_rows:
             raise ValueError("group sizes must sum to num_rows")
 
+    @classmethod
+    def from_binned(
+        cls,
+        X_binned: np.ndarray,
+        mapper: BinMapper,
+        y: Optional[np.ndarray] = None,
+        *,
+        weight: Optional[np.ndarray] = None,
+        group: Optional[np.ndarray] = None,
+        categorical_features: Sequence[int] = (),
+    ) -> "Dataset":
+        """Dataset over an already-binned matrix (streaming/out-of-core
+        ingest) — runs the same label/weight/group validation as __init__."""
+        ds = cls.__new__(cls)
+        ds.categorical_features = tuple(int(c) for c in categorical_features)
+        ds.mapper = mapper
+        ds.X_binned = np.ascontiguousarray(X_binned, mapper.bin_dtype)
+        ds.num_rows, ds.num_features = ds.X_binned.shape
+        ds.y = None if y is None else np.ascontiguousarray(y, np.float32)
+        if ds.y is not None and ds.y.shape[0] != ds.num_rows:
+            raise ValueError("y length mismatch")
+        ds.weight = None if weight is None else np.ascontiguousarray(weight, np.float32)
+        if ds.weight is not None and ds.weight.shape[0] != ds.num_rows:
+            raise ValueError(
+                f"weight length {ds.weight.shape[0]} != num_rows {ds.num_rows}"
+            )
+        ds.group = None if group is None else np.ascontiguousarray(group, np.int64)
+        if ds.group is not None and int(ds.group.sum()) != ds.num_rows:
+            raise ValueError("group sizes must sum to num_rows")
+        return ds
+
     def bind(self, X: np.ndarray, y: Optional[np.ndarray] = None, **kw) -> "Dataset":
         """Bin new data (validation/test) through this dataset's frozen mapper."""
         return Dataset(X, y, mapper=self.mapper, categorical_features=self.categorical_features, **kw)

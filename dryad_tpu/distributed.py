@@ -1,0 +1,148 @@
+"""Multi-host distributed entry points (SURVEY.md §3 "Distributed init";
+BASELINE.json:11 — Criteo-1TB on v5p-64).
+
+The reference's NCCL world is replaced by JAX's runtime: every host runs the
+same program, ``initialize()`` wires the cluster (coordinator + process
+ids), and a global ``Mesh`` over all devices carries the row-sharded
+training state.  The per-split histogram allreduce rides
+``jax.lax.psum`` over ICI within a slice and DCN across hosts — the mesh
+abstracts both links, nothing in the engine changes between single-chip,
+single-host-multi-chip, and multi-host.
+
+Determinism contract for the sketch: every worker must bin through
+IDENTICAL edges.  ``sketch_distributed`` computes the sketch from a
+deterministic per-host sample union (allgathered), so all hosts derive the
+same BinMapper without any host seeing the full data — the Criteo-1TB
+ingest pattern (each host reads only its row range).
+
+Single-process testing: ``XLA_FLAGS=--xla_force_host_platform_device_count=8``
+gives an 8-device CPU mesh; the exact code paths here then run in CI
+(tests/test_multihost.py), per SURVEY.md §4.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Optional, Sequence
+
+import numpy as np
+
+from dryad_tpu.config import Params, make_params
+from dryad_tpu.dataset import Dataset
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None) -> None:
+    """Wire this host into the cluster (NCCL-init equivalent).
+
+    On TPU pods, all arguments auto-detect from the environment; pass them
+    explicitly for manual clusters.  Call once, before any jax use.
+    """
+    import jax
+
+    kw = {}
+    if coordinator_address is not None:
+        kw["coordinator_address"] = coordinator_address
+    if num_processes is not None:
+        kw["num_processes"] = num_processes
+    if process_id is not None:
+        kw["process_id"] = process_id
+    jax.distributed.initialize(**kw)
+
+
+def global_mesh(axis: str = "data"):
+    """One mesh over every device in the cluster (all hosts)."""
+    import jax
+    from jax.sharding import Mesh
+
+    return Mesh(np.asarray(jax.devices()), (axis,))
+
+
+def host_row_range(num_rows: int) -> tuple[int, int]:
+    """[start, stop) row range this host should ingest — contiguous blocks
+    in process order, balanced to within one row."""
+    import jax
+
+    p, n = jax.process_index(), jax.process_count()
+    base, rem = divmod(num_rows, n)
+    start = p * base + min(p, rem)
+    return start, start + base + (1 if p < rem else 0)
+
+
+def sketch_distributed(
+    X_local: np.ndarray,
+    total_rows: int,
+    row_offset: int,
+    *,
+    max_bins: int = 256,
+    categorical_features: Sequence[int] = (),
+    sample_rows: int = 1 << 20,
+    seed: int = 0,
+    allgather=None,
+):
+    """Identical BinMapper on every host from row-sharded data.
+
+    Each host keeps the rows whose global-row-id-keyed draw (stateless
+    splitmix64 hash — data/streaming.py::_keyed_uniform) falls under
+    ``sample_rows / total_rows``, allgathers the (small) samples, and
+    sketches the union — deterministic in the partitioning, so every host
+    freezes the same edges (the bit-identity requirement, BASELINE.json:5).
+
+    ``allgather(arr) -> list[arr]`` exchanges host arrays; default uses
+    ``jax.experimental.multihost_utils`` (single-process: identity).
+    """
+    from dryad_tpu.data.sketch import sketch_features
+
+    n = X_local.shape[0]
+    rate = min(1.0, sample_rows / max(total_rows, 1))
+    keep = _global_row_uniform(row_offset, n, seed) < rate
+    local_sample = np.ascontiguousarray(X_local[keep], np.float32)
+
+    if allgather is None:
+        allgather = _default_allgather
+    parts = allgather(local_sample)
+    sample = np.concatenate(parts, axis=0)
+    return sketch_features(sample, max_bins=max_bins,
+                           categorical_features=categorical_features)
+
+
+def _global_row_uniform(row_offset: int, n: int, seed: int) -> np.ndarray:
+    """uniform(0,1) per row, a pure function of (seed, global row id)."""
+    from dryad_tpu.data.streaming import _keyed_uniform
+
+    return _keyed_uniform(row_offset, n, seed)
+
+
+def _default_allgather(arr: np.ndarray) -> list[np.ndarray]:
+    import jax
+
+    if jax.process_count() == 1:
+        return [arr]
+    from jax.experimental import multihost_utils
+
+    # pad to the max local length so process_allgather gets uniform shapes
+    n = np.int64(arr.shape[0])
+    ns = multihost_utils.process_allgather(n)
+    m = int(ns.max())
+    pad = np.zeros((m - arr.shape[0],) + arr.shape[1:], arr.dtype)
+    stacked = multihost_utils.process_allgather(
+        np.concatenate([arr, pad], axis=0))
+    return [stacked[i, : int(ns[i])] for i in range(stacked.shape[0])]
+
+
+def train_distributed(
+    params: "Params | dict | None",
+    data: Dataset,
+    valid: Optional[Dataset] = None,
+    *,
+    mesh=None,
+    **kw,
+):
+    """``dryad.train`` over a (multi-host) mesh: rows sharded, histograms
+    psum'd — the NCCL data-parallel mode (SURVEY.md §2 #13-14)."""
+    from dryad_tpu.engine.train import train_device
+
+    p = make_params(params)
+    if mesh is None:
+        mesh = global_mesh()
+    return train_device(p, data, valid, mesh=mesh, **kw)

@@ -263,6 +263,12 @@ def train_device(
 
     # ---- boosting loop: async dispatch, zero per-iteration syncs -------------
     for it in range(start_iter, T // K):
+        # a checkpoint taken AT the early-stop boundary restores stale >=
+        # rounds; growing anything past it would diverge from the stopped run
+        if (valid is not None and p.early_stopping_rounds
+                and stale >= p.early_stopping_rounds):
+            T = it * K
+            break
         row_mask_np, feat_mask_np = sample_masks(p, it, N, F)
         if row_mask_np is None:
             bag = ones_rows

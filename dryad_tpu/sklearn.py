@@ -141,8 +141,10 @@ class DryadClassifier(_DryadModel):
         y = np.asarray(y)
         self.classes_ = np.unique(y)
         n_class = self.classes_.size
+        if n_class < 2:
+            raise ValueError("DryadClassifier needs at least 2 classes in y")
         y_enc = np.searchsorted(self.classes_, y).astype(np.float32)
-        if n_class <= 2:
+        if n_class == 2:
             self._objective = "binary"
             over = {}
         else:
@@ -150,7 +152,13 @@ class DryadClassifier(_DryadModel):
             over = {"num_class": n_class}
         if eval_set is not None:
             Xv, yv = eval_set[0] if isinstance(eval_set, list) else eval_set
-            yv = np.searchsorted(self.classes_, np.asarray(yv)).astype(np.float32)
+            yv = np.asarray(yv)
+            unknown = np.setdiff1d(np.unique(yv), self.classes_)
+            if unknown.size:
+                raise ValueError(
+                    f"eval_set labels {unknown.tolist()} never appear in the "
+                    "training labels")
+            yv = np.searchsorted(self.classes_, yv).astype(np.float32)
             eval_set = (Xv, yv)
         return self._fit(X, y_enc, sample_weight=sample_weight,
                          eval_set=eval_set, **over)

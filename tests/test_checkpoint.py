@@ -87,6 +87,26 @@ def test_resume_with_valid_and_early_stopping(tmp_path, data, backend):
             assert v == pytest.approx(ref[k]), (info["iteration"], k)
 
 
+@pytest.mark.parametrize("backend", ["cpu", "tpu"])
+def test_resume_from_early_stop_boundary_grows_nothing(tmp_path, data, backend):
+    """A checkpoint taken at the early-stop iteration must resume to the
+    exact same booster — not train past the stop."""
+    X, y = higgs_like(1200, seed=23)
+    valid = data.bind(X, y)
+    params = dict(PARAMS, early_stopping_rounds=2, num_trees=40,
+                  learning_rate=1.5)  # aggressive lr -> overfits -> stops early
+    ckdir = str(tmp_path / backend)
+    stopped = dryad.train(params, data, [valid], backend=backend,
+                          checkpoint_dir=ckdir, checkpoint_every=1)
+    assert stopped.num_iterations < 40, "early stopping never fired"
+
+    resumed = dryad.train(params, data, [valid], backend=backend,
+                          checkpoint_dir=ckdir, checkpoint_every=1, resume=True)
+    assert resumed.num_iterations == stopped.num_iterations
+    assert resumed.best_iteration == stopped.best_iteration
+    np.testing.assert_array_equal(stopped.feature, resumed.feature)
+
+
 def test_checkpoint_pruning_and_atomicity(tmp_path, data):
     ckdir = str(tmp_path / "prune")
     dryad.train(PARAMS, data, backend="cpu", checkpoint_dir=ckdir,

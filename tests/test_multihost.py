@@ -1,0 +1,81 @@
+"""Multi-host entry points on the 8-fake-device CPU mesh (SURVEY.md §4:
+the same shard_map/psum code paths run in CI with no TPU)."""
+
+import numpy as np
+
+import dryad_tpu as dryad
+from dryad_tpu.datasets import higgs_like
+from dryad_tpu.distributed import (
+    global_mesh,
+    host_row_range,
+    sketch_distributed,
+    train_distributed,
+)
+from dryad_tpu.data.streaming import dataset_from_chunks, sketch_stream
+
+
+def test_global_mesh_spans_all_devices():
+    import jax
+
+    mesh = global_mesh()
+    assert mesh.devices.size == len(jax.devices()) == 8
+
+
+def test_host_row_range_partitions_exactly():
+    start, stop = host_row_range(1003)
+    assert (start, stop) == (0, 1003)  # single process owns everything
+
+
+def test_train_distributed_matches_single_device():
+    X, y = higgs_like(2048, seed=61)
+    ds = dryad.Dataset(X, y, max_bins=32)
+    p = dict(objective="binary", num_trees=4, num_leaves=7, max_bins=32)
+    b_mesh = train_distributed(p, ds)
+    b_one = dryad.train(p, ds, backend="tpu")
+    np.testing.assert_array_equal(b_mesh.feature, b_one.feature)
+    np.testing.assert_array_equal(b_mesh.threshold, b_one.threshold)
+    np.testing.assert_allclose(b_mesh.value, b_one.value, atol=1e-3)
+
+
+def test_sketch_distributed_invariant_to_partitioning():
+    X, _ = higgs_like(5000, seed=63)
+    # one "host" with everything vs simulated two-host partition exchanging
+    # samples through a fake allgather
+    m_all = sketch_distributed(X, 5000, 0, max_bins=32, sample_rows=1024)
+
+    # emulate: collect both hosts' samples, then sketch the union per host
+    samples = {}
+    for who, (lo, hi) in enumerate([(0, 2600), (2600, 5000)]):
+        from dryad_tpu.distributed import _global_row_uniform
+
+        keep = _global_row_uniform(lo, hi - lo, 0) < 1024 / 5000
+        samples[who] = X[lo:hi][keep]
+    union = [samples[0], samples[1]]
+    m_two = sketch_distributed(
+        X[0:2600], 5000, 0, max_bins=32, sample_rows=1024,
+        allgather=lambda arr: union,
+    )
+    for fa, fb in zip(m_all.features, m_two.features):
+        np.testing.assert_array_equal(fa.edges, fb.edges)
+
+
+def test_streaming_dataset_matches_in_memory_bins():
+    X, y = higgs_like(3000, seed=65)
+
+    def chunks():
+        for s in range(0, 3000, 700):
+            yield X[s : s + 700]
+
+    ds_stream = dataset_from_chunks(chunks, y, 3000, X.shape[1], max_bins=32)
+    # binning through the SAME mapper must equal the in-memory transform
+    np.testing.assert_array_equal(
+        ds_stream.X_binned, ds_stream.mapper.transform(X))
+    # sketch is chunking-invariant
+    m2 = sketch_stream(lambda: (X[s:s + 1100] for s in range(0, 3000, 1100)),
+                       3000, max_bins=32)
+    for fa, fb in zip(ds_stream.mapper.features, m2.features):
+        np.testing.assert_array_equal(fa.edges, fb.edges)
+    # and trains
+    b = dryad.train(dict(objective="binary", num_trees=3, num_leaves=7,
+                         max_bins=32), ds_stream, backend="cpu")
+    assert b.num_iterations == 3
