@@ -39,6 +39,9 @@ _PARAM_ALIASES = {
     "application": "objective",
     "grow_policy": "growth",
     "num_classes": "num_class",
+    "boosting_type": "boosting",
+    "top_rate": "goss_top_rate",
+    "other_rate": "goss_other_rate",
 }
 
 _OBJECTIVE_ALIASES = {
@@ -80,10 +83,20 @@ class Params:
     min_data_in_leaf: int = 20
     min_split_gain: float = 0.0
     growth: str = "leafwise"
+    # gbdt: plain boosting (+ optional bagging). goss: gradient-based
+    # one-side sampling — keep the goss_top_rate fraction with the largest
+    # |grad|, Bernoulli-sample goss_other_rate of the rest and amplify their
+    # grad/hess by (1-top)/other to stay unbiased.
+    boosting: str = "gbdt"
+    goss_top_rate: float = 0.2
+    goss_other_rate: float = 0.1
     subsample: float = 1.0
     colsample: float = 1.0
     seed: int = 0
     categorical_features: tuple[int, ...] = ()
+    # per-feature -1/0/+1; () = unconstrained. Split-level enforcement: a +1
+    # feature may only split where right-child value >= left-child value.
+    monotone_constraints: tuple[int, ...] = ()
     # evaluation / early stopping
     metric: str = ""              # "" = objective default
     early_stopping_rounds: int = 0  # 0 = disabled
@@ -131,6 +144,17 @@ class Params:
             raise ValueError("categorical splits support max_bins <= 256 (bitset width)")
         if self.min_data_in_leaf < 1:
             raise ValueError("min_data_in_leaf must be >= 1")
+        if any(m not in (-1, 0, 1) for m in self.monotone_constraints):
+            raise ValueError("monotone_constraints entries must be -1, 0 or +1")
+        if self.boosting not in ("gbdt", "goss"):
+            raise ValueError("boosting must be 'gbdt' or 'goss'")
+        if self.boosting == "goss":
+            if not (0 < self.goss_top_rate < 1) or not (0 < self.goss_other_rate < 1):
+                raise ValueError("goss rates must be in (0, 1)")
+            if self.goss_top_rate + self.goss_other_rate > 1:
+                raise ValueError("goss_top_rate + goss_other_rate must be <= 1")
+            if self.subsample < 1.0:
+                raise ValueError("goss replaces bagging; set subsample=1.0")
         if self.num_leaves < 2:
             raise ValueError("num_leaves must be >= 2")
         if self.num_trees < 1:
@@ -159,7 +183,7 @@ class Params:
                 value = _OBJECTIVE_ALIASES.get(value, value)
             if key == "growth" and isinstance(value, str):
                 value = _GROWTH_ALIASES.get(value, value)
-            if key == "categorical_features" and isinstance(value, Sequence):
+            if key in ("categorical_features", "monotone_constraints") and isinstance(value, Sequence):
                 value = tuple(int(v) for v in value)
             if key not in known:
                 raise ValueError(f"unknown parameter {key!r}")

@@ -79,6 +79,7 @@ def find_best_split(
     feature_mask: np.ndarray | None = None,
     is_categorical: np.ndarray | None = None,
     cat_smooth: float = 10.0,
+    monotone: np.ndarray | None = None,
 ) -> SplitInfo | None:
     """Best (feature, threshold) over the histogram; None when nothing valid.
 
@@ -116,6 +117,14 @@ def find_best_split(
     )
     if feature_mask is not None:
         valid &= feature_mask[:, None]
+    if monotone is not None:
+        # split-level monotone enforcement: a +1 (-1) feature may only split
+        # where the right child's Newton value is >= (<=) the left's;
+        # unconstrained (0) features pass regardless of NaN child values
+        with np.errstate(invalid="ignore", divide="ignore"):
+            vl = -GL / (HL + lambda_l2)
+            vr = -GR / (HR + lambda_l2)
+            valid &= (monotone[:, None] == 0) | (monotone[:, None] * (vr - vl) >= 0)
     with np.errstate(invalid="ignore", divide="ignore"):
         gain = 0.5 * (GL * GL / (HL + lambda_l2) + GR * GR / (HR + lambda_l2) - parent_score)
     gain = np.where(valid, gain, NEG_INF)

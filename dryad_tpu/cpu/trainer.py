@@ -33,6 +33,39 @@ from dryad_tpu.dataset import Dataset
 from dryad_tpu.objectives import get_objective
 
 
+def goss_uniform(params: Params, iteration: int, num_rows: int) -> np.ndarray:
+    """Per-iteration uniform draws for the GOSS Bernoulli pick — host Philox,
+    shared verbatim by both backends (like the bagging masks)."""
+    rng = np.random.Generator(
+        np.random.Philox(key=params.seed ^ 0x5A17ED, counter=iteration))
+    return rng.random(num_rows).astype(np.float32)
+
+
+def goss_select_np(params: Params, g_all: np.ndarray, u: np.ndarray):
+    """Canonical GOSS selection -> (mask, weight).
+
+    Keep every row whose gradient magnitude reaches the top_rate quantile
+    (ties included — deterministic), Bernoulli-pick the rest with the shared
+    uniforms, amplify picked rows by (1-top)/other so histogram sums stay
+    unbiased (the GOSS estimator).
+    """
+    p = params
+    # f32 throughout — bit-matches the device selection (_goss_jit) so
+    # boundary rows classify identically on both backends
+    absg = np.sqrt((g_all.astype(np.float32) ** 2).sum(axis=1, dtype=np.float32))
+    N = absg.shape[0]
+    top_n = max(1, int(round(p.goss_top_rate * N)))
+    thr = np.sort(absg)[N - top_n]
+    is_top = absg >= thr
+    n_top = int(is_top.sum())
+    p_pick = min(np.float32(1.0),
+                 np.float32(p.goss_other_rate * N) / np.float32(max(N - n_top, 1)))
+    picked = (~is_top) & (u < p_pick)
+    amp = (1.0 - p.goss_top_rate) / p.goss_other_rate
+    weight = np.where(picked, amp, 1.0)
+    return is_top | picked, weight
+
+
 def sample_masks(params: Params, iteration: int, num_rows: int, num_features: int):
     """Host-side deterministic bagging/colsample masks, shared by both backends."""
     row_mask = None
@@ -172,6 +205,13 @@ class _TreeGrower:
     def _best(self, hist, G, H, C, depth, max_depth, feat_mask):
         if depth >= max_depth or C < 2 * self.p.min_data_in_leaf:
             return None
+        mono = None
+        if self.p.monotone_constraints:
+            # pad/truncate to F (same policy as the device _monotone_array)
+            F = self.Xb.shape[1]
+            mono = np.zeros(F, np.float64)
+            k = min(F, len(self.p.monotone_constraints))
+            mono[:k] = self.p.monotone_constraints[:k]
         return find_best_split(
             hist, G, H, C,
             lambda_l2=self.p.lambda_l2,
@@ -180,6 +220,7 @@ class _TreeGrower:
             min_split_gain=self.p.min_split_gain,
             feature_mask=feat_mask,
             is_categorical=self.is_cat_feat,
+            monotone=mono,
         )
 
 
@@ -272,6 +313,11 @@ def train_cpu(
 
         row_mask, feat_mask = sample_masks(p, it, N, F)
         rows = all_rows if row_mask is None else all_rows[row_mask]
+        if p.boosting == "goss":
+            mask, w = goss_select_np(p, grads, goss_uniform(p, it, N))
+            grads = grads * w[:, None]
+            hess = hess * w[:, None]
+            rows = all_rows[mask]
         for k in range(K):
             t = it * K + k
             d = grower.grow(grads[:, k], hess[:, k], rows, feat_mask, out, t)

@@ -51,6 +51,11 @@ class Dataset:
             self.X_binned = bin_matrix(X, mapper)
 
         self.num_rows, self.num_features = self.X_binned.shape
+        self._attach_targets(y, weight, group)
+
+    def _attach_targets(self, y, weight, group) -> None:
+        """Validate + store labels/weights/query groups (shared by __init__
+        and the from_binned factory so the checks can never drift)."""
         self.y = None if y is None else np.ascontiguousarray(y, np.float32)
         if self.y is not None and self.y.shape[0] != self.num_rows:
             raise ValueError("y length mismatch")
@@ -82,17 +87,7 @@ class Dataset:
         ds.mapper = mapper
         ds.X_binned = np.ascontiguousarray(X_binned, mapper.bin_dtype)
         ds.num_rows, ds.num_features = ds.X_binned.shape
-        ds.y = None if y is None else np.ascontiguousarray(y, np.float32)
-        if ds.y is not None and ds.y.shape[0] != ds.num_rows:
-            raise ValueError("y length mismatch")
-        ds.weight = None if weight is None else np.ascontiguousarray(weight, np.float32)
-        if ds.weight is not None and ds.weight.shape[0] != ds.num_rows:
-            raise ValueError(
-                f"weight length {ds.weight.shape[0]} != num_rows {ds.num_rows}"
-            )
-        ds.group = None if group is None else np.ascontiguousarray(group, np.int64)
-        if ds.group is not None and int(ds.group.sum()) != ds.num_rows:
-            raise ValueError("group sizes must sum to num_rows")
+        ds._attach_targets(y, weight, group)
         return ds
 
     def bind(self, X: np.ndarray, y: Optional[np.ndarray] = None, **kw) -> "Dataset":

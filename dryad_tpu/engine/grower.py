@@ -64,6 +64,16 @@ def grow_any(params, total_bins, Xb, g, h, bag_mask, feat_mask, is_cat_feat,
     )
 
 
+def _monotone_array(p: Params, F: int):
+    """(F,) int32 constraint array, or None when unconstrained (static)."""
+    if not p.monotone_constraints or not any(p.monotone_constraints):
+        return None
+    mono = [0] * F
+    for i, m in enumerate(p.monotone_constraints[:F]):
+        mono[i] = int(m)
+    return jnp.asarray(mono, jnp.int32)
+
+
 def root_stats(hist0: jnp.ndarray):
     """Canonical leaf totals = feature-0 histogram sums (cpu/trainer.py
     contract) — shared by both growers so the derivation can never diverge."""
@@ -115,6 +125,8 @@ def grow_tree(
     depth_cap = p.max_depth if p.max_depth > 0 else L
     depthwise = p.growth == "depthwise"
 
+    mono = _monotone_array(p, F)
+
     def best(hist, G, H, C, depth):
         allow = (depth < depth_cap) & (C >= 2 * p.min_data_in_leaf)
         return find_best_split(
@@ -127,6 +139,7 @@ def grow_tree(
             is_cat_feat=is_cat_feat,
             allow=allow,
             has_cat=has_cat,
+            monotone=mono,
         )
 
     def hist_of(mask):

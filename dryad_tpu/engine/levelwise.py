@@ -55,6 +55,10 @@ def grow_tree_levelwise(
     depth_cap = p.max_depth
     assert depth_cap > 0, "levelwise growth requires max_depth > 0"
 
+    from dryad_tpu.engine.grower import _monotone_array
+
+    mono = _monotone_array(p, F)
+
     def best(hist, G, H, C, allow):
         return find_best_split(
             hist, G, H, C,
@@ -66,6 +70,7 @@ def grow_tree_levelwise(
             is_cat_feat=is_cat_feat,
             allow=allow,
             has_cat=has_cat,
+            monotone=mono,
         )
 
     # ---- root (shared canonical construction) --------------------------------

@@ -47,6 +47,7 @@ def find_best_split(
     is_cat_feat: jnp.ndarray,    # (F,) bool
     allow: jnp.ndarray,          # scalar bool: depth/min-data pre-check
     has_cat: bool = True,        # static: skip the sorted-subset machinery
+    monotone: jnp.ndarray | None = None,  # (F,) int32 in {-1, 0, +1}
 ) -> SplitResult:
     hg, hh, hc = hist[0], hist[1], hist[2]
     F, B = hg.shape
@@ -75,6 +76,13 @@ def find_best_split(
         & (HR >= min_child_weight)
         & feat_mask[:, None]
     )
+    if monotone is not None:
+        # split-level monotone enforcement (mirrors cpu/histogram.py);
+        # unconstrained (0) features pass regardless of NaN child values
+        vl = -GL / (HL + lambda_l2)
+        vr = -GR / (HR + lambda_l2)
+        mcol = monotone.astype(jnp.float32)[:, None]
+        valid &= (mcol == 0) | (mcol * (vr - vl) >= 0)
     parent_score = G * G / (H + lambda_l2)
     gain = 0.5 * (GL * GL / (HL + lambda_l2) + GR * GR / (HR + lambda_l2) - parent_score)
     gain = jnp.where(valid, gain, NEG_INF)

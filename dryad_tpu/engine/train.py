@@ -30,7 +30,7 @@ import numpy as np
 
 from dryad_tpu.booster import CAT_WORDS, Booster
 from dryad_tpu.config import Params
-from dryad_tpu.cpu.trainer import sample_masks
+from dryad_tpu.cpu.trainer import goss_uniform, sample_masks
 from dryad_tpu.dataset import Dataset
 from dryad_tpu.engine.grower import grow_any
 from dryad_tpu.engine.predict import _accumulate, tree_leaves
@@ -63,7 +63,11 @@ def _step_jit(p, B, has_cat, mesh, out, score, Xb, g_all, h_all, bag, fmask,
     else:
         tree = grow_any(p, B, Xb, g, h, bag, fmask, is_cat_feat,
                         has_cat=has_cat)
-        leaves = tree_leaves(tree, Xb, tree["max_depth"])
+        # a static depth bound keeps the traversal a fori_loop (a traced
+        # bound lowers to a slower while_loop); depthwise growth has one
+        depth_bound = (p.max_depth if p.growth == "depthwise" and p.max_depth > 0
+                       else tree["max_depth"])
+        leaves = tree_leaves(tree, Xb, depth_bound)
     col = jnp.take(score, k, axis=1) + tree["value"][leaves]
     score = jax.lax.dynamic_update_index_in_dim(score, col, k, axis=1)
     for key in _TREE_KEYS:
@@ -99,6 +103,28 @@ def _grads_jit(p, N, K, pad, score, y, weight, qoff, rank_row_ids,
         return obj.grad_hess_jax(score, y, weight)
     g, h = obj.grad_hess_jax(score[:, 0], y, weight)
     return g[:, None], h[:, None]
+
+
+@partial(jax.jit, static_argnames=("p", "N"))
+def _goss_jit(p, N, g_all, h_all, u, valid):
+    """Device GOSS (mirrors cpu/trainer.py::goss_select_np — both run the
+    selection in f32 so boundary rows classify identically): amplified
+    grad/hess + the row mask.  ``valid`` excludes padded rows, whose real
+    gradients must never compete in the top-quantile."""
+    absg = jnp.sqrt(jnp.sum(g_all.astype(jnp.float32) ** 2, axis=1))
+    absg = jnp.where(valid, absg, jnp.float32(-1.0))
+    top_n = max(1, int(round(p.goss_top_rate * N)))
+    thr = jnp.sort(absg)[absg.shape[0] - top_n]
+    is_top = valid & (absg >= thr)
+    n_top = jnp.sum(is_top.astype(jnp.int32))
+    p_pick = jnp.minimum(
+        jnp.float32(1.0),
+        jnp.float32(p.goss_other_rate * N)
+        / jnp.maximum(N - n_top, 1).astype(jnp.float32))
+    picked = valid & ~is_top & (u < p_pick)
+    amp = jnp.float32((1.0 - p.goss_top_rate) / p.goss_other_rate)
+    w = jnp.where(picked, amp, jnp.float32(1.0))[:, None]
+    return g_all * w, h_all * w, is_top | picked
 
 
 @jax.jit
@@ -280,6 +306,13 @@ def train_device(
         fmask = ones_feat if feat_mask_np is None else jnp.asarray(feat_mask_np)
 
         g_all, h_all = grads(score)
+        if p.boosting == "goss":
+            u_np = np.pad(goss_uniform(p, it, N), (0, pad), constant_values=2.0)
+            u = jnp.asarray(u_np)
+            if mesh is not None:
+                u = shard_rows(mesh, u)[0]
+            g_all, h_all, goss_mask = _goss_jit(p_key, N, g_all, h_all, u, bag)
+            bag = goss_mask
         for k in range(K):
             t = it * K + k
             out, score = step(out, score, g_all, h_all, bag, fmask, t, k)

@@ -55,9 +55,24 @@ def accuracy(y_true: np.ndarray, y_prob: np.ndarray) -> float:
     return float((pred == y).mean())
 
 
+def error_rate(y_true: np.ndarray, y_prob: np.ndarray) -> float:
+    """Misclassification fraction (LightGBM's 'error' convention)."""
+    return 1.0 - accuracy(y_true, y_prob)
+
+
 def rmse(y_true: np.ndarray, y_pred: np.ndarray) -> float:
     d = np.asarray(y_true, np.float64) - np.asarray(y_pred, np.float64)
     return float(np.sqrt(np.mean(d * d)))
+
+
+def mse(y_true: np.ndarray, y_pred: np.ndarray) -> float:
+    d = np.asarray(y_true, np.float64) - np.asarray(y_pred, np.float64)
+    return float(np.mean(d * d))
+
+
+def mae(y_true: np.ndarray, y_pred: np.ndarray) -> float:
+    d = np.asarray(y_true, np.float64) - np.asarray(y_pred, np.float64)
+    return float(np.mean(np.abs(d)))
 
 
 def dcg_at_k(rels: np.ndarray, k: int) -> float:
@@ -94,7 +109,14 @@ METRICS = {
     "multi_logloss": multi_logloss,
     "accuracy": accuracy,
     "rmse": rmse,
+    "mse": mse,
+    "mae": mae,
+    "error": error_rate,
 }
+
+_METRIC_ALIASES = {"l2": "mse", "l2_root": "rmse", "l1": "mae",
+                   "logloss": "binary_logloss", "binary_error": "error",
+                   "multi_error": "error"}
 
 DEFAULT_METRIC = {
     "binary": "auc",
@@ -103,8 +125,9 @@ DEFAULT_METRIC = {
     "lambdarank": "ndcg",
 }
 
-HIGHER_BETTER = {"auc": True, "ndcg": True, "accuracy": True,
-                 "binary_logloss": False, "multi_logloss": False, "rmse": False}
+HIGHER_BETTER = {"auc": True, "ndcg": True, "accuracy": True, "error": False,
+                 "binary_logloss": False, "multi_logloss": False,
+                 "rmse": False, "mse": False, "mae": False}
 
 
 def evaluate_raw(
@@ -117,6 +140,7 @@ def evaluate_raw(
 ) -> tuple[str, float, bool]:
     """Evaluate a metric on raw (pre-link) scores → (name, value, higher_better)."""
     name = metric or DEFAULT_METRIC[objective]
+    name = _METRIC_ALIASES.get(name, name)
     s = raw_score if raw_score.ndim == 1 else raw_score[:, 0] if raw_score.shape[1] == 1 else raw_score
     if name == "auc":
         value = auc(y, s)
@@ -125,10 +149,19 @@ def evaluate_raw(
     elif name == "multi_logloss":
         e = np.exp(s - s.max(axis=1, keepdims=True))
         value = multi_logloss(y, e / e.sum(axis=1, keepdims=True))
-    elif name == "accuracy":
-        value = accuracy(y, s)
+    elif name in ("accuracy", "error"):
+        if s.ndim == 1:   # binary raw scores: class 1 iff score > 0
+            acc = float((np.asarray(y).astype(np.int64)
+                         == (s > 0).astype(np.int64)).mean())
+        else:
+            acc = accuracy(y, s)
+        value = acc if name == "accuracy" else 1.0 - acc
     elif name == "rmse":
         value = rmse(y, s)
+    elif name == "mse":
+        value = mse(y, s)
+    elif name == "mae":
+        value = mae(y, s)
     elif name == "ndcg":
         if query_offsets is None:
             raise ValueError("ndcg requires query groups on the validation set")
