@@ -30,7 +30,11 @@ import jax.numpy as jnp
 
 from dryad_tpu.config import Params
 from dryad_tpu.engine.grower import finalize_leaf_values, pack_cat_bitset, root_stats
-from dryad_tpu.engine.histogram import build_hist, build_hist_segmented
+from dryad_tpu.engine.histogram import (
+    build_hist,
+    build_hist_multi,
+    build_hist_segmented,
+)
 from dryad_tpu.engine.split import NEG_INF, find_best_split
 
 
@@ -108,13 +112,17 @@ def grow_tree_levelwise(
     splits_done = jnp.int32(0)
     max_depth = jnp.int32(0)
 
-    # ---- levels: ONE traced body under fori_loop -----------------------------
-    # A Python unroll over levels multiplies the XLA program by depth_cap and
-    # makes remote compilation pathologically slow; instead every level runs
-    # the same fixed-width program (P = widest level), with inactive
-    # candidate columns masked out.  The MXU pads the weight matrix's N
-    # dimension to 128 anyway, so the uniform width costs little extra.
-    P = min(1 << (depth_cap - 1), L - 1)
+    # ---- levels: two fori_loop phases with level-appropriate widths ----------
+    # A Python unroll over levels would multiply the XLA program by depth_cap
+    # (pathological remote compile times); a single fori_loop must run EVERY
+    # level at the deepest level's width P (the per-level cost of the
+    # candidate machinery, tile plan and vmapped split scan all scale with
+    # P).  Two phases split the difference: shallow levels (<= 2^3 leaves)
+    # run at width 8, deep levels at the full width — one extra traced body,
+    # most of the narrow-level savings.
+    P_full = min(1 << (depth_cap - 1), L - 1)
+    d_switch = 4 if (depth_cap > 4 and P_full > 8) else depth_cap
+    P_narrow = min(1 << (d_switch - 1), L - 1)
 
     st = {
         "row_slot": row_slot, "slot_node": slot_node, "slot_gain": slot_gain,
@@ -127,151 +135,155 @@ def grow_tree_levelwise(
         "cat_nodes": cat_nodes, "num_nodes": num_nodes,
         "splits_done": splits_done, "max_depth": max_depth,
     }
-    def level_body(d, st):
-        (row_slot, slot_node, slot_gain, slot_G, slot_H, slot_C, slot_depth,
-         sp_feature, sp_thresh, sp_GL, sp_HL, sp_CL, sp_catmask, hists,
-         feature, threshold, gain_arr, left, right, is_cat_arr, cat_nodes,
-         num_nodes, splits_done, max_depth) = (
-            st["row_slot"], st["slot_node"], st["slot_gain"], st["slot_G"],
-            st["slot_H"], st["slot_C"], st["slot_depth"], st["sp_feature"],
-            st["sp_thresh"], st["sp_GL"], st["sp_HL"], st["sp_CL"],
-            st["sp_catmask"], st["hists"], st["feature"], st["threshold"],
-            st["gain"], st["left"], st["right"], st["is_cat"], st["cat_nodes"],
-            st["num_nodes"], st["splits_done"], st["max_depth"])
-        at_level = (slot_depth == d) & (slot_gain > NEG_INF) & (slot_node >= 0)
-        # gain-descending order, stable => lowest slot id wins ties, exactly
-        # the CPU trainer's repeated first-max argmax sequence
-        order = jnp.argsort(jnp.where(at_level, -slot_gain, jnp.inf), stable=True)
-        cand = order[:P].astype(jnp.int32)
-        budget_left = (L - 1) - splits_done
-        do = at_level[cand] & (jnp.arange(P) < budget_left)
-        n_do = jnp.sum(do.astype(jnp.int32))
+    def make_level_body(P):
+        def level_body(d, st):
+            (row_slot, slot_node, slot_gain, slot_G, slot_H, slot_C, slot_depth,
+             sp_feature, sp_thresh, sp_GL, sp_HL, sp_CL, sp_catmask, hists,
+             feature, threshold, gain_arr, left, right, is_cat_arr, cat_nodes,
+             num_nodes, splits_done, max_depth) = (
+                st["row_slot"], st["slot_node"], st["slot_gain"], st["slot_G"],
+                st["slot_H"], st["slot_C"], st["slot_depth"], st["sp_feature"],
+                st["sp_thresh"], st["sp_GL"], st["sp_HL"], st["sp_CL"],
+                st["sp_catmask"], st["hists"], st["feature"], st["threshold"],
+                st["gain"], st["left"], st["right"], st["is_cat"], st["cat_nodes"],
+                st["num_nodes"], st["splits_done"], st["max_depth"])
+            at_level = (slot_depth == d) & (slot_gain > NEG_INF) & (slot_node >= 0)
+            # gain-descending order, stable => lowest slot id wins ties, exactly
+            # the CPU trainer's repeated first-max argmax sequence
+            order = jnp.argsort(jnp.where(at_level, -slot_gain, jnp.inf), stable=True)
+            cand = order[:P].astype(jnp.int32)
+            budget_left = (L - 1) - splits_done
+            do = at_level[cand] & (jnp.arange(P) < budget_left)
+            n_do = jnp.sum(do.astype(jnp.int32))
 
-        sj = cand
-        parent_node = slot_node[sj]
-        sf = sp_feature[sj]
-        thr = sp_thresh[sj]
-        GL, HL, CL = sp_GL[sj], sp_HL[sj], sp_CL[sj]
-        Gp, Hp, Cp = slot_G[sj], slot_H[sj], slot_C[sj]
-        GR, HR, CR = Gp - GL, Hp - HL, Cp - CL
-        cat_split = (is_cat_feat[jnp.maximum(sf, 0)] & do) if has_cat else jnp.zeros((P,), bool)
+            sj = cand
+            parent_node = slot_node[sj]
+            sf = sp_feature[sj]
+            thr = sp_thresh[sj]
+            GL, HL, CL = sp_GL[sj], sp_HL[sj], sp_CL[sj]
+            Gp, Hp, Cp = slot_G[sj], slot_H[sj], slot_C[sj]
+            GR, HR, CR = Gp - GL, Hp - HL, Cp - CL
+            cat_split = (is_cat_feat[jnp.maximum(sf, 0)] & do) if has_cat else jnp.zeros((P,), bool)
 
-        # slot/node allocation in execution (gain) order, as the CPU does
-        ks = splits_done + jnp.cumsum(do.astype(jnp.int32)) - do.astype(jnp.int32)
-        right_slot = jnp.where(do, ks + 1, L).astype(jnp.int32)
-        left_id = jnp.where(do, num_nodes + 2 * (ks - splits_done), 0).astype(jnp.int32)
-        right_id = left_id + 1
+            # slot/node allocation in execution (gain) order, as the CPU does
+            ks = splits_done + jnp.cumsum(do.astype(jnp.int32)) - do.astype(jnp.int32)
+            right_slot = jnp.where(do, ks + 1, L).astype(jnp.int32)
+            left_id = jnp.where(do, num_nodes + 2 * (ks - splits_done), 0).astype(jnp.int32)
+            right_id = left_id + 1
 
-        pidx = jnp.where(do, parent_node, M)
-        feature = feature.at[pidx].set(sf, mode="drop")
-        gain_arr = gain_arr.at[pidx].set(
-            jnp.where(do, slot_gain[sj], 0.0), mode="drop")
-        threshold = threshold.at[pidx].set(jnp.where(cat_split, 0, thr), mode="drop")
-        left = left.at[pidx].set(left_id, mode="drop")
-        right = right.at[pidx].set(right_id, mode="drop")
-        is_cat_arr = is_cat_arr.at[pidx].set(cat_split, mode="drop")
-        cat_nodes = cat_nodes.at[pidx].set(
-            jnp.where(cat_split[:, None], sp_catmask[sj], False), mode="drop"
-        )
-
-        # ---- row partition: every splitting leaf in one vectorized pass -----
-        slot_do = jnp.zeros((L,), bool).at[jnp.where(do, sj, L)].set(True, mode="drop")
-        slot_right = jnp.full((L,), L, jnp.int32).at[
-            jnp.where(do, sj, L)].set(right_slot, mode="drop")
-        rs = jnp.minimum(row_slot, L - 1)
-        row_do = slot_do[rs] & (row_slot < L)
-        rf = jnp.maximum(sp_feature[rs], 0)
-        bins_rf = jnp.take_along_axis(Xb, rf[:, None].astype(jnp.int32), axis=1)[:, 0]
-        bins_rf = bins_rf.astype(jnp.int32)
-        go_left = bins_rf <= sp_thresh[rs]
-        if has_cat:
-            cat_row = sp_catmask[rs, jnp.minimum(bins_rf, Bc - 1)]
-            go_left = jnp.where(is_cat_feat[rf], cat_row, go_left)
-        row_slot = jnp.where(row_do & ~go_left, slot_right[rs], row_slot)
-
-        # ---- one batched histogram pass for all smaller children ------------
-        left_smaller = CL <= CR
-        small_slot = jnp.where(left_smaller, sj, right_slot)
-        large_slot = jnp.where(left_smaller, right_slot, sj)
-        # non-do candidates scatter to L+1 (out of bounds, dropped) so
-        # colof[L] stays P and out-of-bag rows are never selected
-        colof = jnp.full((L + 1,), P, jnp.int32).at[
-            jnp.where(do, small_slot, L + 1)].set(
-                jnp.arange(P, dtype=jnp.int32), mode="drop")
-        smallsel = colof[jnp.minimum(row_slot, L)]
-        # Single device, smaller children cover at most half the rows
-        # (min(left,right) <= parent/2, parents disjoint) -> half the tile
-        # grid.  Under shard_map the smaller child is chosen on GLOBAL
-        # counts and one shard's share of it may exceed half that shard, so
-        # no bound applies there; ditto above 2^24 rows, where the fp32
-        # histogram counts backing the smaller-child choice stop being exact.
-        bound_ok = axis_name is None and N < (1 << 24)
-        hist_small = build_hist_segmented(
-            Xb, g, h, smallsel, P, B,
-            rows_per_chunk=p.rows_per_chunk, axis_name=axis_name,
-            precision=p.hist_precision, backend=p.hist_backend,
-            rows_bound=(N // 2 + 1) if bound_ok else None,
-        )
-        if p.hist_subtraction:
-            hist_large = hists[sj] - hist_small
-        else:
-            largesel = jnp.full((L + 1,), P, jnp.int32).at[
-                jnp.where(do, large_slot, L + 1)].set(
-                    jnp.arange(P, dtype=jnp.int32), mode="drop")
-            hist_large = build_hist_multi(
-                Xb, g, h, largesel[jnp.minimum(row_slot, L)], P, B,
-                rows_per_chunk=p.rows_per_chunk, axis_name=axis_name,
-            precision=p.hist_precision,
+            pidx = jnp.where(do, parent_node, M)
+            feature = feature.at[pidx].set(sf, mode="drop")
+            gain_arr = gain_arr.at[pidx].set(
+                jnp.where(do, slot_gain[sj], 0.0), mode="drop")
+            threshold = threshold.at[pidx].set(jnp.where(cat_split, 0, thr), mode="drop")
+            left = left.at[pidx].set(left_id, mode="drop")
+            right = right.at[pidx].set(right_id, mode="drop")
+            is_cat_arr = is_cat_arr.at[pidx].set(cat_split, mode="drop")
+            cat_nodes = cat_nodes.at[pidx].set(
+                jnp.where(cat_split[:, None], sp_catmask[sj], False), mode="drop"
             )
-        ls = left_smaller[:, None, None, None]
-        hist_l = jnp.where(ls, hist_small, hist_large)
-        hist_r = jnp.where(ls, hist_large, hist_small)
-        hists = hists.at[jnp.where(do, sj, L)].set(hist_l, mode="drop")
-        hists = hists.at[jnp.where(do, right_slot, L)].set(hist_r, mode="drop")
 
-        # ---- children stats + their best splits (vmapped finder) ------------
-        ch_slot = jnp.concatenate([sj, right_slot])
-        ch_do = jnp.concatenate([do, do])
-        ch_node = jnp.concatenate([left_id, right_id])
-        ch_hist = jnp.concatenate([hist_l, hist_r])
-        ch_G = jnp.concatenate([GL, GR])
-        ch_H = jnp.concatenate([HL, HR])
-        ch_C = jnp.concatenate([CL, CR])
-        allow = ch_do & (d + 1 < depth_cap) & (ch_C >= 2 * p.min_data_in_leaf)
-        res = jax.vmap(best, in_axes=(0, 0, 0, 0, 0))(ch_hist, ch_G, ch_H, ch_C, allow)
+            # ---- row partition: every splitting leaf in one vectorized pass -----
+            slot_do = jnp.zeros((L,), bool).at[jnp.where(do, sj, L)].set(True, mode="drop")
+            slot_right = jnp.full((L,), L, jnp.int32).at[
+                jnp.where(do, sj, L)].set(right_slot, mode="drop")
+            rs = jnp.minimum(row_slot, L - 1)
+            row_do = slot_do[rs] & (row_slot < L)
+            rf = jnp.maximum(sp_feature[rs], 0)
+            bins_rf = jnp.take_along_axis(Xb, rf[:, None].astype(jnp.int32), axis=1)[:, 0]
+            bins_rf = bins_rf.astype(jnp.int32)
+            go_left = bins_rf <= sp_thresh[rs]
+            if has_cat:
+                cat_row = sp_catmask[rs, jnp.minimum(bins_rf, Bc - 1)]
+                go_left = jnp.where(is_cat_feat[rf], cat_row, go_left)
+            row_slot = jnp.where(row_do & ~go_left, slot_right[rs], row_slot)
 
-        cidx = jnp.where(ch_do, ch_slot, L)
-        slot_node = slot_node.at[cidx].set(ch_node, mode="drop")
-        slot_gain = slot_gain.at[cidx].set(res.gain, mode="drop")
-        slot_G = slot_G.at[cidx].set(ch_G, mode="drop")
-        slot_H = slot_H.at[cidx].set(ch_H, mode="drop")
-        slot_C = slot_C.at[cidx].set(ch_C, mode="drop")
-        slot_depth = slot_depth.at[cidx].set(d + 1, mode="drop")
-        sp_feature = sp_feature.at[cidx].set(res.feature, mode="drop")
-        sp_thresh = sp_thresh.at[cidx].set(res.threshold, mode="drop")
-        sp_GL = sp_GL.at[cidx].set(res.g_left, mode="drop")
-        sp_HL = sp_HL.at[cidx].set(res.h_left, mode="drop")
-        sp_CL = sp_CL.at[cidx].set(res.c_left, mode="drop")
-        sp_catmask = sp_catmask.at[cidx].set(res.cat_mask, mode="drop")
+            # ---- one batched histogram pass for all smaller children ------------
+            left_smaller = CL <= CR
+            small_slot = jnp.where(left_smaller, sj, right_slot)
+            large_slot = jnp.where(left_smaller, right_slot, sj)
+            # non-do candidates scatter to L+1 (out of bounds, dropped) so
+            # colof[L] stays P and out-of-bag rows are never selected
+            colof = jnp.full((L + 1,), P, jnp.int32).at[
+                jnp.where(do, small_slot, L + 1)].set(
+                    jnp.arange(P, dtype=jnp.int32), mode="drop")
+            smallsel = colof[jnp.minimum(row_slot, L)]
+            # Single device, smaller children cover at most half the rows
+            # (min(left,right) <= parent/2, parents disjoint) -> half the tile
+            # grid.  Under shard_map the smaller child is chosen on GLOBAL
+            # counts and one shard's share of it may exceed half that shard, so
+            # no bound applies there; ditto above 2^24 rows, where the fp32
+            # histogram counts backing the smaller-child choice stop being exact.
+            bound_ok = axis_name is None and N < (1 << 24)
+            hist_small = build_hist_segmented(
+                Xb, g, h, smallsel, P, B,
+                rows_per_chunk=p.rows_per_chunk, axis_name=axis_name,
+                precision=p.hist_precision, backend=p.hist_backend,
+                rows_bound=(N // 2 + 1) if bound_ok else None,
+            )
+            if p.hist_subtraction:
+                hist_large = hists[sj] - hist_small
+            else:
+                largesel = jnp.full((L + 1,), P, jnp.int32).at[
+                    jnp.where(do, large_slot, L + 1)].set(
+                        jnp.arange(P, dtype=jnp.int32), mode="drop")
+                hist_large = build_hist_multi(
+                    Xb, g, h, largesel[jnp.minimum(row_slot, L)], P, B,
+                    rows_per_chunk=p.rows_per_chunk, axis_name=axis_name,
+                precision=p.hist_precision,
+                )
+            ls = left_smaller[:, None, None, None]
+            hist_l = jnp.where(ls, hist_small, hist_large)
+            hist_r = jnp.where(ls, hist_large, hist_small)
+            hists = hists.at[jnp.where(do, sj, L)].set(hist_l, mode="drop")
+            hists = hists.at[jnp.where(do, right_slot, L)].set(hist_r, mode="drop")
 
-        splits_done = splits_done + n_do
-        num_nodes = num_nodes + 2 * n_do
-        max_depth = jnp.where(n_do > 0, (d + 1).astype(jnp.int32), max_depth)
+            # ---- children stats + their best splits (vmapped finder) ------------
+            ch_slot = jnp.concatenate([sj, right_slot])
+            ch_do = jnp.concatenate([do, do])
+            ch_node = jnp.concatenate([left_id, right_id])
+            ch_hist = jnp.concatenate([hist_l, hist_r])
+            ch_G = jnp.concatenate([GL, GR])
+            ch_H = jnp.concatenate([HL, HR])
+            ch_C = jnp.concatenate([CL, CR])
+            allow = ch_do & (d + 1 < depth_cap) & (ch_C >= 2 * p.min_data_in_leaf)
+            res = jax.vmap(best, in_axes=(0, 0, 0, 0, 0))(ch_hist, ch_G, ch_H, ch_C, allow)
 
-        return {
-            "row_slot": row_slot, "slot_node": slot_node,
-            "slot_gain": slot_gain, "slot_G": slot_G, "slot_H": slot_H,
-            "slot_C": slot_C, "slot_depth": slot_depth,
-            "sp_feature": sp_feature, "sp_thresh": sp_thresh, "sp_GL": sp_GL,
-            "sp_HL": sp_HL, "sp_CL": sp_CL, "sp_catmask": sp_catmask,
-            "hists": hists, "feature": feature, "threshold": threshold,
-            "gain": gain_arr, "left": left, "right": right,
-            "is_cat": is_cat_arr, "cat_nodes": cat_nodes,
-            "num_nodes": num_nodes, "splits_done": splits_done,
-            "max_depth": max_depth,
-        }
+            cidx = jnp.where(ch_do, ch_slot, L)
+            slot_node = slot_node.at[cidx].set(ch_node, mode="drop")
+            slot_gain = slot_gain.at[cidx].set(res.gain, mode="drop")
+            slot_G = slot_G.at[cidx].set(ch_G, mode="drop")
+            slot_H = slot_H.at[cidx].set(ch_H, mode="drop")
+            slot_C = slot_C.at[cidx].set(ch_C, mode="drop")
+            slot_depth = slot_depth.at[cidx].set(d + 1, mode="drop")
+            sp_feature = sp_feature.at[cidx].set(res.feature, mode="drop")
+            sp_thresh = sp_thresh.at[cidx].set(res.threshold, mode="drop")
+            sp_GL = sp_GL.at[cidx].set(res.g_left, mode="drop")
+            sp_HL = sp_HL.at[cidx].set(res.h_left, mode="drop")
+            sp_CL = sp_CL.at[cidx].set(res.c_left, mode="drop")
+            sp_catmask = sp_catmask.at[cidx].set(res.cat_mask, mode="drop")
 
-    st = jax.lax.fori_loop(0, depth_cap, level_body, st)
+            splits_done = splits_done + n_do
+            num_nodes = num_nodes + 2 * n_do
+            max_depth = jnp.where(n_do > 0, (d + 1).astype(jnp.int32), max_depth)
+
+            return {
+                "row_slot": row_slot, "slot_node": slot_node,
+                "slot_gain": slot_gain, "slot_G": slot_G, "slot_H": slot_H,
+                "slot_C": slot_C, "slot_depth": slot_depth,
+                "sp_feature": sp_feature, "sp_thresh": sp_thresh, "sp_GL": sp_GL,
+                "sp_HL": sp_HL, "sp_CL": sp_CL, "sp_catmask": sp_catmask,
+                "hists": hists, "feature": feature, "threshold": threshold,
+                "gain": gain_arr, "left": left, "right": right,
+                "is_cat": is_cat_arr, "cat_nodes": cat_nodes,
+                "num_nodes": num_nodes, "splits_done": splits_done,
+                "max_depth": max_depth,
+            }
+        return level_body
+
+    st = jax.lax.fori_loop(0, d_switch, make_level_body(P_narrow), st)
+    if d_switch < depth_cap:
+        st = jax.lax.fori_loop(d_switch, depth_cap, make_level_body(P_full), st)
 
     # ---- finalize leaf values + node bitsets (shared helpers) ----------------
     value = finalize_leaf_values(p, M, st["slot_node"], st["slot_G"],

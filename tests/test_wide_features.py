@@ -1,0 +1,40 @@
+"""Epsilon-shaped wide-feature stress (BASELINE.json config 3, scaled for
+CI): many-feature regression must train correctly through the feature-
+chunked histogram path on both backends."""
+
+import numpy as np
+
+import dryad_tpu as dryad
+from dryad_tpu.datasets import epsilon_like
+from dryad_tpu.metrics import rmse
+
+PARAMS = dict(objective="regression", num_trees=5, num_leaves=31,
+              max_depth=5, growth="depthwise", max_bins=64)
+
+
+def test_wide_regression_cpu_tpu_parity():
+    X, y = epsilon_like(n=3000, num_features=300, seed=81)
+    ds = dryad.Dataset(X, y, max_bins=64)
+    b_cpu = dryad.train(PARAMS, ds, backend="cpu")
+    b_tpu = dryad.train(PARAMS, ds, backend="tpu")
+    np.testing.assert_array_equal(b_cpu.feature, b_tpu.feature)
+    np.testing.assert_array_equal(b_cpu.threshold, b_tpu.threshold)
+    r = rmse(y, b_cpu.predict_binned(ds.X_binned))
+    assert r < np.sqrt(np.var(y))            # learned something
+
+
+def test_no_hist_subtraction_path():
+    # exercises the build_hist_multi large-child branch (hist_subtraction off)
+    X, y = epsilon_like(n=2000, num_features=20, seed=83)
+    ds = dryad.Dataset(X, y, max_bins=32)
+    p = dict(PARAMS, max_bins=32, hist_subtraction=False)
+    b_cpu = dryad.train(p, ds, backend="cpu")
+    b_tpu = dryad.train(p, ds, backend="tpu")
+    np.testing.assert_array_equal(b_cpu.feature, b_tpu.feature)
+
+
+def test_wide_forces_multiple_feature_chunks():
+    from dryad_tpu.engine.pallas_hist import _feature_chunk, _pow2_bins
+
+    Fc = _feature_chunk(300, _pow2_bins(64))
+    assert Fc < 300                          # the chunked path is exercised
