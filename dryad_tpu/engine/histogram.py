@@ -256,30 +256,13 @@ def build_hist_segmented(
     P = int(num_cols)
     prec = _resolve_precision(precision)
     T = _segment_tile(N, P)
-    bound = N if rows_bound is None else min(int(rows_bound), N)
-    n_tiles = bound // T + P + 1  # worst case: every leaf wastes < 1 tile
+    # one shared bucketing plan with the Pallas path (incl. the rows_bound
+    # safety squeeze); clamped trailing tiles hold only sentinel rows, so
+    # their leaf assignment contributes zeros to the scatter below
+    from dryad_tpu.engine.pallas_hist import tile_plan
 
-    sel = sel.astype(jnp.int32)
-    order = jnp.argsort(sel, stable=True)
-    sel_sorted = sel[order]
-    # per-leaf [start, end) in sorted order via binary search
-    start = jnp.searchsorted(sel_sorted, jnp.arange(P + 1, dtype=jnp.int32),
-                             side="left").astype(jnp.int32)
-    counts = start[1:] - start[:-1]                       # (P,)
-    leaf_tiles = (counts + (T - 1)) // T
-    seg_base = jnp.concatenate([jnp.zeros((1,), jnp.int32),
-                                jnp.cumsum(leaf_tiles).astype(jnp.int32)])
-
-    # destination slot of sorted position i: its leaf's tile range, packed
-    pos = jnp.arange(N, dtype=jnp.int32)
-    l_of = jnp.minimum(sel_sorted, P - 1)
-    in_leaf = pos - start[l_of]
-    dest = jnp.where(sel_sorted < P, seg_base[l_of] * T + in_leaf, n_tiles * T)
-    buf = jnp.full((n_tiles * T,), N, jnp.int32).at[dest].set(order.astype(jnp.int32),
-                                                             mode="drop")
-    # tile -> leaf map (P for empty tiles)
-    tile_leaf = jnp.searchsorted(seg_base[1:], jnp.arange(n_tiles, dtype=jnp.int32),
-                                 side="right").astype(jnp.int32)
+    buf, tile_leaf, _ = tile_plan(sel, N, P, T, rows_bound=rows_bound)
+    n_tiles = buf.shape[0] // T
 
     # gather rows (sentinel N -> zero row)
     Xp = jnp.concatenate([Xb, jnp.zeros((1, F), Xb.dtype)])

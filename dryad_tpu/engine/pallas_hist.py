@@ -265,11 +265,20 @@ def tile_plan(sel: jnp.ndarray, N: int, P: int, T: int,
     leaf_tiles = jnp.maximum((counts + (T - 1)) // T, 1)
     seg_base = jnp.concatenate([jnp.zeros((1,), jnp.int32),
                                 jnp.cumsum(leaf_tiles).astype(jnp.int32)])
+    # Safety squeeze: if the caller's rows_bound was violated, raw bases can
+    # exceed the grid.  Clamp so leaf i starts no later than n_tiles-(P-i) —
+    # every leaf keeps >= 1 in-range tile (outputs stay initialized) and
+    # rows beyond a leaf's allotment drop deterministically instead of
+    # corrupting a neighbour's tiles.
+    seg_base = jnp.minimum(
+        seg_base, jnp.int32(n_tiles) - (P - jnp.arange(P + 1, dtype=jnp.int32)))
+    cap_rows = (seg_base[1:] - seg_base[:-1]) * T         # (P,)
 
     pos = jnp.arange(N, dtype=jnp.int32)
     l_of = jnp.minimum(sel_sorted, P - 1)
     in_leaf = pos - start[l_of]
-    dest = jnp.where(sel_sorted < P, seg_base[l_of] * T + in_leaf, n_tiles * T)
+    dest = jnp.where((sel_sorted < P) & (in_leaf < cap_rows[l_of]),
+                     seg_base[l_of] * T + in_leaf, n_tiles * T)
     buf = jnp.full((n_tiles * T,), N, jnp.int32).at[dest].set(
         order.astype(jnp.int32), mode="drop")
     tile_leaf = jnp.searchsorted(seg_base[1:], jnp.arange(n_tiles, dtype=jnp.int32),

@@ -1,0 +1,49 @@
+"""max_bins > 256: the uint16 bin path through sketch, both growers and
+predict (the Pallas kernel supports <= 1024 bins; beyond that the XLA
+histogram path takes over automatically)."""
+
+import numpy as np
+import pytest
+
+import dryad_tpu as dryad
+from dryad_tpu.datasets import covertype_like, higgs_like
+from dryad_tpu.metrics import auc
+
+
+def test_uint16_bins_cpu_tpu_parity():
+    X, y = higgs_like(4000, seed=91)
+    ds = dryad.Dataset(X, y, max_bins=512)
+    assert ds.X_binned.dtype == np.uint16
+    p = dict(objective="binary", num_trees=5, num_leaves=15, max_bins=512,
+             growth="depthwise", max_depth=4)
+    b_cpu = dryad.train(p, ds, backend="cpu")
+    b_tpu = dryad.train(p, ds, backend="tpu")
+    np.testing.assert_array_equal(b_cpu.feature, b_tpu.feature)
+    np.testing.assert_array_equal(b_cpu.threshold, b_tpu.threshold)
+    np.testing.assert_allclose(b_cpu.value, b_tpu.value, atol=1e-2)
+    # bit-identity: the SAME booster must predict identically on both backends
+    np.testing.assert_array_equal(
+        b_tpu.predict_binned(ds.X_binned, backend="cpu"),
+        b_tpu.predict_binned(ds.X_binned, backend="tpu"))
+
+
+def test_bins_beyond_pallas_cap_fall_back():
+    X, y = higgs_like(2000, seed=93)
+    ds = dryad.Dataset(X, y, max_bins=2048)
+    p = dict(objective="binary", num_trees=3, num_leaves=7, max_bins=2048,
+             growth="depthwise", max_depth=3, hist_backend="auto")
+    b = dryad.train(p, ds, backend="tpu")
+    assert auc(y, b.predict_binned(ds.X_binned)) > 0.6
+
+
+def test_weighted_multiclass_depthwise():
+    X, y = covertype_like(4000, seed=95)
+    w = np.random.default_rng(95).uniform(0.5, 2.0, size=4000).astype(np.float32)
+    ds = dryad.Dataset(X, y, weight=w, max_bins=64)
+    p = dict(objective="multiclass", num_class=7, num_trees=3, num_leaves=15,
+             growth="depthwise", max_depth=4, max_bins=64)
+    b_cpu = dryad.train(p, ds, backend="cpu")
+    b_tpu = dryad.train(p, ds, backend="tpu")
+    np.testing.assert_array_equal(b_cpu.feature, b_tpu.feature)
+    pred = b_tpu.predict_binned(ds.X_binned)
+    assert (pred.argmax(1) == y).mean() > 0.5
