@@ -33,14 +33,20 @@ import jax.numpy as jnp
 _PALLAS_PLATFORMS = ("tpu", "axon")  # axon: the tunneled-TPU plugin platform
 
 
-def resolve_backend(backend: str, *, segmented: bool = False) -> str:
+def resolve_backend(backend: str, *, segmented: bool = False,
+                    platform: str | None = None) -> str:
     """auto -> the measured winner per path: the Pallas kernel for the
     leaf-segmented level pass on a TPU (1.7x over the XLA matmul), XLA for
     the single-mask pass (where the Pallas prep overhead eats the kernel
     win), on CPU (Pallas would run interpreted) and on any non-TPU
-    accelerator (the kernel uses TPU-only Mosaic features)."""
+    accelerator (the kernel uses TPU-only Mosaic features).
+
+    ``platform`` overrides the process default backend when the caller
+    knows the devices that will actually run the program (e.g. a CPU mesh
+    forced on a TPU-attached process — train_device resolves against its
+    mesh and passes a concrete backend down)."""
     if backend == "auto":
-        if jax.default_backend() not in _PALLAS_PLATFORMS:
+        if (platform or jax.default_backend()) not in _PALLAS_PLATFORMS:
             return "xla"
         return "pallas" if segmented else "xla"
     return backend

@@ -75,14 +75,25 @@ def _pow2_bins(B: int) -> int:
 
 
 def _feature_chunk(F: int, Bp: int) -> int:
-    """Features per chunk: bound one-hot lanes; keep Fc a multiple of 8
-    (sublane alignment — a ragged feature dim forces Mosaic relayouts that
-    cost orders of magnitude) and Fc*Bp a multiple of 128 (lane rule)."""
-    step = max(8, 128 // Bp)
-    budget = max(step, (_LANE_BUDGET // Bp) // step * step)
-    if F <= budget:
-        return ((F + step - 1) // step) * step
-    return budget
+    """Features per chunk: a power of two (>= 8) so the kernel can recover
+    the bin index from the tiled one-hot layout with a shift, bounded so
+    Fc*Bp one-hot lanes fit the VMEM budget; Fc*Bp stays a multiple of 128
+    (lane rule) since both factors are pow2 with product >= 128.
+
+    Among the admissible sizes, pick the one minimizing the padded total
+    ceil(F/Fc)*Fc — the largest pow2 is NOT always best (F=130 would pad
+    97% at Fc=256 but only 5% at Fc=8)."""
+    budget = max(8, _LANE_BUDGET // Bp)
+    best, best_padded = 8, None
+    fc = 8
+    while fc <= budget:
+        padded = -(-F // fc) * fc
+        if best_padded is None or padded <= best_padded:
+            best, best_padded = fc, padded   # ties -> larger fc (fewer chunks)
+        if fc >= F:
+            break
+        fc *= 2
+    return best
 
 
 def _split3(x: jnp.ndarray):
@@ -118,13 +129,24 @@ def _pack_weights(g: jnp.ndarray, h: jnp.ndarray, valid: jnp.ndarray) -> jnp.nda
 
 def _hist_kernel(tile_leaf_ref, tile_first_ref, x_ref, w_ref, o_ref, *,
                  padded_bins: int):
-    """One (feature-chunk, row-tile) step: w (128,T) @ one-hot (T, Fc*Bp)."""
+    """One (feature-chunk, row-tile) step: w (128,T) @ one-hot (T, Fc*Bp).
+
+    The one-hot is built directly in its 2-D lane layout: ``pltpu.repeat``
+    TILES the bin-id block Bp times along lanes (column c holds feature
+    c mod Fc, bin c >> log2(Fc)), and a shifted iota supplies the bin to
+    compare against.  (The obvious (T, Fc, Bp) -> (T, Fc*Bp) reshape is an
+    "unsupported shape cast" to Mosaic whenever Bp < 128, and the tiled
+    layout needs no relayout at all.)  The caller untangles the b-major
+    column order once, outside the kernel.
+    """
     i = pl.program_id(1)
     x = x_ref[0, 0]                                # (T, Fc) int32
     T, Fc = x.shape
     Bp = padded_bins
-    iota_b = jax.lax.broadcasted_iota(jnp.int32, (T, Fc, Bp), 2)
-    onehot = (x[:, :, None] == iota_b).astype(jnp.bfloat16).reshape(T, Fc * Bp)
+    shift = Fc.bit_length() - 1                    # Fc is a power of two
+    x_rep = pltpu.repeat(x, Bp, axis=1)            # (T, Fc*Bp) tiled
+    iota_b = jax.lax.broadcasted_iota(jnp.int32, (T, Fc * Bp), 1) >> shift
+    onehot = (x_rep == iota_b).astype(jnp.bfloat16)
     part = jax.lax.dot_general(
         w_ref[0], onehot,
         (((1,), (0,)), ((), ())),
@@ -141,10 +163,12 @@ def _hist_kernel(tile_leaf_ref, tile_first_ref, x_ref, w_ref, o_ref, *,
 
 
 @functools.partial(
-    jax.jit, static_argnames=("num_cols", "total_bins", "num_features")
+    jax.jit, static_argnames=("num_cols", "total_bins", "num_features",
+                              "axis_name")
 )
 def _hist_tiles(Xt, Wt, tile_leaf, tile_first, *, num_cols: int,
-                total_bins: int, num_features: int) -> jnp.ndarray:
+                total_bins: int, num_features: int,
+                axis_name: str | None = None) -> jnp.ndarray:
     """Core pallas_call: leaf-grouped tiles -> (P, 3, F, B) f32 histograms.
 
     Xt (n_fb, n_tiles, T, Fc) int32 bin ids (feature-chunked, -padded),
@@ -152,6 +176,10 @@ def _hist_tiles(Xt, Wt, tile_leaf, tile_first, *, num_cols: int,
     monotone non-decreasing leaf per tile, tile_first (n_tiles,) 1 on a
     leaf's first tile.  Every leaf in [0, P) must own at least one tile so
     its output block is written.
+
+    ``axis_name`` must name the shard_map axis when tracing inside one —
+    the per-shard partial histogram varies over it (vma) until the caller's
+    psum.
     """
     n_fb, n_tiles, T, Fc = Xt.shape
     B = int(total_bins)
@@ -169,14 +197,21 @@ def _hist_tiles(Xt, Wt, tile_leaf, tile_first, *, num_cols: int,
         out_specs=pl.BlockSpec((1, _WROWS, Fc * Bp),
                                lambda j, i, tl, tf: (tl[i], 0, j)),
     )
+    out_shape = jax.ShapeDtypeStruct(
+        (P, _WROWS, n_fb * Fc * Bp), jnp.float32,
+        **({"vma": frozenset({axis_name})} if axis_name else {}),
+    )
     out = pl.pallas_call(
         functools.partial(_hist_kernel, padded_bins=Bp),
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((P, _WROWS, n_fb * Fc * Bp), jnp.float32),
+        out_shape=out_shape,
         interpret=_interpret(),
     )(tile_leaf, tile_first, Xt, Wt)
 
-    out = out.reshape(P, _WROWS, n_fb * Fc, Bp)[:, :, :F, :B]
+    # kernel columns are (bin-major, feature-minor) per chunk — untangle
+    out = (out.reshape(P, _WROWS, n_fb, Bp, Fc)
+              .transpose(0, 1, 2, 4, 3)
+              .reshape(P, _WROWS, n_fb * Fc, Bp))[:, :, :F, :B]
     hg = out[:, 0] + out[:, 1] + out[:, 2]
     hh = out[:, 3] + out[:, 4] + out[:, 5]
     hc = out[:, 6]
@@ -231,7 +266,7 @@ def build_hist_pallas(
 
     hist = _hist_tiles(
         Xt, Wt, tile_leaf, tile_first,
-        num_cols=1, total_bins=B, num_features=F,
+        num_cols=1, total_bins=B, num_features=F, axis_name=axis_name,
     )[0]
     if axis_name is not None:
         hist = jax.lax.psum(hist, axis_name)
@@ -320,6 +355,7 @@ def hist_from_plan(
     hist = _hist_tiles(
         Xt, Wt, tile_leaf, tile_first,
         num_cols=int(num_cols), total_bins=B, num_features=F,
+        axis_name=axis_name,
     )
     if axis_name is not None:
         hist = jax.lax.psum(hist, axis_name)

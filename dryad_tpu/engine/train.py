@@ -227,6 +227,18 @@ def train_device(
         rank_Q, rank_S = rank_plan.Q, rank_plan.S
         qoff_j = jnp.asarray(qoff)
 
+    # the devices that actually run the step may differ from the process
+    # default backend (e.g. a CPU mesh forced on a TPU-attached process);
+    # force the XLA histogram there — plain 'auto' consults the process
+    # default and would pick the TPU-only Pallas kernel
+    if p.hist_backend == "auto":
+        from dryad_tpu.engine.histogram import resolve_backend
+
+        plat = (mesh.devices.flat[0].platform if mesh is not None
+                else jax.devices()[0].platform)
+        if resolve_backend("auto", segmented=True, platform=plat) == "xla":
+            p = p.replace(hist_backend="xla")
+
     # static jit key: strip fields that cannot affect the compiled programs
     # so e.g. a warmup run with fewer trees reuses the same executables
     p_key = p.replace(num_trees=1, early_stopping_rounds=0, metric="")
