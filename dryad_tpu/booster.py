@@ -118,7 +118,24 @@ class Booster:
         raw_score: bool = False,
         backend: str = "cpu",
         num_iteration: Optional[int] = None,
+        pred_leaf: bool = False,
     ) -> np.ndarray:
+        if pred_leaf:
+            from dryad_tpu.cpu.predict import predict_tree_leaves
+
+            if num_iteration is not None:
+                n_iter = num_iteration
+            elif self.best_iteration > 0:   # early-stopping semantics, as scores
+                n_iter = self.best_iteration
+            else:
+                n_iter = self.num_iterations
+            T = min(n_iter * self.num_outputs, self.num_total_trees)
+            ta = self.tree_arrays()
+            out = np.empty((X_binned.shape[0], T), np.int32)
+            for t in range(T):
+                out[:, t] = predict_tree_leaves(ta, X_binned, t,
+                                                max(self.max_depth_seen, 1))
+            return out
         if backend == "cpu":
             from dryad_tpu.cpu.predict import predict_binned_cpu
 

@@ -100,6 +100,8 @@ class Params:
     # evaluation / early stopping
     metric: str = ""              # "" = objective default
     early_stopping_rounds: int = 0  # 0 = disabled
+    # binary: multiply the positive class's grad/hess (imbalanced data)
+    scale_pos_weight: float = 1.0
     # LambdaMART
     sigmoid: float = 1.0
     ndcg_at: int = 10
@@ -163,6 +165,8 @@ class Params:
             raise ValueError("learning_rate must be > 0")
         if not (0.0 < self.subsample <= 1.0) or not (0.0 < self.colsample <= 1.0):
             raise ValueError("subsample/colsample must be in (0, 1]")
+        if not (self.scale_pos_weight > 0.0):
+            raise ValueError("scale_pos_weight must be > 0")
         if self.hist_backend not in ("auto", "xla", "pallas"):
             raise ValueError("hist_backend must be auto|xla|pallas")
         if self.hist_precision not in ("exact", "fast"):

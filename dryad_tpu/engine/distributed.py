@@ -56,7 +56,8 @@ def shard_rows(mesh: Mesh, *arrays):
 
 
 def grow_sharded(params: Params, total_bins: int, has_cat: bool,
-                 mesh: Mesh, Xb, g, h, bag_mask, feat_mask, is_cat_feat):
+                 mesh: Mesh, Xb, g, h, bag_mask, feat_mask, is_cat_feat,
+                 platform=None):
     """One sharded tree grow; returns (replicated tree, row-sharded leaves).
 
     Called inside the device train step's jit: the tree arrays come back
@@ -67,7 +68,7 @@ def grow_sharded(params: Params, total_bins: int, has_cat: bool,
     def run(Xb_l, g_l, h_l, bag_l, fmask, iscat):
         tree = grow_any(
             params, total_bins, Xb_l, g_l, h_l, bag_l, fmask, iscat,
-            has_cat=has_cat, axis_name=AXIS,
+            has_cat=has_cat, axis_name=AXIS, platform=platform,
         )
         leaves = tree_leaves(tree, Xb_l, tree["max_depth"])
         return tree, leaves

@@ -44,7 +44,7 @@ _BIG_DEPTH = jnp.int32(2**30)
 
 
 def grow_any(params, total_bins, Xb, g, h, bag_mask, feat_mask, is_cat_feat,
-             *, has_cat=False, axis_name=None):
+             *, has_cat=False, axis_name=None, platform=None):
     """Route to the fastest grower for the growth policy.
 
     Depth-wise growth takes the level-synchronous path (one batched
@@ -56,11 +56,11 @@ def grow_any(params, total_bins, Xb, g, h, bag_mask, feat_mask, is_cat_feat,
 
         return grow_tree_levelwise(
             params, total_bins, Xb, g, h, bag_mask, feat_mask, is_cat_feat,
-            has_cat=has_cat, axis_name=axis_name,
+            has_cat=has_cat, axis_name=axis_name, platform=platform,
         )
     return grow_tree(
         params, total_bins, Xb, g, h, bag_mask, feat_mask, is_cat_feat,
-        has_cat=has_cat, axis_name=axis_name,
+        has_cat=has_cat, axis_name=axis_name, platform=platform,
     )
 
 
@@ -111,6 +111,7 @@ def grow_tree(
     *,
     has_cat: bool = False,
     axis_name: str | None = None,
+    platform: str | None = None,
 ) -> dict[str, Any]:
     """Grow one tree; returns SoA tree arrays (max_nodes,) + max_depth.
 
@@ -147,6 +148,7 @@ def grow_tree(
             Xb, g, h, mask, B,
             rows_per_chunk=p.rows_per_chunk, axis_name=axis_name,
             precision=p.hist_precision, backend=p.hist_backend,
+            platform=platform,
         )
 
     # ---- root ---------------------------------------------------------------

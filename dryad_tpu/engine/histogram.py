@@ -82,6 +82,7 @@ def build_hist(
     axis_name: str | None = None,
     precision: str = "exact",
     backend: str = "xla",
+    platform: str | None = None,
 ) -> jnp.ndarray:
     """Masked per-(feature, bin) sums -> (3, F, B) fp32: grad, hess, count.
 
@@ -89,12 +90,13 @@ def build_hist(
     being histogrammed — the replacement for gathering a dynamic row list,
     which XLA's static-shape model rules out).
     """
-    if resolve_backend(backend) == "pallas":
+    if resolve_backend(backend, platform=platform) == "pallas":
         from dryad_tpu.engine import pallas_hist
 
         if pallas_hist.supports(total_bins):
             return pallas_hist.build_hist_pallas(
-                Xb, g, h, mask, total_bins, axis_name=axis_name
+                Xb, g, h, mask, total_bins, axis_name=axis_name,
+                platform=platform,
             )
     N, F = Xb.shape
     B = int(total_bins)
@@ -235,6 +237,7 @@ def build_hist_segmented(
     precision: str = "exact",
     backend: str = "xla",
     rows_bound: int | None = None,
+    platform: str | None = None,
 ) -> jnp.ndarray:
     """Histograms for ``num_cols`` leaves -> (P, 3, F, B) fp32, O(N·F·B) work.
 
@@ -249,13 +252,13 @@ def build_hist_segmented(
     ``sel`` (N,) in [0, P]; P drops the row.  Deterministic: stable sort +
     fixed tile accumulation order.
     """
-    if resolve_backend(backend, segmented=True) == "pallas":
+    if resolve_backend(backend, segmented=True, platform=platform) == "pallas":
         from dryad_tpu.engine import pallas_hist
 
         if pallas_hist.supports(total_bins):
             return pallas_hist.build_hist_segmented_pallas(
                 Xb, g, h, sel, num_cols, total_bins, axis_name=axis_name,
-                rows_bound=rows_bound,
+                rows_bound=rows_bound, platform=platform,
             )
     N, F = Xb.shape
     B = int(total_bins)

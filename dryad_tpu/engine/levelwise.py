@@ -50,6 +50,7 @@ def grow_tree_levelwise(
     *,
     has_cat: bool = False,
     axis_name: str | None = None,
+    platform: str | None = None,
 ) -> dict[str, Any]:
     p = params
     N, F = Xb.shape
@@ -81,7 +82,8 @@ def grow_tree_levelwise(
     row_slot = jnp.where(bag_mask, 0, L).astype(jnp.int32)
     hist0 = build_hist(Xb, g, h, row_slot == 0, B,
                        rows_per_chunk=p.rows_per_chunk, axis_name=axis_name,
-                       precision=p.hist_precision, backend=p.hist_backend)
+                       precision=p.hist_precision, backend=p.hist_backend,
+                       platform=platform)
     G0, H0, C0 = root_stats(hist0)
     root = best(hist0, G0, H0, C0,
                 (jnp.int32(0) < depth_cap) & (C0 >= 2 * p.min_data_in_leaf))
@@ -220,6 +222,7 @@ def grow_tree_levelwise(
                 rows_per_chunk=p.rows_per_chunk, axis_name=axis_name,
                 precision=p.hist_precision, backend=p.hist_backend,
                 rows_bound=(N // 2 + 1) if bound_ok else None,
+                platform=platform,
             )
             if p.hist_subtraction:
                 hist_large = hists[sj] - hist_small

@@ -65,8 +65,8 @@ def supports(total_bins: int) -> bool:
     return int(total_bins) <= _MAX_PALLAS_BINS
 
 
-def _interpret() -> bool:
-    return jax.default_backend() == "cpu"
+def _interpret(platform: str | None = None) -> bool:
+    return (platform or jax.default_backend()) == "cpu"
 
 
 def _pow2_bins(B: int) -> int:
@@ -164,11 +164,12 @@ def _hist_kernel(tile_leaf_ref, tile_first_ref, x_ref, w_ref, o_ref, *,
 
 @functools.partial(
     jax.jit, static_argnames=("num_cols", "total_bins", "num_features",
-                              "axis_name")
+                              "axis_name", "platform")
 )
 def _hist_tiles(Xt, Wt, tile_leaf, tile_first, *, num_cols: int,
                 total_bins: int, num_features: int,
-                axis_name: str | None = None) -> jnp.ndarray:
+                axis_name: str | None = None,
+                platform: str | None = None) -> jnp.ndarray:
     """Core pallas_call: leaf-grouped tiles -> (P, 3, F, B) f32 histograms.
 
     Xt (n_fb, n_tiles, T, Fc) int32 bin ids (feature-chunked, -padded),
@@ -205,7 +206,7 @@ def _hist_tiles(Xt, Wt, tile_leaf, tile_first, *, num_cols: int,
         functools.partial(_hist_kernel, padded_bins=Bp),
         grid_spec=grid_spec,
         out_shape=out_shape,
-        interpret=_interpret(),
+        interpret=_interpret(platform),
     )(tile_leaf, tile_first, Xt, Wt)
 
     # kernel columns are (bin-major, feature-minor) per chunk — untangle
@@ -242,6 +243,7 @@ def build_hist_pallas(
     total_bins: int,
     *,
     axis_name: str | None = None,
+    platform: str | None = None,
 ) -> jnp.ndarray:
     """Single-leaf masked histogram -> (3, F, B) f32 (root / leaf-wise path).
 
@@ -267,6 +269,7 @@ def build_hist_pallas(
     hist = _hist_tiles(
         Xt, Wt, tile_leaf, tile_first,
         num_cols=1, total_bins=B, num_features=F, axis_name=axis_name,
+        platform=platform,
     )[0]
     if axis_name is not None:
         hist = jax.lax.psum(hist, axis_name)
@@ -337,6 +340,7 @@ def hist_from_plan(
     total_bins: int,
     *,
     axis_name: str | None = None,
+    platform: str | None = None,
 ) -> jnp.ndarray:
     """Histogram leaf-grouped rows given a precomputed tile plan."""
     N, F = Xb.shape
@@ -355,7 +359,7 @@ def hist_from_plan(
     hist = _hist_tiles(
         Xt, Wt, tile_leaf, tile_first,
         num_cols=int(num_cols), total_bins=B, num_features=F,
-        axis_name=axis_name,
+        axis_name=axis_name, platform=platform,
     )
     if axis_name is not None:
         hist = jax.lax.psum(hist, axis_name)
@@ -372,6 +376,7 @@ def build_hist_segmented_pallas(
     *,
     axis_name: str | None = None,
     rows_bound: int | None = None,
+    platform: str | None = None,
 ) -> jnp.ndarray:
     """Per-leaf histograms for a whole tree level -> (P, 3, F, B) f32.
 
@@ -384,5 +389,5 @@ def build_hist_segmented_pallas(
                                            rows_bound=rows_bound)
     return hist_from_plan(
         Xb, g, h, buf, tile_leaf, tile_first, num_cols, total_bins,
-        axis_name=axis_name,
+        axis_name=axis_name, platform=platform,
     )

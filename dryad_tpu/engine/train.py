@@ -40,10 +40,10 @@ _TREE_KEYS = ("feature", "threshold", "left", "right", "value", "is_cat",
               "cat_bitset", "gain")
 
 
-@partial(jax.jit, static_argnames=("p", "B", "has_cat", "mesh"),
-         donate_argnums=(4, 5))
-def _step_jit(p, B, has_cat, mesh, out, score, Xb, g_all, h_all, bag, fmask,
-              is_cat_feat, t, k):
+@partial(jax.jit, static_argnames=("p", "B", "has_cat", "mesh", "platform"),
+         donate_argnums=(5, 6))
+def _step_jit(p, B, has_cat, mesh, platform, out, score, Xb, g_all, h_all,
+              bag, fmask, is_cat_feat, t, k):
     """One (iteration, class) tree: grow, record into slot t, update scores.
 
     Module-level jit keyed on the static (params, bins, mesh) triple — the
@@ -58,11 +58,12 @@ def _step_jit(p, B, has_cat, mesh, out, score, Xb, g_all, h_all, bag, fmask,
         from dryad_tpu.engine.distributed import grow_sharded
 
         tree, leaves = grow_sharded(
-            p, B, has_cat, mesh, Xb, g, h, bag, fmask, is_cat_feat
+            p, B, has_cat, mesh, Xb, g, h, bag, fmask, is_cat_feat,
+            platform=platform,
         )
     else:
         tree = grow_any(p, B, Xb, g, h, bag, fmask, is_cat_feat,
-                        has_cat=has_cat)
+                        has_cat=has_cat, platform=platform)
         # a static depth bound keeps the traversal a fori_loop (a traced
         # bound lowers to a slower while_loop); depthwise growth has one
         depth_bound = (p.max_depth if p.growth == "depthwise" and p.max_depth > 0
@@ -228,16 +229,10 @@ def train_device(
         qoff_j = jnp.asarray(qoff)
 
     # the devices that actually run the step may differ from the process
-    # default backend (e.g. a CPU mesh forced on a TPU-attached process);
-    # force the XLA histogram there — plain 'auto' consults the process
-    # default and would pick the TPU-only Pallas kernel
-    if p.hist_backend == "auto":
-        from dryad_tpu.engine.histogram import resolve_backend
-
-        plat = (mesh.devices.flat[0].platform if mesh is not None
-                else jax.devices()[0].platform)
-        if resolve_backend("auto", segmented=True, platform=plat) == "xla":
-            p = p.replace(hist_backend="xla")
+    # default backend (e.g. a CPU mesh forced on a TPU-attached process) —
+    # resolve 'auto' against the real target platform all the way down
+    plat = (mesh.devices.flat[0].platform if mesh is not None
+            else jax.devices()[0].platform)
 
     # static jit key: strip fields that cannot affect the compiled programs
     # so e.g. a warmup run with fewer trees reuses the same executables
@@ -248,8 +243,8 @@ def train_device(
                           rank_row, rank_col, rank_Q, rank_S)
 
     def step(out, score, g_all, h_all, bag, fmask, t, k):
-        return _step_jit(p_key, B, has_cat, mesh, out, score, Xb, g_all, h_all,
-                         bag, fmask, is_cat_feat, t, k)
+        return _step_jit(p_key, B, has_cat, mesh, plat, out, score, Xb,
+                         g_all, h_all, bag, fmask, is_cat_feat, t, k)
 
     # ---- resume / warm start -------------------------------------------------
     out = _empty_out_device(T, p.max_nodes, CAT_WORDS)

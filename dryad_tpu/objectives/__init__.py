@@ -22,28 +22,38 @@ def _sigmoid_np(x):
 
 
 class Binary:
-    """Binary cross-entropy on logit scores (Higgs config, BASELINE.json:7)."""
+    """Binary cross-entropy on logit scores (Higgs config, BASELINE.json:7).
+
+    ``scale_pos_weight`` multiplies the positive class's grad/hess (and its
+    share of the init score) — an implicit per-row weight composing
+    multiplicatively with explicit sample weights.
+    """
 
     name = "binary"
     num_outputs = 1
 
-    @staticmethod
-    def init_score(y: np.ndarray, weight=None) -> float:
-        w = np.ones_like(y) if weight is None else weight
+    def __init__(self, scale_pos_weight: float = 1.0):
+        self.spw = float(scale_pos_weight)
+
+    def _weights_np(self, y, weight):
+        w = np.ones_like(y, np.float32) if weight is None else np.asarray(weight, np.float32)
+        if self.spw != 1.0:
+            w = w * np.where(y > 0.5, np.float32(self.spw), np.float32(1.0))
+        return w
+
+    def init_score(self, y: np.ndarray, weight=None) -> float:
+        w = self._weights_np(np.asarray(y, np.float32), weight)
         p = float(np.clip(np.average(y, weights=w), 1e-12, 1 - 1e-12))
         return float(np.log(p / (1 - p)))
 
-    @staticmethod
-    def grad_hess_np(score: np.ndarray, y: np.ndarray, weight=None):
+    def grad_hess_np(self, score: np.ndarray, y: np.ndarray, weight=None):
         p = _sigmoid_np(score.astype(np.float32))
         g = (p - y).astype(np.float32)
         h = (p * (1.0 - p)).astype(np.float32)
-        if weight is not None:
-            g, h = g * weight, h * weight
-        return g, h
+        w = self._weights_np(np.asarray(y, np.float32), weight)
+        return g * w, h * w
 
-    @staticmethod
-    def grad_hess_jax(score, y, weight=None):
+    def grad_hess_jax(self, score, y, weight=None):
         import jax.numpy as jnp  # local: keep numpy path importable without jax init
 
         p = jnp.asarray(1.0, jnp.float32) / (1.0 + jnp.exp(-score))
@@ -51,6 +61,9 @@ class Binary:
         h = p * (1.0 - p)
         if weight is not None:
             g, h = g * weight, h * weight
+        if self.spw != 1.0:
+            wp = jnp.where(y > 0.5, jnp.float32(self.spw), jnp.float32(1.0))
+            g, h = g * wp, h * wp
         return g, h
 
     @staticmethod
@@ -214,7 +227,7 @@ class LambdaRank:
 
 def get_objective(params) -> object:
     if params.objective == "binary":
-        return Binary()
+        return Binary(params.scale_pos_weight)
     if params.objective == "regression":
         return Regression()
     if params.objective == "multiclass":

@@ -26,8 +26,8 @@ def test_binary_matches_autodiff(rng):
 
     s = rng.normal(size=256).astype(np.float32)
     y = (rng.uniform(size=256) < 0.5).astype(np.float32)
-    g_np, h_np = Binary.grad_hess_np(s, y)
-    g_jx, h_jx = Binary.grad_hess_jax(jnp.array(s), jnp.array(y))
+    g_np, h_np = Binary().grad_hess_np(s, y)
+    g_jx, h_jx = Binary().grad_hess_jax(jnp.array(s), jnp.array(y))
     np.testing.assert_allclose(g_np, np.asarray(g_jx), rtol=1e-5, atol=1e-6)
     np.testing.assert_allclose(h_np, np.asarray(h_jx), rtol=1e-5, atol=1e-6)
 
@@ -90,3 +90,35 @@ def test_lambdarank_no_pairs_zero_grad():
     y = np.zeros(3, np.float32)  # all same relevance → no pairs
     g, h = obj.grad_hess_np(s, y, query_offsets=np.array([0, 3]))
     assert (g == 0).all() and (h == 0).all()
+
+
+def test_scale_pos_weight_shifts_predictions():
+    import dryad_tpu as dryad
+    from dryad_tpu.datasets import higgs_like
+
+    X, y = higgs_like(3000, seed=101)
+    ds = dryad.Dataset(X, y, max_bins=32)
+    base = dict(objective="binary", num_trees=10, num_leaves=15, max_bins=32)
+    b1 = dryad.train(base, ds, backend="cpu")
+    b2 = dryad.train(dict(base, scale_pos_weight=5.0), ds, backend="cpu")
+    p1 = b1.predict_binned(ds.X_binned)
+    p2 = b2.predict_binned(ds.X_binned)
+    assert p2.mean() > p1.mean() + 0.05   # positives up-weighted
+    # CPU/TPU parity with spw
+    b3 = dryad.train(dict(base, scale_pos_weight=5.0), ds, backend="tpu")
+    np.testing.assert_array_equal(b2.feature, b3.feature)
+
+
+def test_pred_leaf_indices():
+    import dryad_tpu as dryad
+    from dryad_tpu.datasets import higgs_like
+
+    X, y = higgs_like(1000, seed=103)
+    ds = dryad.Dataset(X, y, max_bins=32)
+    b = dryad.train(dict(objective="binary", num_trees=4, num_leaves=7,
+                         max_bins=32), ds, backend="cpu")
+    leaves = b.predict_binned(ds.X_binned, pred_leaf=True)
+    assert leaves.shape == (1000, 4) and leaves.dtype == np.int32
+    # every reported node is a leaf of its tree
+    for t in range(4):
+        assert (b.feature[t, leaves[:, t]] == -1).all()
