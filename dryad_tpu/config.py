@@ -100,6 +100,9 @@ class Params:
     # evaluation / early stopping
     metric: str = ""              # "" = objective default
     early_stopping_rounds: int = 0  # 0 = disabled
+    # evaluate every k-th iteration (each eval forces a device->host fetch,
+    # ~100ms through a remote tunnel); early stopping checks at that cadence
+    eval_period: int = 1
     # binary: multiply the positive class's grad/hess (imbalanced data)
     scale_pos_weight: float = 1.0
     # LambdaMART
@@ -167,6 +170,8 @@ class Params:
             raise ValueError("subsample/colsample must be in (0, 1]")
         if not (self.scale_pos_weight > 0.0):
             raise ValueError("scale_pos_weight must be > 0")
+        if self.eval_period < 1:
+            raise ValueError("eval_period must be >= 1")
         if self.hist_backend not in ("auto", "xla", "pallas"):
             raise ValueError("hist_backend must be auto|xla|pallas")
         if self.hist_precision not in ("exact", "fast"):

@@ -329,7 +329,10 @@ def train_cpu(
                 vscore[:, k] += out["value"][t, vleaves]
 
         info: dict = {"iteration": it}
-        if valid is not None:
+        # eval every eval_period-th iteration, always including the last so
+        # the training tail is never silently unscored
+        eval_now = (it + 1) % p.eval_period == 0 or it + 1 == T // K
+        if valid is not None and eval_now:
             from dryad_tpu.metrics import evaluate_raw
 
             name, value, higher = evaluate_raw(

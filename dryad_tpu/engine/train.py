@@ -331,7 +331,10 @@ def train_device(
 
         info: dict = {"iteration": it}
         stop = False
-        if valid is not None:
+        # eval every eval_period-th iteration, always including the last so
+        # the training tail is never silently unscored
+        eval_now = (it + 1) % p.eval_period == 0 or it + 1 == T // K
+        if valid is not None and eval_now:
             from dryad_tpu.metrics import evaluate_raw
 
             vs = np.asarray(vscore)  # forced sync: metric eval on host

@@ -53,3 +53,19 @@ def test_resume_incompatible_raises():
 def test_categorical_max_bins_guard():
     with pytest.raises(ValueError, match="bitset"):
         dryad.Params.from_dict({"max_bins": 512, "categorical_features": [0]})
+
+
+def test_eval_period_evaluates_tail():
+    import dryad_tpu as dryad
+    from dryad_tpu.datasets import higgs_like
+
+    X, y = higgs_like(2000, seed=107)
+    ds = dryad.Dataset(X, y, max_bins=32)
+    valid = ds.bind(X[:500], y[:500])
+    infos = []
+    b = dryad.train(dict(objective="binary", num_trees=20, num_leaves=7,
+                         max_bins=32, eval_period=7), ds, [valid],
+                    backend="cpu", callback=lambda it, i: infos.append(i))
+    evaled = [i["iteration"] for i in infos if len(i) > 1]
+    assert evaled == [6, 13, 19]       # every 7th plus the forced final
+    assert b.best_iteration > 0

@@ -118,3 +118,18 @@ def test_train_pallas_with_bagging_matches_xla_trees():
     np.testing.assert_array_equal(b_xla.feature, b_pl.feature)
     np.testing.assert_array_equal(b_xla.threshold, b_pl.threshold)
     np.testing.assert_allclose(b_xla.value, b_pl.value, atol=1e-4)
+
+
+def test_leafwise_pallas_matches_xla_trees():
+    # leaf-wise growth routed through the masked Pallas histogram
+    import dryad_tpu as dryad
+    from dryad_tpu.datasets import higgs_like
+
+    X, y = higgs_like(4000, seed=13)
+    ds = dryad.Dataset(X, y, max_bins=32)
+    base = dict(objective="binary", num_trees=4, num_leaves=15, max_bins=32)
+    b_xla = dryad.train(dict(base, hist_backend="xla"), ds, backend="tpu")
+    b_pl = dryad.train(dict(base, hist_backend="pallas"), ds, backend="tpu")
+    np.testing.assert_array_equal(b_xla.feature, b_pl.feature)
+    np.testing.assert_array_equal(b_xla.threshold, b_pl.threshold)
+    np.testing.assert_allclose(b_xla.value, b_pl.value, atol=1e-4)
