@@ -11,6 +11,7 @@ surface (BASELINE.json:5).  The ``dryad`` package is an alias of this one.
 
 from __future__ import annotations
 
+import contextlib
 from typing import Any, Mapping, Optional
 
 import numpy as np
@@ -35,6 +36,7 @@ def train(
     checkpoint_dir: Optional[str] = None,
     checkpoint_every: int = 10,
     resume: bool = False,
+    profile_dir: Optional[str] = None,
     **kw: Any,
 ) -> Booster:
     """Train a booster.  backend: 'auto' (TPU if available), 'tpu', 'cpu'.
@@ -45,6 +47,8 @@ def train(
     uninterrupted run bit for bit — see dryad_tpu/checkpoint.py).
     ``callbacks`` is a list of ``fn(iteration, info)`` (see
     dryad_tpu/callbacks.py); ``callback`` remains as a single-function alias.
+    ``profile_dir`` captures a jax.profiler trace of the whole training run
+    (open with XProf/Perfetto — SURVEY.md §5 tracing).
     """
     p = make_params(params, **kw)
     if train_set is None:
@@ -69,17 +73,26 @@ def train(
 
     cb = combine(([callback] if callback else []) + list(callbacks or []))
 
-    if backend == "cpu":
-        from dryad_tpu.cpu.trainer import train_cpu
+    if backend not in ("cpu", "tpu"):
+        raise ValueError(f"unknown backend {backend!r}")
 
-        return train_cpu(p, train_set, valid, init_booster=init_booster,
-                         callback=cb, checkpointer=checkpointer)
-    if backend == "tpu":
+    if profile_dir is not None:
+        import jax
+
+        trace_ctx = jax.profiler.trace(profile_dir)
+    else:
+        trace_ctx = contextlib.nullcontext()
+
+    with trace_ctx:
+        if backend == "cpu":
+            from dryad_tpu.cpu.trainer import train_cpu
+
+            return train_cpu(p, train_set, valid, init_booster=init_booster,
+                             callback=cb, checkpointer=checkpointer)
         from dryad_tpu.engine.train import train_device
 
         return train_device(p, train_set, valid, init_booster=init_booster,
                             callback=cb, checkpointer=checkpointer)
-    raise ValueError(f"unknown backend {backend!r}")
 
 
 def predict(

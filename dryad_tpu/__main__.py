@@ -89,6 +89,7 @@ def cmd_train(args) -> int:
             checkpoint_dir=args.checkpoint_dir,
             checkpoint_every=args.checkpoint_every,
             resume=args.resume,
+            profile_dir=args.profile_dir,
         )
     finally:
         if logger is not None:
@@ -151,6 +152,7 @@ def main(argv=None) -> int:
     t.add_argument("--checkpoint-every", type=int, default=10)
     t.add_argument("--resume", action="store_true")
     t.add_argument("--log-jsonl", help="per-iteration metrics JSONL path")
+    t.add_argument("--profile-dir", help="capture a jax.profiler trace here")
     t.add_argument("--log-period", type=int, default=1)
     t.add_argument("--quiet", action="store_true")
     t.set_defaults(fn=cmd_train)

@@ -1,0 +1,99 @@
+"""Variant test: feature-major (Fc, T) tiles — no XLA lane padding on Xt.
+
+Compares correctness + speed of the current (T, Fc)-tile kernel vs a
+feature-major variant where the one-hot is built as (Fc*Bp, T) via sublane
+tiling and the dot contracts both operands' trailing dim.
+"""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+N, F, B = 200_000, 28, 256
+T, Fc, Bp = 512, 32, 256
+n_tiles = N // T + 1
+n_fb = 1
+W = 128
+
+
+def kern_cur(x_ref, w_ref, o_ref):   # x (1,1,T,Fc)
+    x = x_ref[0, 0]
+    shift = Fc.bit_length() - 1
+    x_rep = pltpu.repeat(x, Bp, axis=1)
+    iota_b = jax.lax.broadcasted_iota(jnp.int32, (T, Fc * Bp), 1) >> shift
+    onehot = (x_rep == iota_b).astype(jnp.bfloat16)
+    part = jax.lax.dot_general(w_ref[0], onehot, (((1,), (0,)), ((), ())),
+                               preferred_element_type=jnp.float32)[:8]
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        o_ref[0] = part
+
+    @pl.when(i != 0)
+    def _():
+        o_ref[0] = o_ref[0] + part
+
+
+def kern_fm(x_ref, w_ref, o_ref):    # x (1,1,Fc,T)
+    x = x_ref[0, 0]
+    shift = Fc.bit_length() - 1
+    x_rep = pltpu.repeat(x, Bp, axis=0)                       # (Fc*Bp, T)
+    iota_b = jax.lax.broadcasted_iota(jnp.int32, (Fc * Bp, T), 0) >> shift
+    onehot = (x_rep == iota_b).astype(jnp.bfloat16)
+    part = jax.lax.dot_general(w_ref[0], onehot, (((1,), (1,)), ((), ())),
+                               preferred_element_type=jnp.float32)[:8]
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        o_ref[0] = part
+
+    @pl.when(i != 0)
+    def _():
+        o_ref[0] = o_ref[0] + part
+
+
+def bench(name, kern, Xt):
+    def call(s):
+        return pl.pallas_call(
+            kern,
+            grid_spec=pl.GridSpec(
+                grid=(n_tiles,),
+                in_specs=[pl.BlockSpec((1, 1) + Xt.shape[2:], lambda i: (0, i, 0, 0)),
+                          pl.BlockSpec((1, W, T), lambda i: (i, 0, 0))],
+                out_specs=pl.BlockSpec((1, 8, Fc * Bp), lambda i: (0, 0, 0)),
+            ),
+            out_shape=jax.ShapeDtypeStruct((1, 8, Fc * Bp), jnp.float32),
+        )(Xt, Wt + s.astype(jnp.bfloat16))
+    f = jax.jit(call)
+    try:
+        s = jnp.float32(0.0)
+        out0 = np.asarray(f(s))
+        t0 = time.perf_counter()
+        for _ in range(10):
+            out = f(s)
+            s = jnp.ravel(out)[0].astype(jnp.float32) * 1e-30
+        _ = float(s)
+        print(f"{name}: {(time.perf_counter()-t0)/10*1e3:8.2f} ms")
+        return out0
+    except Exception as ex:
+        print(f"{name} FAILED: {str(ex)[:250]}")
+        return None
+
+
+rng = np.random.default_rng(0)
+Xrows = rng.integers(0, B, size=(n_tiles, T, Fc)).astype(np.int32)
+Wt = jnp.asarray(rng.normal(size=(n_tiles, W, T)).astype(np.float32)).astype(jnp.bfloat16)
+
+Xt_cur = jnp.asarray(Xrows[None])                       # (1, n_tiles, T, Fc)
+Xt_fm = jnp.asarray(Xrows.transpose(0, 2, 1)[None])     # (1, n_tiles, Fc, T)
+
+a = bench("current (T,Fc) tiles  ", kern_cur, Xt_cur)
+b = bench("feature-major (Fc,T)  ", kern_fm, Xt_fm)
+if a is not None and b is not None:
+    print("outputs equal:", np.allclose(a, b, atol=1e-3))
+print("HBM bytes: cur(padded)", n_tiles*T*128*4, " fm(unpadded)", n_tiles*Fc*T*4)

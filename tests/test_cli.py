@@ -68,3 +68,15 @@ def test_cli_csr_npz_train_predict(tmp_path):
     assert rc == 0
     preds = np.load(tmp_path / "p.npy")
     assert preds.shape == (2000,) and auc(y, preds) > 0.55
+
+
+def test_profile_dir_captures_trace(tmp_path):
+    import dryad_tpu as dryad
+
+    X, y = higgs_like(1000, seed=47)
+    ds = dryad.Dataset(X, y, max_bins=16)
+    pdir = str(tmp_path / "trace")
+    dryad.train(dict(objective="binary", num_trees=2, num_leaves=7,
+                     max_bins=16), ds, backend="tpu", profile_dir=pdir)
+    files = [os.path.join(dp, f) for dp, _, fs in os.walk(pdir) for f in fs]
+    assert files, "no profiler trace written"
