@@ -99,7 +99,11 @@ class Params:
     monotone_constraints: tuple[int, ...] = ()
     # evaluation / early stopping
     metric: str = ""              # "" = objective default
-    early_stopping_rounds: int = 0  # 0 = disabled
+    # 0 = disabled.  Counts EVALUATIONS without improvement, not iterations:
+    # with eval_period > 1 the effective patience in iterations is
+    # early_stopping_rounds * eval_period (LightGBM counts iterations, but
+    # it also evaluates every iteration — at eval_period=1 the two agree).
+    early_stopping_rounds: int = 0
     # evaluate every k-th iteration (each eval forces a device->host fetch,
     # ~100ms through a remote tunnel); early stopping checks at that cadence
     eval_period: int = 1

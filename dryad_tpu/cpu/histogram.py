@@ -61,9 +61,17 @@ class SplitInfo:
     c_left: float
 
 
-def leaf_output(G: float, H: float, lambda_l2: float, learning_rate: float) -> float:
-    """Newton leaf value with shrinkage applied (fp32-rounded, both backends)."""
-    return float(np.float32(-(np.float32(G) / np.float32(H + lambda_l2)) * np.float32(learning_rate)))
+def leaf_output(G: float, H: float, lambda_l2: float, learning_rate: float,
+                lo: float = -np.inf, hi: float = np.inf) -> float:
+    """Newton leaf value with shrinkage applied (fp32-rounded, both backends).
+
+    ``lo``/``hi`` are the node's monotone output bounds (f32 values tracked
+    by the growers); the raw Newton value is clamped before shrinkage,
+    exactly as the device ``finalize_leaf_values`` does.
+    """
+    raw = np.float32(-(np.float32(G) / np.float32(H + lambda_l2)))
+    raw = np.float32(min(max(raw, np.float32(lo)), np.float32(hi)))
+    return float(np.float32(raw * np.float32(learning_rate)))
 
 
 def find_best_split(
@@ -80,6 +88,8 @@ def find_best_split(
     is_categorical: np.ndarray | None = None,
     cat_smooth: float = 10.0,
     monotone: np.ndarray | None = None,
+    lo: float = -np.inf,
+    hi: float = np.inf,
 ) -> SplitInfo | None:
     """Best (feature, threshold) over the histogram; None when nothing valid.
 
@@ -90,7 +100,6 @@ def find_best_split(
     """
     hg, hh, hc = hist[0], hist[1], hist[2]
     F, B = hg.shape
-    parent_score = G * G / (H + lambda_l2)
 
     GL = np.cumsum(hg, axis=1)
     HL = np.cumsum(hh, axis=1)
@@ -118,15 +127,24 @@ def find_best_split(
     if feature_mask is not None:
         valid &= feature_mask[:, None]
     if monotone is not None:
-        # split-level monotone enforcement: a +1 (-1) feature may only split
-        # where the right child's Newton value is >= (<=) the left's;
+        # LightGBM-"basic" monotone mode (the device split.py mirrors this):
+        # child outputs clamped to the node's inherited [lo, hi] bounds, gain
+        # computed with the clamped outputs, and a ±1 feature may only split
+        # where the clamped right value is >=/<= the clamped left value;
         # unconstrained (0) features pass regardless of NaN child values
         with np.errstate(invalid="ignore", divide="ignore"):
-            vl = -GL / (HL + lambda_l2)
-            vr = -GR / (HR + lambda_l2)
-            valid &= (monotone[:, None] == 0) | (monotone[:, None] * (vr - vl) >= 0)
-    with np.errstate(invalid="ignore", divide="ignore"):
-        gain = 0.5 * (GL * GL / (HL + lambda_l2) + GR * GR / (HR + lambda_l2) - parent_score)
+            wl = np.clip(-GL / (HL + lambda_l2), lo, hi)
+            wr = np.clip(-GR / (HR + lambda_l2), lo, hi)
+            wp = min(max(-G / (H + lambda_l2), lo), hi)
+            valid &= (monotone[:, None] == 0) | (monotone[:, None] * (wr - wl) >= 0)
+            red_l = -(GL * wl + 0.5 * (HL + lambda_l2) * wl * wl)
+            red_r = -(GR * wr + 0.5 * (HR + lambda_l2) * wr * wr)
+            red_p = -(G * wp + 0.5 * (H + lambda_l2) * wp * wp)
+            gain = red_l + red_r - red_p
+    else:
+        with np.errstate(invalid="ignore", divide="ignore"):
+            parent_score = G * G / (H + lambda_l2)
+            gain = 0.5 * (GL * GL / (HL + lambda_l2) + GR * GR / (HR + lambda_l2) - parent_score)
     gain = np.where(valid, gain, NEG_INF)
 
     flat = int(np.argmax(gain))

@@ -89,6 +89,13 @@ class _TreeGrower:
         self.Xb = Xb
         self.B = total_bins
         self.is_cat_feat = is_categorical
+        self.mono = None
+        if params.monotone_constraints and any(params.monotone_constraints):
+            # pad/truncate to F (same policy as the device _monotone_array)
+            F = Xb.shape[1]
+            self.mono = np.zeros(F, np.float64)
+            k = min(F, len(params.monotone_constraints))
+            self.mono[:k] = params.monotone_constraints[:k]
 
     def grow(
         self,
@@ -112,6 +119,9 @@ class _TreeGrower:
         leaf_G = np.zeros(L)
         leaf_H = np.zeros(L)
         leaf_depth = np.zeros(L, np.int64)
+        # monotone output bounds per slot (f32 values, as the device tracks)
+        leaf_lo = np.full(L, -np.inf, np.float32)
+        leaf_hi = np.full(L, np.inf, np.float32)
 
         hist0 = build_hist(self.Xb, g, h, rows, self.B)
         # canonical leaf totals: feature-0 histogram sums (device derives the
@@ -119,7 +129,8 @@ class _TreeGrower:
         G0, H0, C0 = float(hist0[0, 0].sum()), float(hist0[1, 0].sum()), float(rows.size)
         leaf_node[0], leaf_rows[0], leaf_hist[0] = 0, rows, hist0
         leaf_G[0], leaf_H[0] = G0, H0
-        leaf_split[0] = self._best(hist0, G0, H0, C0, 0, max_depth, feat_mask)
+        leaf_split[0] = self._best(hist0, G0, H0, C0, 0, max_depth, feat_mask,
+                                   leaf_lo[0], leaf_hi[0])
         leaf_gain[0] = leaf_split[0].gain if leaf_split[0] else -np.inf
 
         num_nodes, max_seen_depth = 1, 0
@@ -167,6 +178,27 @@ class _TreeGrower:
             GL, HL, CL = split.g_left, split.h_left, split.c_left
             GR, HR, CR = pG - GL, pH - HL, float(prows.size) - CL
 
+            # monotone bounds for the children: on a ±1 split feature the
+            # midpoint of the clamped child outputs separates the subtrees
+            # (LightGBM "basic" mode); m=0 splits inherit the parent bounds.
+            # f32 arithmetic mirrors the device grower bit for bit.
+            lo_p, hi_p = leaf_lo[s], leaf_hi[s]
+            lo_l = lo_r = lo_p
+            hi_l = hi_r = hi_p
+            if self.mono is not None:
+                m = self.mono[split.feature]
+                if m != 0:
+                    lam32 = np.float32(self.p.lambda_l2)
+                    wl = np.float32(min(max(
+                        np.float32(-(np.float32(GL) / (np.float32(HL) + lam32))), lo_p), hi_p))
+                    wr = np.float32(min(max(
+                        np.float32(-(np.float32(GR) / (np.float32(HR) + lam32))), lo_p), hi_p))
+                    mid = np.float32(np.float32(0.5) * (wl + wr))
+                    if m > 0:
+                        hi_l, lo_r = mid, mid
+                    else:
+                        lo_l, hi_r = mid, mid
+
             # histograms: smaller child direct, larger by subtraction
             left_smaller = rows_l.size <= rows_r.size
             srows = rows_l if left_smaller else rows_r
@@ -178,16 +210,18 @@ class _TreeGrower:
             hist_l, hist_r = (shist, ohist) if left_smaller else (ohist, shist)
 
             sl, sr = s, k + 1
-            for slot, node_id, r_, hist_, G_, H_, C_ in (
-                (sl, left_id, rows_l, hist_l, GL, HL, CL),
-                (sr, right_id, rows_r, hist_r, GR, HR, CR),
+            for slot, node_id, r_, hist_, G_, H_, C_, lo_, hi_ in (
+                (sl, left_id, rows_l, hist_l, GL, HL, CL, lo_l, hi_l),
+                (sr, right_id, rows_r, hist_r, GR, HR, CR, lo_r, hi_r),
             ):
                 leaf_node[slot] = node_id
                 leaf_rows[slot] = r_
                 leaf_hist[slot] = hist_
                 leaf_G[slot], leaf_H[slot] = G_, H_
                 leaf_depth[slot] = depth + 1
-                sp = self._best(hist_, G_, H_, C_, depth + 1, max_depth, feat_mask)
+                leaf_lo[slot], leaf_hi[slot] = lo_, hi_
+                sp = self._best(hist_, G_, H_, C_, depth + 1, max_depth, feat_mask,
+                                lo_, hi_)
                 leaf_split[slot] = sp
                 leaf_gain[slot] = sp.gain if sp else -np.inf
 
@@ -198,20 +232,15 @@ class _TreeGrower:
                 continue
             out["feature"][t, node] = -1
             out["value"][t, node] = leaf_output(
-                leaf_G[slot], leaf_H[slot], self.p.lambda_l2, self.p.learning_rate
+                leaf_G[slot], leaf_H[slot], self.p.lambda_l2, self.p.learning_rate,
+                leaf_lo[slot], leaf_hi[slot],
             )
         return max_seen_depth
 
-    def _best(self, hist, G, H, C, depth, max_depth, feat_mask):
+    def _best(self, hist, G, H, C, depth, max_depth, feat_mask,
+              lo=-np.inf, hi=np.inf):
         if depth >= max_depth or C < 2 * self.p.min_data_in_leaf:
             return None
-        mono = None
-        if self.p.monotone_constraints:
-            # pad/truncate to F (same policy as the device _monotone_array)
-            F = self.Xb.shape[1]
-            mono = np.zeros(F, np.float64)
-            k = min(F, len(self.p.monotone_constraints))
-            mono[:k] = self.p.monotone_constraints[:k]
         return find_best_split(
             hist, G, H, C,
             lambda_l2=self.p.lambda_l2,
@@ -220,7 +249,9 @@ class _TreeGrower:
             min_split_gain=self.p.min_split_gain,
             feature_mask=feat_mask,
             is_categorical=self.is_cat_feat,
-            monotone=mono,
+            monotone=self.mono,
+            lo=float(lo),
+            hi=float(hi),
         )
 
 
@@ -332,6 +363,7 @@ def train_cpu(
         # eval every eval_period-th iteration, always including the last so
         # the training tail is never silently unscored
         eval_now = (it + 1) % p.eval_period == 0 or it + 1 == T // K
+        stop = False
         if valid is not None and eval_now:
             from dryad_tpu.metrics import evaluate_raw
 
@@ -346,10 +378,10 @@ def train_cpu(
             else:
                 stale += 1
             if p.early_stopping_rounds and stale >= p.early_stopping_rounds:
-                if callback is not None:
-                    callback(it, info)
+                stop = True
                 T = (it + 1) * K  # trim unfilled trailing trees
-                break
+        # stop falls through to the callback and the due boundary checkpoint
+        # before breaking — same checkpoint stream as the device trainer
         if callback is not None:
             callback(it, info)
         if checkpointer is not None and checkpointer.due(it + 1):
@@ -358,6 +390,8 @@ def train_cpu(
                               max_depth_seen, best_iteration, best_value, stale),
                 it + 1,
             )
+        if stop:
+            break
 
     return _make_booster(p, data.mapper, out, T, init, max_depth_seen,
                          best_iteration, best_value, stale)

@@ -74,6 +74,23 @@ def _monotone_array(p: Params, F: int):
     return jnp.asarray(mono, jnp.int32)
 
 
+def child_bounds(mono, sf, GL, HL, GR, HR, lam, lo_p, hi_p):
+    """Monotone output bounds for the two children of a split (LightGBM
+    "basic" mode): the midpoint of the clamped child outputs separates the
+    subtrees across a ±1 split feature; m=0 splits inherit the parent
+    bounds.  Shared by both device growers; cpu/trainer.py mirrors the same
+    f32 arithmetic.  Works elementwise on scalars or (P,) candidate rows."""
+    wl = jnp.clip(-(GL / (HL + lam)), lo_p, hi_p)
+    wr = jnp.clip(-(GR / (HR + lam)), lo_p, hi_p)
+    mid = jnp.float32(0.5) * (wl + wr)
+    m = mono[jnp.maximum(sf, 0)]
+    lo_l = jnp.where(m < 0, mid, lo_p)
+    hi_l = jnp.where(m > 0, mid, hi_p)
+    lo_r = jnp.where(m > 0, mid, lo_p)
+    hi_r = jnp.where(m < 0, mid, hi_p)
+    return lo_l, hi_l, lo_r, hi_r
+
+
 def root_stats(hist0: jnp.ndarray):
     """Canonical leaf totals = feature-0 histogram sums (cpu/trainer.py
     contract) — shared by both growers so the derivation can never diverge."""
@@ -81,9 +98,16 @@ def root_stats(hist0: jnp.ndarray):
 
 
 def finalize_leaf_values(p: Params, M: int, slot_node, slot_G, slot_H,
-                         value: jnp.ndarray) -> jnp.ndarray:
-    """Newton leaf values with shrinkage, fp32, scattered to leaf nodes."""
-    vals = -(slot_G / (slot_H + jnp.float32(p.lambda_l2))) * jnp.float32(p.learning_rate)
+                         value: jnp.ndarray, slot_lo=None, slot_hi=None) -> jnp.ndarray:
+    """Newton leaf values with shrinkage, fp32, scattered to leaf nodes.
+
+    ``slot_lo``/``slot_hi`` (monotone output bounds) clamp the raw Newton
+    value before shrinkage; pass None when unconstrained so the compiled
+    program is unchanged."""
+    raw = -(slot_G / (slot_H + jnp.float32(p.lambda_l2)))
+    if slot_lo is not None:
+        raw = jnp.clip(raw, slot_lo, slot_hi)
+    vals = raw * jnp.float32(p.learning_rate)
     idx = jnp.where(slot_node >= 0, slot_node, M)
     return value.at[idx].set(vals, mode="drop")
 
@@ -128,7 +152,7 @@ def grow_tree(
 
     mono = _monotone_array(p, F)
 
-    def best(hist, G, H, C, depth):
+    def best(hist, G, H, C, depth, lo=None, hi=None):
         allow = (depth < depth_cap) & (C >= 2 * p.min_data_in_leaf)
         return find_best_split(
             hist, G, H, C,
@@ -141,6 +165,8 @@ def grow_tree(
             allow=allow,
             has_cat=has_cat,
             monotone=mono,
+            lo=lo,
+            hi=hi,
         )
 
     def hist_of(mask):
@@ -161,7 +187,8 @@ def grow_tree(
     row_slot = jnp.where(bag_mask, 0, L).astype(jnp.int32)
     hist0 = hist_of(row_slot == 0)
     G0, H0, C0 = root_stats(hist0)
-    root = best(hist0, G0, H0, C0, jnp.int32(0))
+    ninf, pinf = jnp.float32(-jnp.inf), jnp.float32(jnp.inf)
+    root = best(hist0, G0, H0, C0, jnp.int32(0), ninf, pinf)
 
     st = {
         "row_slot": row_slot,
@@ -171,6 +198,8 @@ def grow_tree(
         "slot_H": jnp.zeros((L,), jnp.float32).at[0].set(H0),
         "slot_C": jnp.zeros((L,), jnp.float32).at[0].set(C0),
         "slot_depth": jnp.zeros((L,), jnp.int32),
+        "slot_lo": jnp.full((L,), ninf, jnp.float32),
+        "slot_hi": jnp.full((L,), pinf, jnp.float32),
         "sp_feature": jnp.full((L,), -1, jnp.int32).at[0].set(root.feature),
         "sp_thresh": jnp.zeros((L,), jnp.int32).at[0].set(root.threshold),
         "sp_GL": jnp.zeros((L,), jnp.float32).at[0].set(root.g_left),
@@ -250,8 +279,15 @@ def grow_tree(
         hists = st["hists"].at[s].set(hist_l).at[new_r].set(hist_r)
 
         depth_c = st["slot_depth"][s] + 1
-        res_l = best(hist_l, GL, HL, CL, depth_c)
-        res_r = best(hist_r, GR, HR, CR, depth_c)
+        lo_p, hi_p = st["slot_lo"][s], st["slot_hi"][s]
+        if mono is not None:
+            lo_l, hi_l, lo_r, hi_r = child_bounds(
+                mono, sf, GL, HL, GR, HR, jnp.float32(p.lambda_l2), lo_p, hi_p)
+        else:
+            lo_l = lo_r = lo_p
+            hi_l = hi_r = hi_p
+        res_l = best(hist_l, GL, HL, CL, depth_c, lo_l, hi_l)
+        res_r = best(hist_r, GR, HR, CR, depth_c, lo_r, hi_r)
 
         def put(a, vl, vr):
             return a.at[s].set(vl).at[new_r].set(vr)
@@ -264,6 +300,8 @@ def grow_tree(
             "slot_H": put(st["slot_H"], HL, HR),
             "slot_C": put(st["slot_C"], CL, CR),
             "slot_depth": put(st["slot_depth"], depth_c, depth_c),
+            "slot_lo": put(st["slot_lo"], lo_l, lo_r),
+            "slot_hi": put(st["slot_hi"], hi_l, hi_r),
             "sp_feature": put(st["sp_feature"], res_l.feature, res_r.feature),
             "sp_thresh": put(st["sp_thresh"], res_l.threshold, res_r.threshold),
             "sp_GL": put(st["sp_GL"], res_l.g_left, res_r.g_left),
@@ -295,8 +333,11 @@ def grow_tree(
     st = jax.lax.fori_loop(0, L - 1, body, st)
 
     # ---- finalize leaf values + node bitsets (shared helpers) ---------------
-    value = finalize_leaf_values(p, M, st["slot_node"], st["slot_G"], st["slot_H"],
-                                 st["value"])
+    value = finalize_leaf_values(
+        p, M, st["slot_node"], st["slot_G"], st["slot_H"], st["value"],
+        slot_lo=st["slot_lo"] if mono is not None else None,
+        slot_hi=st["slot_hi"] if mono is not None else None,
+    )
     cat_bitset = pack_cat_bitset(st["cat_mask_nodes"], M)
 
     return {

@@ -29,7 +29,12 @@ import jax
 import jax.numpy as jnp
 
 from dryad_tpu.config import Params
-from dryad_tpu.engine.grower import finalize_leaf_values, pack_cat_bitset, root_stats
+from dryad_tpu.engine.grower import (
+    child_bounds,
+    finalize_leaf_values,
+    pack_cat_bitset,
+    root_stats,
+)
 from dryad_tpu.engine.histogram import (
     build_hist,
     build_hist_multi,
@@ -64,7 +69,7 @@ def grow_tree_levelwise(
 
     mono = _monotone_array(p, F)
 
-    def best(hist, G, H, C, allow):
+    def best(hist, G, H, C, allow, lo, hi):
         return find_best_split(
             hist, G, H, C,
             lambda_l2=p.lambda_l2,
@@ -76,6 +81,8 @@ def grow_tree_levelwise(
             allow=allow,
             has_cat=has_cat,
             monotone=mono,
+            lo=lo,
+            hi=hi,
         )
 
     # ---- root (shared canonical construction) --------------------------------
@@ -85,8 +92,10 @@ def grow_tree_levelwise(
                        precision=p.hist_precision, backend=p.hist_backend,
                        platform=platform)
     G0, H0, C0 = root_stats(hist0)
+    ninf, pinf = jnp.float32(-jnp.inf), jnp.float32(jnp.inf)
     root = best(hist0, G0, H0, C0,
-                (jnp.int32(0) < depth_cap) & (C0 >= 2 * p.min_data_in_leaf))
+                (jnp.int32(0) < depth_cap) & (C0 >= 2 * p.min_data_in_leaf),
+                ninf, pinf)
     Bc = root.cat_mask.shape[0]
 
     slot_node = jnp.full((L,), -1, jnp.int32).at[0].set(0)
@@ -95,6 +104,8 @@ def grow_tree_levelwise(
     slot_H = jnp.zeros((L,), jnp.float32).at[0].set(H0)
     slot_C = jnp.zeros((L,), jnp.float32).at[0].set(C0)
     slot_depth = jnp.zeros((L,), jnp.int32)
+    slot_lo = jnp.full((L,), ninf, jnp.float32)
+    slot_hi = jnp.full((L,), pinf, jnp.float32)
     sp_feature = jnp.full((L,), -1, jnp.int32).at[0].set(root.feature)
     sp_thresh = jnp.zeros((L,), jnp.int32).at[0].set(root.threshold)
     sp_GL = jnp.zeros((L,), jnp.float32).at[0].set(root.g_left)
@@ -129,7 +140,8 @@ def grow_tree_levelwise(
     st = {
         "row_slot": row_slot, "slot_node": slot_node, "slot_gain": slot_gain,
         "slot_G": slot_G, "slot_H": slot_H, "slot_C": slot_C,
-        "slot_depth": slot_depth, "sp_feature": sp_feature,
+        "slot_depth": slot_depth, "slot_lo": slot_lo, "slot_hi": slot_hi,
+        "sp_feature": sp_feature,
         "sp_thresh": sp_thresh, "sp_GL": sp_GL, "sp_HL": sp_HL,
         "sp_CL": sp_CL, "sp_catmask": sp_catmask, "hists": hists,
         "feature": feature, "threshold": threshold, "gain": gain_arr,
@@ -140,11 +152,13 @@ def grow_tree_levelwise(
     def make_level_body(P):
         def level_body(d, st):
             (row_slot, slot_node, slot_gain, slot_G, slot_H, slot_C, slot_depth,
+             slot_lo, slot_hi,
              sp_feature, sp_thresh, sp_GL, sp_HL, sp_CL, sp_catmask, hists,
              feature, threshold, gain_arr, left, right, is_cat_arr, cat_nodes,
              num_nodes, splits_done, max_depth) = (
                 st["row_slot"], st["slot_node"], st["slot_gain"], st["slot_G"],
-                st["slot_H"], st["slot_C"], st["slot_depth"], st["sp_feature"],
+                st["slot_H"], st["slot_C"], st["slot_depth"],
+                st["slot_lo"], st["slot_hi"], st["sp_feature"],
                 st["sp_thresh"], st["sp_GL"], st["sp_HL"], st["sp_CL"],
                 st["sp_catmask"], st["hists"], st["feature"], st["threshold"],
                 st["gain"], st["left"], st["right"], st["is_cat"], st["cat_nodes"],
@@ -242,6 +256,14 @@ def grow_tree_levelwise(
             hists = hists.at[jnp.where(do, right_slot, L)].set(hist_r, mode="drop")
 
             # ---- children stats + their best splits (vmapped finder) ------------
+            lo_p, hi_p = slot_lo[sj], slot_hi[sj]
+            if mono is not None:
+                lo_l, hi_l, lo_r, hi_r = child_bounds(
+                    mono, sf, GL, HL, GR, HR, jnp.float32(p.lambda_l2), lo_p, hi_p)
+            else:
+                lo_l = lo_r = lo_p
+                hi_l = hi_r = hi_p
+
             ch_slot = jnp.concatenate([sj, right_slot])
             ch_do = jnp.concatenate([do, do])
             ch_node = jnp.concatenate([left_id, right_id])
@@ -249,8 +271,10 @@ def grow_tree_levelwise(
             ch_G = jnp.concatenate([GL, GR])
             ch_H = jnp.concatenate([HL, HR])
             ch_C = jnp.concatenate([CL, CR])
+            ch_lo = jnp.concatenate([lo_l, lo_r])
+            ch_hi = jnp.concatenate([hi_l, hi_r])
             allow = ch_do & (d + 1 < depth_cap) & (ch_C >= 2 * p.min_data_in_leaf)
-            res = jax.vmap(best, in_axes=(0, 0, 0, 0, 0))(ch_hist, ch_G, ch_H, ch_C, allow)
+            res = jax.vmap(best)(ch_hist, ch_G, ch_H, ch_C, allow, ch_lo, ch_hi)
 
             cidx = jnp.where(ch_do, ch_slot, L)
             slot_node = slot_node.at[cidx].set(ch_node, mode="drop")
@@ -259,6 +283,8 @@ def grow_tree_levelwise(
             slot_H = slot_H.at[cidx].set(ch_H, mode="drop")
             slot_C = slot_C.at[cidx].set(ch_C, mode="drop")
             slot_depth = slot_depth.at[cidx].set(d + 1, mode="drop")
+            slot_lo = slot_lo.at[cidx].set(ch_lo, mode="drop")
+            slot_hi = slot_hi.at[cidx].set(ch_hi, mode="drop")
             sp_feature = sp_feature.at[cidx].set(res.feature, mode="drop")
             sp_thresh = sp_thresh.at[cidx].set(res.threshold, mode="drop")
             sp_GL = sp_GL.at[cidx].set(res.g_left, mode="drop")
@@ -274,6 +300,7 @@ def grow_tree_levelwise(
                 "row_slot": row_slot, "slot_node": slot_node,
                 "slot_gain": slot_gain, "slot_G": slot_G, "slot_H": slot_H,
                 "slot_C": slot_C, "slot_depth": slot_depth,
+                "slot_lo": slot_lo, "slot_hi": slot_hi,
                 "sp_feature": sp_feature, "sp_thresh": sp_thresh, "sp_GL": sp_GL,
                 "sp_HL": sp_HL, "sp_CL": sp_CL, "sp_catmask": sp_catmask,
                 "hists": hists, "feature": feature, "threshold": threshold,
@@ -289,8 +316,12 @@ def grow_tree_levelwise(
         st = jax.lax.fori_loop(d_switch, depth_cap, make_level_body(P_full), st)
 
     # ---- finalize leaf values + node bitsets (shared helpers) ----------------
-    value = finalize_leaf_values(p, M, st["slot_node"], st["slot_G"],
-                                 st["slot_H"], jnp.zeros((M,), jnp.float32))
+    value = finalize_leaf_values(
+        p, M, st["slot_node"], st["slot_G"], st["slot_H"],
+        jnp.zeros((M,), jnp.float32),
+        slot_lo=st["slot_lo"] if mono is not None else None,
+        slot_hi=st["slot_hi"] if mono is not None else None,
+    )
     cat_bitset = pack_cat_bitset(st["cat_nodes"], M)
 
     return {

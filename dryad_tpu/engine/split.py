@@ -48,6 +48,8 @@ def find_best_split(
     allow: jnp.ndarray,          # scalar bool: depth/min-data pre-check
     has_cat: bool = True,        # static: skip the sorted-subset machinery
     monotone: jnp.ndarray | None = None,  # (F,) int32 in {-1, 0, +1}
+    lo: jnp.ndarray | None = None,  # scalar f32: node output lower bound
+    hi: jnp.ndarray | None = None,  # scalar f32: node output upper bound
 ) -> SplitResult:
     hg, hh, hc = hist[0], hist[1], hist[2]
     F, B = hg.shape
@@ -77,14 +79,28 @@ def find_best_split(
         & feat_mask[:, None]
     )
     if monotone is not None:
-        # split-level monotone enforcement (mirrors cpu/histogram.py);
-        # unconstrained (0) features pass regardless of NaN child values
-        vl = -GL / (HL + lambda_l2)
-        vr = -GR / (HR + lambda_l2)
+        # LightGBM-"basic" monotone mode (mirrors cpu/histogram.py): child
+        # outputs are clamped to the node's inherited [lo, hi] bounds, the
+        # gain is computed with the clamped outputs (objective reduction
+        # -(G w + (H+λ)w²/2), which collapses to G²/(2(H+λ)) unclamped),
+        # and a ±1 feature may only split where the clamped right value is
+        # >=/<= the clamped left value.  Descendants inherit tightened
+        # bounds from the grower, so deep subtrees cannot cross a
+        # constrained ancestor's split — unconstrained (0) features pass
+        # the direction check regardless of NaN child values.
+        lam = jnp.float32(lambda_l2)
+        wl = jnp.clip(-GL / (HL + lam), lo, hi)
+        wr = jnp.clip(-GR / (HR + lam), lo, hi)
+        wp = jnp.clip(-G / (H + lam), lo, hi)
         mcol = monotone.astype(jnp.float32)[:, None]
-        valid &= (mcol == 0) | (mcol * (vr - vl) >= 0)
-    parent_score = G * G / (H + lambda_l2)
-    gain = 0.5 * (GL * GL / (HL + lambda_l2) + GR * GR / (HR + lambda_l2) - parent_score)
+        valid &= (mcol == 0) | (mcol * (wr - wl) >= 0)
+        red_l = -(GL * wl + 0.5 * (HL + lam) * wl * wl)
+        red_r = -(GR * wr + 0.5 * (HR + lam) * wr * wr)
+        red_p = -(G * wp + 0.5 * (H + lam) * wp * wp)
+        gain = red_l + red_r - red_p
+    else:
+        parent_score = G * G / (H + lambda_l2)
+        gain = 0.5 * (GL * GL / (HL + lambda_l2) + GR * GR / (HR + lambda_l2) - parent_score)
     gain = jnp.where(valid, gain, NEG_INF)
 
     flat = jnp.argmax(gain.ravel()).astype(jnp.int32)  # first-max tie-break

@@ -59,11 +59,15 @@ class Binary:
         p = jnp.asarray(1.0, jnp.float32) / (1.0 + jnp.exp(-score))
         g = p - y
         h = p * (1.0 - p)
-        if weight is not None:
-            g, h = g * weight, h * weight
+        # combine explicit weight and scale_pos_weight into ONE vector before
+        # multiplying g/h — same rounding order as _weights_np, so gain-argmax
+        # ties cannot flip between backends when both are in play
+        w = weight
         if self.spw != 1.0:
             wp = jnp.where(y > 0.5, jnp.float32(self.spw), jnp.float32(1.0))
-            g, h = g * wp, h * wp
+            w = wp if w is None else w * wp
+        if w is not None:
+            g, h = g * w, h * w
         return g, h
 
     @staticmethod

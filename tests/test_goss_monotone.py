@@ -74,6 +74,48 @@ def test_monotone_constraint_holds_on_stumps(backend):
     assert not np.isin(used, [0, 1]).any()  # constrained-out of both
 
 
+@pytest.mark.parametrize("backend", ["cpu", "tpu"])
+@pytest.mark.parametrize("growth", ["leafwise", "depthwise"])
+def test_monotone_constraint_holds_deep(backend, growth):
+    # deep trees: only bound propagation (LightGBM "basic" mode) can stop a
+    # descendant subtree from crossing a constrained ancestor's split
+    rng = np.random.default_rng(81)
+    X = rng.normal(size=(4000, 4)).astype(np.float32)
+    y = (X[:, 0] + 0.8 * np.sin(2 * X[:, 1]) + 0.3 * rng.normal(size=4000)
+         ).astype(np.float32)
+    ds = dryad.Dataset(X, y, max_bins=64)
+    b = dryad.train(dict(objective="regression", num_trees=25, num_leaves=31,
+                         max_depth=6, growth=growth, max_bins=64,
+                         monotone_constraints=(1, 0, 0, 0)),
+                    ds, backend=backend)
+    assert b.max_depth_seen >= 3  # the constraint must not collapse the trees
+    # exhaustive check along the constrained axis: predictions must be
+    # non-decreasing in feature 0 for many random settings of the others
+    base = rng.normal(size=(64, 4)).astype(np.float32)
+    grid = np.linspace(X[:, 0].min(), X[:, 0].max(), 48, dtype=np.float32)
+    pts = np.repeat(base, grid.size, axis=0)
+    pts[:, 0] = np.tile(grid, base.shape[0])
+    s = b.predict(pts, raw_score=True).reshape(base.shape[0], grid.size)
+    assert (np.diff(s, axis=1) >= -1e-6).all()
+
+
+def test_monotone_decreasing_deep():
+    rng = np.random.default_rng(83)
+    X = rng.normal(size=(3000, 3)).astype(np.float32)
+    y = (-X[:, 0] + 0.5 * X[:, 1] ** 2 + 0.2 * rng.normal(size=3000)
+         ).astype(np.float32)
+    ds = dryad.Dataset(X, y, max_bins=32)
+    b = dryad.train(dict(objective="regression", num_trees=15, num_leaves=31,
+                         max_bins=32, monotone_constraints=(-1, 0, 0)),
+                    ds, backend="cpu")
+    base = rng.normal(size=(32, 3)).astype(np.float32)
+    grid = np.linspace(X[:, 0].min(), X[:, 0].max(), 32, dtype=np.float32)
+    pts = np.repeat(base, grid.size, axis=0)
+    pts[:, 0] = np.tile(grid, base.shape[0])
+    s = b.predict(pts, raw_score=True).reshape(base.shape[0], grid.size)
+    assert (np.diff(s, axis=1) <= 1e-6).all()
+
+
 def test_monotone_cpu_tpu_parity():
     rng = np.random.default_rng(79)
     X = rng.normal(size=(3000, 5)).astype(np.float32)
