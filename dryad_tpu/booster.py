@@ -52,6 +52,7 @@ class Booster:
         best_iteration: int = -1,
         gain: Optional[np.ndarray] = None,
         train_state: Optional[dict] = None,
+        default_left: Optional[np.ndarray] = None,
     ):
         self.params = params
         self.mapper = mapper
@@ -68,6 +69,10 @@ class Booster:
         # per-node split gain (0 at leaves); optional for old checkpoints
         self.gain = (np.zeros_like(value) if gain is None
                      else np.asarray(gain, np.float32))
+        # per-node learned missing direction (numerical splits; True = bin 0
+        # goes left).  Old models default to all-True — the historic rule.
+        self.default_left = (np.ones(feature.shape, bool) if default_left is None
+                             else np.asarray(default_left, bool))
         # loop state a resumed run needs to continue exactly (early stopping)
         self.train_state = dict(train_state or {})
 
@@ -94,6 +99,7 @@ class Booster:
             "is_cat": self.is_cat,
             "cat_bitset": self.cat_bitset,
             "gain": self.gain,
+            "default_left": self.default_left,
         }
 
     # ---- predict -----------------------------------------------------------
@@ -170,6 +176,7 @@ class Booster:
             is_cat=self.is_cat,
             cat_bitset=self.cat_bitset,
             gain=self.gain,
+            default_left=self.default_left,
             init_score=self.init_score,
             meta=np.frombuffer(
                 json.dumps(
@@ -213,6 +220,7 @@ class Booster:
                 meta.get("best_iteration", -1),
                 gain=z["gain"] if "gain" in z.files else None,
                 train_state=meta.get("train_state"),
+                default_left=z["default_left"] if "default_left" in z.files else None,
             )
 
     # ---- introspection -----------------------------------------------------
@@ -247,6 +255,7 @@ class Booster:
                         "split_feature": f,
                         "threshold_bin": int(self.threshold[t, n]),
                         "is_categorical": bool(self.is_cat[t, n]),
+                        "default_left": bool(self.default_left[t, n]),
                         "gain": float(self.gain[t, n]),
                         "left": int(self.left[t, n]),
                         "right": int(self.right[t, n]),
@@ -277,4 +286,5 @@ def empty_tree_arrays(num_total_trees: int, max_nodes: int) -> dict[str, np.ndar
         "is_cat": np.zeros((num_total_trees, max_nodes), bool),
         "cat_bitset": np.zeros((num_total_trees, max_nodes, CAT_WORDS), np.uint32),
         "gain": np.zeros((num_total_trees, max_nodes), np.float32),
+        "default_left": np.ones((num_total_trees, max_nodes), bool),
     }

@@ -59,6 +59,7 @@ class SplitInfo:
     g_left: float
     h_left: float
     c_left: float
+    default_left: bool = True  # missing (bin 0) goes left at this split
 
 
 def leaf_output(G: float, H: float, lambda_l2: float, learning_rate: float,
@@ -90,13 +91,17 @@ def find_best_split(
     monotone: np.ndarray | None = None,
     lo: float = -np.inf,
     hi: float = np.inf,
+    learn_missing: bool = False,
 ) -> SplitInfo | None:
     """Best (feature, threshold) over the histogram; None when nothing valid.
 
-    Numerical: scan "bin <= t goes left" for every t.  Categorical: LightGBM
-    style sorted-subset — bins ordered by g/(h+smooth), best prefix becomes
-    the left membership set.  Tie-break: first index in flattened (F, B)
-    order (matches both np.argmax and jnp.argmax).
+    Numerical: scan "bin <= t goes left" for every t; with ``learn_missing``
+    a second plane scans "bins 1..t left, missing (bin 0) right" and the
+    better plane wins (missing-left plane first on ties, so NaN-free data
+    grows unchanged trees).  Categorical: LightGBM style sorted-subset —
+    bins ordered by g/(h+smooth), best prefix becomes the left membership
+    set (missing direction is part of the membership).  Tie-break: first
+    index in flattened (plane, F, B) order (matches jnp.argmax).
     """
     hg, hh, hc = hist[0], hist[1], hist[2]
     F, B = hg.shape
@@ -106,7 +111,8 @@ def find_best_split(
     CL = np.cumsum(hc, axis=1)
 
     cat_order: dict[int, np.ndarray] = {}
-    if is_categorical is not None and is_categorical.any():
+    any_cat = is_categorical is not None and is_categorical.any()
+    if any_cat:
         # Rewrite the scan to sorted-bin order, only for categorical rows.
         for f in np.where(is_categorical)[0]:
             with np.errstate(invalid="ignore", divide="ignore"):
@@ -117,45 +123,70 @@ def find_best_split(
             HL[f] = np.cumsum(hh[f][o])
             CL[f] = np.cumsum(hc[f][o])
 
-    GR, HR, CR = G - GL, H - HL, C - CL
-    valid = (
-        (CL >= min_data_in_leaf)
-        & (CR >= min_data_in_leaf)
-        & (HL >= min_child_weight)
-        & (HR >= min_child_weight)
-    )
-    if feature_mask is not None:
-        valid &= feature_mask[:, None]
-    if monotone is not None:
-        # LightGBM-"basic" monotone mode (the device split.py mirrors this):
-        # child outputs clamped to the node's inherited [lo, hi] bounds, gain
-        # computed with the clamped outputs, and a ±1 feature may only split
-        # where the clamped right value is >=/<= the clamped left value;
-        # unconstrained (0) features pass regardless of NaN child values
-        with np.errstate(invalid="ignore", divide="ignore"):
-            wl = np.clip(-GL / (HL + lambda_l2), lo, hi)
-            wr = np.clip(-GR / (HR + lambda_l2), lo, hi)
-            wp = min(max(-G / (H + lambda_l2), lo), hi)
-            valid &= (monotone[:, None] == 0) | (monotone[:, None] * (wr - wl) >= 0)
-            red_l = -(GL * wl + 0.5 * (HL + lambda_l2) * wl * wl)
-            red_r = -(GR * wr + 0.5 * (HR + lambda_l2) * wr * wr)
-            red_p = -(G * wp + 0.5 * (H + lambda_l2) * wp * wp)
-            gain = red_l + red_r - red_p
-    else:
-        with np.errstate(invalid="ignore", divide="ignore"):
-            parent_score = G * G / (H + lambda_l2)
-            gain = 0.5 * (GL * GL / (HL + lambda_l2) + GR * GR / (HR + lambda_l2) - parent_score)
-    gain = np.where(valid, gain, NEG_INF)
+    def gain_of(GLx, HLx, CLx):
+        GRx, HRx, CRx = G - GLx, H - HLx, C - CLx
+        valid = (
+            (CLx >= min_data_in_leaf)
+            & (CRx >= min_data_in_leaf)
+            & (HLx >= min_child_weight)
+            & (HRx >= min_child_weight)
+        )
+        if feature_mask is not None:
+            valid &= feature_mask[:, None]
+        if monotone is not None:
+            # LightGBM-"basic" monotone mode (the device split.py mirrors
+            # this): child outputs clamped to the node's inherited [lo, hi]
+            # bounds, gain computed with the clamped outputs, and a ±1
+            # feature may only split where the clamped right value is >=/<=
+            # the clamped left value; unconstrained (0) features pass
+            # regardless of NaN child values
+            with np.errstate(invalid="ignore", divide="ignore"):
+                wl = np.clip(-GLx / (HLx + lambda_l2), lo, hi)
+                wr = np.clip(-GRx / (HRx + lambda_l2), lo, hi)
+                wp = min(max(-G / (H + lambda_l2), lo), hi)
+                valid &= (monotone[:, None] == 0) | (monotone[:, None] * (wr - wl) >= 0)
+                red_l = -(GLx * wl + 0.5 * (HLx + lambda_l2) * wl * wl)
+                red_r = -(GRx * wr + 0.5 * (HRx + lambda_l2) * wr * wr)
+                red_p = -(G * wp + 0.5 * (H + lambda_l2) * wp * wp)
+                gain = red_l + red_r - red_p
+        else:
+            with np.errstate(invalid="ignore", divide="ignore"):
+                parent_score = G * G / (H + lambda_l2)
+                gain = 0.5 * (GLx * GLx / (HLx + lambda_l2)
+                              + GRx * GRx / (HRx + lambda_l2) - parent_score)
+        return np.where(valid, gain, NEG_INF)
 
-    flat = int(np.argmax(gain))
-    best_gain = float(gain.ravel()[flat])
+    gain = gain_of(GL, HL, CL)
+    default_left = True
+    if learn_missing:
+        # missing-right plane: subtract the first scanned position's stats
+        # (bin 0 for numerical features — identity order keeps it first)
+        CL_r = CL - hc[:, :1]
+        gain_r = gain_of(GL - hg[:, :1], HL - hh[:, :1], CL_r)
+        # exclude right-child-holds-only-missing candidates: they mirror the
+        # plane-0 t=0 split (sides swapped) and fp noise could flip the
+        # CPU/TPU argmax between the two representations (device mirrors)
+        gain_r = np.where((C - CL_r) > hc[:, :1], gain_r, NEG_INF)
+        if any_cat:
+            gain_r[is_categorical] = NEG_INF
+        flat2 = int(np.argmax(np.concatenate([gain.ravel(), gain_r.ravel()])))
+        default_left = flat2 < F * B
+        flat = flat2 % (F * B)
+        best_gain = float((gain if default_left else gain_r).ravel()[flat])
+    else:
+        flat = int(np.argmax(gain))
+        best_gain = float(gain.ravel()[flat])
     if not np.isfinite(best_gain) or best_gain <= min_split_gain:
         return None
     f, t = flat // B, flat % B
+    gl, hl, cl = float(GL[f, t]), float(HL[f, t]), float(CL[f, t])
+    if not default_left:
+        gl, hl, cl = gl - float(hg[f, 0]), hl - float(hh[f, 0]), cl - float(hc[f, 0])
     if is_categorical is not None and is_categorical[f]:
         members = np.sort(cat_order[int(f)][: t + 1]).astype(np.int32)
-        return SplitInfo(best_gain, f, t, True, members, float(GL[f, t]), float(HL[f, t]), float(CL[f, t]))
-    return SplitInfo(best_gain, f, t, False, np.empty(0, np.int32), float(GL[f, t]), float(HL[f, t]), float(CL[f, t]))
+        return SplitInfo(best_gain, f, t, True, members, gl, hl, cl)
+    return SplitInfo(best_gain, f, t, False, np.empty(0, np.int32), gl, hl, cl,
+                     default_left=bool(default_left))
 
 
 def cat_members_to_bitset(members: np.ndarray, words: int) -> np.ndarray:

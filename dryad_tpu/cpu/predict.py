@@ -24,6 +24,9 @@ def predict_tree_leaves(
     left, right = trees["left"][t], trees["right"][t]
     is_cat = trees["is_cat"][t]
     cat_bs = trees["cat_bitset"][t]
+    # learned per-node missing direction; absent in pre-direction tree dicts
+    # (missing then always travels left, the historic rule)
+    dleft = trees["default_left"][t] if "default_left" in trees else None
     for _ in range(max(depth_bound, 1)):
         f = feature[node]
         internal = f >= 0
@@ -32,6 +35,8 @@ def predict_tree_leaves(
         fc = np.where(internal, f, 0)
         bins_v = Xb[np.arange(N), fc].astype(np.int64)
         num_left = bins_v <= threshold[node]
+        if dleft is not None:
+            num_left &= dleft[node] | (bins_v != 0)
         # bitset word index is clipped: bins beyond the bitset (>256 only on
         # numerical-split nodes) never consult cat_left
         word = cat_bs[node, np.minimum(bins_v >> 5, cat_bs.shape[1] - 1)]

@@ -84,11 +84,13 @@ def sample_masks(params: Params, iteration: int, num_rows: int, num_features: in
 class _TreeGrower:
     """Grows one tree; mirrors engine/grower.py step-for-step."""
 
-    def __init__(self, params: Params, Xb: np.ndarray, total_bins: int, is_categorical: np.ndarray):
+    def __init__(self, params: Params, Xb: np.ndarray, total_bins: int,
+                 is_categorical: np.ndarray, learn_missing: bool = False):
         self.p = params
         self.Xb = Xb
         self.B = total_bins
         self.is_cat_feat = is_categorical
+        self.learn_missing = bool(learn_missing)
         self.mono = None
         if params.monotone_constraints and any(params.monotone_constraints):
             # pad/truncate to F (same policy as the device _monotone_array)
@@ -160,6 +162,8 @@ class _TreeGrower:
                 go_left = np.isin(bins_f, split.cat_members)
             else:
                 go_left = bins_f <= split.threshold
+                if not split.default_left:
+                    go_left &= bins_f != 0  # missing learned to go right
             rows_l, rows_r = prows[go_left], prows[~go_left]
 
             left_id, right_id = num_nodes, num_nodes + 1
@@ -169,6 +173,7 @@ class _TreeGrower:
             out["left"][t, parent] = left_id
             out["right"][t, parent] = right_id
             out["gain"][t, parent] = split.gain
+            out["default_left"][t, parent] = split.default_left or split.is_cat
             if split.is_cat:
                 out["is_cat"][t, parent] = True
                 out["cat_bitset"][t, parent] = cat_members_to_bitset(split.cat_members, CAT_WORDS)
@@ -252,6 +257,7 @@ class _TreeGrower:
             monotone=self.mono,
             lo=float(lo),
             hi=float(hi),
+            learn_missing=self.learn_missing,
         )
 
 
@@ -280,7 +286,7 @@ def train_cpu(
     init = np.asarray(obj.init_score(y, data.weight), np.float32).reshape(-1)
     score = np.broadcast_to(init, (N, K)).astype(np.float32).copy()
     qoff = data.query_offsets
-    grower = _TreeGrower(p, Xb, B, is_cat)
+    grower = _TreeGrower(p, Xb, B, is_cat, learn_missing=data.has_missing)
     max_depth_seen = 0
 
     start_iter = 0
@@ -408,4 +414,5 @@ def _make_booster(p, mapper, out, T, init, max_depth_seen, best_iteration,
         best_iteration=best_iteration,
         gain=out["gain"][:T],
         train_state={"best_value": best_value, "stale": int(stale)},
+        default_left=out["default_left"][:T],
     )

@@ -53,6 +53,20 @@ class Dataset:
         self.num_rows, self.num_features = self.X_binned.shape
         self._attach_targets(y, weight, group)
 
+    _has_missing: Optional[bool] = None
+
+    @property
+    def has_missing(self) -> bool:
+        """True when any NUMERICAL column contains missing (bin 0) rows —
+        the growers then scan splits in both missing directions.  On
+        missing-free data the flag keeps the split scan single-plane, so
+        compiled programs and grown trees are unchanged.  (Categorical
+        missing learns its direction through subset membership instead.)"""
+        if self._has_missing is None:
+            zero_cols = (self.X_binned == 0).any(axis=0)
+            self._has_missing = bool((zero_cols & ~self.mapper.is_categorical).any())
+        return self._has_missing
+
     def _attach_targets(self, y, weight, group) -> None:
         """Validate + store labels/weights/query groups (shared by __init__
         and the from_binned factory so the checks can never drift)."""

@@ -57,7 +57,7 @@ def shard_rows(mesh: Mesh, *arrays):
 
 def grow_sharded(params: Params, total_bins: int, has_cat: bool,
                  mesh: Mesh, Xb, g, h, bag_mask, feat_mask, is_cat_feat,
-                 platform=None):
+                 platform=None, learn_missing=False):
     """One sharded tree grow; returns (replicated tree, row-sharded leaves).
 
     Called inside the device train step's jit: the tree arrays come back
@@ -69,6 +69,7 @@ def grow_sharded(params: Params, total_bins: int, has_cat: bool,
         tree = grow_any(
             params, total_bins, Xb_l, g_l, h_l, bag_l, fmask, iscat,
             has_cat=has_cat, axis_name=AXIS, platform=platform,
+            learn_missing=learn_missing,
         )
         leaves = tree_leaves(tree, Xb_l, tree["max_depth"])
         return tree, leaves
@@ -79,7 +80,7 @@ def grow_sharded(params: Params, total_bins: int, has_cat: bool,
     tree_specs = {
         "feature": rep, "threshold": rep, "left": rep, "right": rep,
         "value": rep, "gain": rep, "is_cat": rep, "cat_bitset": rep,
-        "max_depth": rep,
+        "default_left": rep, "max_depth": rep,
     }
     return jax.shard_map(
         run, mesh=mesh,

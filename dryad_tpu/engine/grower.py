@@ -44,7 +44,8 @@ _BIG_DEPTH = jnp.int32(2**30)
 
 
 def grow_any(params, total_bins, Xb, g, h, bag_mask, feat_mask, is_cat_feat,
-             *, has_cat=False, axis_name=None, platform=None):
+             *, has_cat=False, axis_name=None, platform=None,
+             learn_missing=False):
     """Route to the fastest grower for the growth policy.
 
     Depth-wise growth takes the level-synchronous path (one batched
@@ -57,10 +58,12 @@ def grow_any(params, total_bins, Xb, g, h, bag_mask, feat_mask, is_cat_feat,
         return grow_tree_levelwise(
             params, total_bins, Xb, g, h, bag_mask, feat_mask, is_cat_feat,
             has_cat=has_cat, axis_name=axis_name, platform=platform,
+            learn_missing=learn_missing,
         )
     return grow_tree(
         params, total_bins, Xb, g, h, bag_mask, feat_mask, is_cat_feat,
         has_cat=has_cat, axis_name=axis_name, platform=platform,
+        learn_missing=learn_missing,
     )
 
 
@@ -136,6 +139,7 @@ def grow_tree(
     has_cat: bool = False,
     axis_name: str | None = None,
     platform: str | None = None,
+    learn_missing: bool = False,
 ) -> dict[str, Any]:
     """Grow one tree; returns SoA tree arrays (max_nodes,) + max_depth.
 
@@ -167,6 +171,7 @@ def grow_tree(
             monotone=mono,
             lo=lo,
             hi=hi,
+            learn_missing=learn_missing,
         )
 
     def hist_of(mask):
@@ -206,6 +211,7 @@ def grow_tree(
         "sp_HL": jnp.zeros((L,), jnp.float32).at[0].set(root.h_left),
         "sp_CL": jnp.zeros((L,), jnp.float32).at[0].set(root.c_left),
         "sp_catmask": jnp.zeros((L, root.cat_mask.shape[0]), bool).at[0].set(root.cat_mask),
+        "sp_dleft": jnp.ones((L,), bool).at[0].set(root.default_left),
         "hists": jnp.zeros((L, 3, F, B), jnp.float32).at[0].set(hist0),
         "feature": jnp.full((M,), -1, jnp.int32),
         "threshold": jnp.zeros((M,), jnp.int32),
@@ -215,6 +221,7 @@ def grow_tree(
         "gain": jnp.zeros((M,), jnp.float32),
         "is_cat": jnp.zeros((M,), bool),
         "cat_mask_nodes": jnp.zeros((M, root.cat_mask.shape[0]), bool),
+        "node_dleft": jnp.ones((M,), bool),
         "num_nodes": jnp.int32(1),
         "max_depth": jnp.int32(0),
     }
@@ -237,11 +244,15 @@ def grow_tree(
         cat_split = is_cat_feat[sf] if has_cat else jnp.bool_(False)
 
         bins_f = jnp.take(Xb, sf, axis=1).astype(jnp.int32)
+        num_left = bins_f <= thr
+        dl = st["sp_dleft"][s]
+        if learn_missing:
+            num_left &= dl | (bins_f > 0)
         if has_cat:
             go_left = jnp.where(cat_split, catm[jnp.minimum(bins_f, catm.shape[0] - 1)],
-                                bins_f <= thr)
+                                num_left)
         else:
-            go_left = bins_f <= thr
+            go_left = num_left
         in_slot = st["row_slot"] == s
 
         GL, HL, CL = st["sp_GL"][s], st["sp_HL"][s], st["sp_CL"][s]
@@ -261,6 +272,7 @@ def grow_tree(
         cat_nodes = st["cat_mask_nodes"].at[parent].set(
             jnp.where(cat_split, catm, jnp.zeros_like(catm))
         )
+        node_dleft = st["node_dleft"].at[parent].set(dl | cat_split)
 
         # row partition/apply: left child keeps slot s, right child takes k+1
         row_slot = jnp.where(in_slot & ~go_left, new_r, st["row_slot"])
@@ -308,6 +320,7 @@ def grow_tree(
             "sp_HL": put(st["sp_HL"], res_l.h_left, res_r.h_left),
             "sp_CL": put(st["sp_CL"], res_l.c_left, res_r.c_left),
             "sp_catmask": put(st["sp_catmask"], res_l.cat_mask, res_r.cat_mask),
+            "sp_dleft": put(st["sp_dleft"], res_l.default_left, res_r.default_left),
             "hists": hists,
             "feature": feature,
             "threshold": threshold,
@@ -317,6 +330,7 @@ def grow_tree(
             "gain": gain_arr,
             "is_cat": is_cat_arr,
             "cat_mask_nodes": cat_nodes,
+            "node_dleft": node_dleft,
             "num_nodes": st["num_nodes"] + 2,
             "max_depth": jnp.maximum(st["max_depth"], depth_c),
         }
@@ -349,5 +363,6 @@ def grow_tree(
         "gain": st["gain"],
         "is_cat": st["is_cat"],
         "cat_bitset": cat_bitset,
+        "default_left": st["node_dleft"],
         "max_depth": st["max_depth"],
     }

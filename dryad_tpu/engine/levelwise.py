@@ -56,6 +56,7 @@ def grow_tree_levelwise(
     has_cat: bool = False,
     axis_name: str | None = None,
     platform: str | None = None,
+    learn_missing: bool = False,
 ) -> dict[str, Any]:
     p = params
     N, F = Xb.shape
@@ -83,6 +84,7 @@ def grow_tree_levelwise(
             monotone=mono,
             lo=lo,
             hi=hi,
+            learn_missing=learn_missing,
         )
 
     # ---- root (shared canonical construction) --------------------------------
@@ -112,6 +114,7 @@ def grow_tree_levelwise(
     sp_HL = jnp.zeros((L,), jnp.float32).at[0].set(root.h_left)
     sp_CL = jnp.zeros((L,), jnp.float32).at[0].set(root.c_left)
     sp_catmask = jnp.zeros((L, Bc), bool).at[0].set(root.cat_mask)
+    sp_dleft = jnp.ones((L,), bool).at[0].set(root.default_left)
     hists = jnp.zeros((L, 3, F, B), jnp.float32).at[0].set(hist0)
 
     feature = jnp.full((M,), -1, jnp.int32)
@@ -121,6 +124,7 @@ def grow_tree_levelwise(
     right = jnp.zeros((M,), jnp.int32)
     is_cat_arr = jnp.zeros((M,), bool)
     cat_nodes = jnp.zeros((M, Bc), bool)
+    node_dleft = jnp.ones((M,), bool)
     num_nodes = jnp.int32(1)
     splits_done = jnp.int32(0)
     max_depth = jnp.int32(0)
@@ -143,26 +147,31 @@ def grow_tree_levelwise(
         "slot_depth": slot_depth, "slot_lo": slot_lo, "slot_hi": slot_hi,
         "sp_feature": sp_feature,
         "sp_thresh": sp_thresh, "sp_GL": sp_GL, "sp_HL": sp_HL,
-        "sp_CL": sp_CL, "sp_catmask": sp_catmask, "hists": hists,
+        "sp_CL": sp_CL, "sp_catmask": sp_catmask, "sp_dleft": sp_dleft,
+        "hists": hists,
         "feature": feature, "threshold": threshold, "gain": gain_arr,
         "left": left, "right": right, "is_cat": is_cat_arr,
-        "cat_nodes": cat_nodes, "num_nodes": num_nodes,
+        "cat_nodes": cat_nodes, "node_dleft": node_dleft,
+        "num_nodes": num_nodes,
         "splits_done": splits_done, "max_depth": max_depth,
     }
     def make_level_body(P):
         def level_body(d, st):
             (row_slot, slot_node, slot_gain, slot_G, slot_H, slot_C, slot_depth,
              slot_lo, slot_hi,
-             sp_feature, sp_thresh, sp_GL, sp_HL, sp_CL, sp_catmask, hists,
+             sp_feature, sp_thresh, sp_GL, sp_HL, sp_CL, sp_catmask, sp_dleft,
+             hists,
              feature, threshold, gain_arr, left, right, is_cat_arr, cat_nodes,
-             num_nodes, splits_done, max_depth) = (
+             node_dleft, num_nodes, splits_done, max_depth) = (
                 st["row_slot"], st["slot_node"], st["slot_gain"], st["slot_G"],
                 st["slot_H"], st["slot_C"], st["slot_depth"],
                 st["slot_lo"], st["slot_hi"], st["sp_feature"],
                 st["sp_thresh"], st["sp_GL"], st["sp_HL"], st["sp_CL"],
-                st["sp_catmask"], st["hists"], st["feature"], st["threshold"],
+                st["sp_catmask"], st["sp_dleft"],
+                st["hists"], st["feature"], st["threshold"],
                 st["gain"], st["left"], st["right"], st["is_cat"], st["cat_nodes"],
-                st["num_nodes"], st["splits_done"], st["max_depth"])
+                st["node_dleft"], st["num_nodes"], st["splits_done"],
+                st["max_depth"])
             at_level = (slot_depth == d) & (slot_gain > NEG_INF) & (slot_node >= 0)
             # gain-descending order, stable => lowest slot id wins ties, exactly
             # the CPU trainer's repeated first-max argmax sequence
@@ -198,6 +207,8 @@ def grow_tree_levelwise(
             cat_nodes = cat_nodes.at[pidx].set(
                 jnp.where(cat_split[:, None], sp_catmask[sj], False), mode="drop"
             )
+            node_dleft = node_dleft.at[pidx].set(sp_dleft[sj] | cat_split,
+                                                 mode="drop")
 
             # ---- row partition: every splitting leaf in one vectorized pass -----
             slot_do = jnp.zeros((L,), bool).at[jnp.where(do, sj, L)].set(True, mode="drop")
@@ -209,6 +220,8 @@ def grow_tree_levelwise(
             bins_rf = jnp.take_along_axis(Xb, rf[:, None].astype(jnp.int32), axis=1)[:, 0]
             bins_rf = bins_rf.astype(jnp.int32)
             go_left = bins_rf <= sp_thresh[rs]
+            if learn_missing:
+                go_left &= sp_dleft[rs] | (bins_rf > 0)
             if has_cat:
                 cat_row = sp_catmask[rs, jnp.minimum(bins_rf, Bc - 1)]
                 go_left = jnp.where(is_cat_feat[rf], cat_row, go_left)
@@ -291,6 +304,7 @@ def grow_tree_levelwise(
             sp_HL = sp_HL.at[cidx].set(res.h_left, mode="drop")
             sp_CL = sp_CL.at[cidx].set(res.c_left, mode="drop")
             sp_catmask = sp_catmask.at[cidx].set(res.cat_mask, mode="drop")
+            sp_dleft = sp_dleft.at[cidx].set(res.default_left, mode="drop")
 
             splits_done = splits_done + n_do
             num_nodes = num_nodes + 2 * n_do
@@ -303,9 +317,11 @@ def grow_tree_levelwise(
                 "slot_lo": slot_lo, "slot_hi": slot_hi,
                 "sp_feature": sp_feature, "sp_thresh": sp_thresh, "sp_GL": sp_GL,
                 "sp_HL": sp_HL, "sp_CL": sp_CL, "sp_catmask": sp_catmask,
+                "sp_dleft": sp_dleft,
                 "hists": hists, "feature": feature, "threshold": threshold,
                 "gain": gain_arr, "left": left, "right": right,
                 "is_cat": is_cat_arr, "cat_nodes": cat_nodes,
+                "node_dleft": node_dleft,
                 "num_nodes": num_nodes, "splits_done": splits_done,
                 "max_depth": max_depth,
             }
@@ -333,5 +349,6 @@ def grow_tree_levelwise(
         "gain": st["gain"],
         "is_cat": st["is_cat"],
         "cat_bitset": cat_bitset,
+        "default_left": st["node_dleft"],
         "max_depth": st["max_depth"],
     }

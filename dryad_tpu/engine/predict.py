@@ -34,6 +34,7 @@ def tree_leaves(tree: dict, Xb: jnp.ndarray, depth_bound) -> jnp.ndarray:
         fc = jnp.where(internal, f, 0).astype(jnp.int32)
         bins = jnp.take_along_axis(Xb, fc[:, None], axis=1)[:, 0].astype(jnp.int32)
         num_left = bins <= tree["threshold"][node]
+        num_left &= tree["default_left"][node] | (bins != 0)
         bs = tree["cat_bitset"]
         word = bs[node, jnp.minimum(bins >> 5, bs.shape[1] - 1)]
         cat_left = ((word >> (bins & 31).astype(jnp.uint32)) & 1) > 0

@@ -31,6 +31,7 @@ class SplitResult(NamedTuple):
     h_left: jnp.ndarray     # f32
     c_left: jnp.ndarray     # f32
     cat_mask: jnp.ndarray   # (B,) bool — left membership set (cat splits only)
+    default_left: jnp.ndarray  # bool — missing (bin 0) goes left at this split
 
 
 def find_best_split(
@@ -50,6 +51,7 @@ def find_best_split(
     monotone: jnp.ndarray | None = None,  # (F,) int32 in {-1, 0, +1}
     lo: jnp.ndarray | None = None,  # scalar f32: node output lower bound
     hi: jnp.ndarray | None = None,  # scalar f32: node output upper bound
+    learn_missing: bool = False,    # static: scan missing-left AND missing-right
 ) -> SplitResult:
     hg, hh, hc = hist[0], hist[1], hist[2]
     F, B = hg.shape
@@ -69,42 +71,72 @@ def find_best_split(
     GL = jnp.cumsum(hg_o, axis=1)
     HL = jnp.cumsum(hh_o, axis=1)
     CL = jnp.cumsum(hc_o, axis=1)
-    GR, HR, CR = G - GL, H - HL, C - CL
 
-    valid = (
-        (CL >= min_data_in_leaf)
-        & (CR >= min_data_in_leaf)
-        & (HL >= min_child_weight)
-        & (HR >= min_child_weight)
-        & feat_mask[:, None]
-    )
-    if monotone is not None:
-        # LightGBM-"basic" monotone mode (mirrors cpu/histogram.py): child
-        # outputs are clamped to the node's inherited [lo, hi] bounds, the
-        # gain is computed with the clamped outputs (objective reduction
-        # -(G w + (H+λ)w²/2), which collapses to G²/(2(H+λ)) unclamped),
-        # and a ±1 feature may only split where the clamped right value is
-        # >=/<= the clamped left value.  Descendants inherit tightened
-        # bounds from the grower, so deep subtrees cannot cross a
-        # constrained ancestor's split — unconstrained (0) features pass
-        # the direction check regardless of NaN child values.
-        lam = jnp.float32(lambda_l2)
-        wl = jnp.clip(-GL / (HL + lam), lo, hi)
-        wr = jnp.clip(-GR / (HR + lam), lo, hi)
-        wp = jnp.clip(-G / (H + lam), lo, hi)
-        mcol = monotone.astype(jnp.float32)[:, None]
-        valid &= (mcol == 0) | (mcol * (wr - wl) >= 0)
-        red_l = -(GL * wl + 0.5 * (HL + lam) * wl * wl)
-        red_r = -(GR * wr + 0.5 * (HR + lam) * wr * wr)
-        red_p = -(G * wp + 0.5 * (H + lam) * wp * wp)
-        gain = red_l + red_r - red_p
+    def gain_of(GLx, HLx, CLx):
+        """Masked gain grid for one scan direction given its left-side sums."""
+        GRx, HRx, CRx = G - GLx, H - HLx, C - CLx
+        valid = (
+            (CLx >= min_data_in_leaf)
+            & (CRx >= min_data_in_leaf)
+            & (HLx >= min_child_weight)
+            & (HRx >= min_child_weight)
+            & feat_mask[:, None]
+        )
+        if monotone is not None:
+            # LightGBM-"basic" monotone mode (mirrors cpu/histogram.py):
+            # child outputs are clamped to the node's inherited [lo, hi]
+            # bounds, the gain is computed with the clamped outputs
+            # (objective reduction -(G w + (H+λ)w²/2), which collapses to
+            # G²/(2(H+λ)) unclamped), and a ±1 feature may only split where
+            # the clamped right value is >=/<= the clamped left value.
+            # Descendants inherit tightened bounds from the grower, so deep
+            # subtrees cannot cross a constrained ancestor's split —
+            # unconstrained (0) features pass regardless of NaN child values.
+            lam = jnp.float32(lambda_l2)
+            wl = jnp.clip(-GLx / (HLx + lam), lo, hi)
+            wr = jnp.clip(-GRx / (HRx + lam), lo, hi)
+            wp = jnp.clip(-G / (H + lam), lo, hi)
+            mcol = monotone.astype(jnp.float32)[:, None]
+            valid &= (mcol == 0) | (mcol * (wr - wl) >= 0)
+            red_l = -(GLx * wl + 0.5 * (HLx + lam) * wl * wl)
+            red_r = -(GRx * wr + 0.5 * (HRx + lam) * wr * wr)
+            red_p = -(G * wp + 0.5 * (H + lam) * wp * wp)
+            gain = red_l + red_r - red_p
+        else:
+            parent_score = G * G / (H + lambda_l2)
+            gain = 0.5 * (GLx * GLx / (HLx + lambda_l2)
+                          + GRx * GRx / (HRx + lambda_l2) - parent_score)
+        return jnp.where(valid, gain, NEG_INF)
+
+    gain = gain_of(GL, HL, CL)
+    if learn_missing:
+        # second scan with the missing bin (ordered position 0 for numerical
+        # features — the identity order keeps bin 0 first) moved to the RIGHT
+        # child: left = bins 1..t.  Categorical features learn the missing
+        # direction through subset membership already, so only the
+        # missing-left plane applies to them.  The missing-left plane comes
+        # FIRST in the flattened argmax, so on data with no missing values
+        # (bin-0 stats all zero → both planes identical) the tie-break picks
+        # missing-left and trees are unchanged.
+        g0, h0, c0 = hg_o[:, :1], hh_o[:, :1], hc_o[:, :1]
+        CL_r = CL - c0
+        gain_r = gain_of(GL - g0, HL - h0, CL_r)
+        # a right child holding ONLY missing rows mirrors the plane-0 t=0
+        # split (sides swapped, bitwise-equal gain only in exact arithmetic);
+        # exclude it so fp noise cannot flip the CPU/TPU argmax between the
+        # two representations of the same partition
+        gain_r = jnp.where((C - CL_r) > c0, gain_r, NEG_INF)
+        if has_cat:
+            gain_r = jnp.where(is_cat_feat[:, None], NEG_INF, gain_r)
+        flat2 = jnp.argmax(jnp.stack([gain.ravel(), gain_r.ravel()]).ravel())
+        flat2 = flat2.astype(jnp.int32)
+        dleft = flat2 < F * B
+        flat = flat2 % (F * B)
+        best_gain = jnp.where(dleft, gain.ravel()[flat], gain_r.ravel()[flat])
     else:
-        parent_score = G * G / (H + lambda_l2)
-        gain = 0.5 * (GL * GL / (HL + lambda_l2) + GR * GR / (HR + lambda_l2) - parent_score)
-    gain = jnp.where(valid, gain, NEG_INF)
-
-    flat = jnp.argmax(gain.ravel()).astype(jnp.int32)  # first-max tie-break
-    best_gain = gain.ravel()[flat]
+        flat = jnp.argmax(gain.ravel()).astype(jnp.int32)  # first-max tie-break
+        dleft = jnp.bool_(True)
+        best_gain = gain.ravel()[flat]
     f = flat // B
     t = flat % B
     ok = allow & jnp.isfinite(best_gain) & (best_gain > min_split_gain)
@@ -116,12 +148,19 @@ def find_best_split(
     else:
         cat_mask = jnp.zeros((1,), bool)
 
+    g_left, h_left, c_left = GL[f, t], HL[f, t], CL[f, t]
+    if learn_missing:
+        g_left = jnp.where(dleft, g_left, g_left - hg_o[f, 0])
+        h_left = jnp.where(dleft, h_left, h_left - hh_o[f, 0])
+        c_left = jnp.where(dleft, c_left, c_left - hc_o[f, 0])
+
     return SplitResult(
         gain=jnp.where(ok, best_gain, NEG_INF),
         feature=jnp.where(ok, f, -1).astype(jnp.int32),
         threshold=t.astype(jnp.int32),
-        g_left=GL[f, t],
-        h_left=HL[f, t],
-        c_left=CL[f, t],
+        g_left=g_left,
+        h_left=h_left,
+        c_left=c_left,
         cat_mask=cat_mask,
+        default_left=dleft | ~ok,
     )

@@ -37,13 +37,15 @@ from dryad_tpu.engine.predict import _accumulate, tree_leaves
 from dryad_tpu.objectives import get_objective
 
 _TREE_KEYS = ("feature", "threshold", "left", "right", "value", "is_cat",
-              "cat_bitset", "gain")
+              "cat_bitset", "gain", "default_left")
 
 
-@partial(jax.jit, static_argnames=("p", "B", "has_cat", "mesh", "platform"),
-         donate_argnums=(5, 6))
-def _step_jit(p, B, has_cat, mesh, platform, out, score, Xb, g_all, h_all,
-              bag, fmask, is_cat_feat, t, k):
+@partial(jax.jit,
+         static_argnames=("p", "B", "has_cat", "mesh", "platform",
+                          "learn_missing"),
+         donate_argnums=(6, 7))
+def _step_jit(p, B, has_cat, mesh, platform, learn_missing, out, score, Xb,
+              g_all, h_all, bag, fmask, is_cat_feat, t, k):
     """One (iteration, class) tree: grow, record into slot t, update scores.
 
     Module-level jit keyed on the static (params, bins, mesh) triple — the
@@ -59,11 +61,12 @@ def _step_jit(p, B, has_cat, mesh, platform, out, score, Xb, g_all, h_all,
 
         tree, leaves = grow_sharded(
             p, B, has_cat, mesh, Xb, g, h, bag, fmask, is_cat_feat,
-            platform=platform,
+            platform=platform, learn_missing=learn_missing,
         )
     else:
         tree = grow_any(p, B, Xb, g, h, bag, fmask, is_cat_feat,
-                        has_cat=has_cat, platform=platform)
+                        has_cat=has_cat, platform=platform,
+                        learn_missing=learn_missing)
         # a static depth bound keeps the traversal a fori_loop (a traced
         # bound lowers to a slower while_loop); depthwise growth has one
         depth_bound = (p.max_depth if p.growth == "depthwise" and p.max_depth > 0
@@ -145,6 +148,7 @@ def _empty_out_device(T: int, M: int, cat_words: int) -> dict:
         "is_cat": jnp.zeros((T, M), bool),
         "cat_bitset": jnp.zeros((T, M, cat_words), jnp.uint32),
         "gain": jnp.zeros((T, M), jnp.float32),
+        "default_left": jnp.ones((T, M), bool),
         "max_depth": jnp.zeros((T,), jnp.int32),
     }
 
@@ -163,6 +167,7 @@ def _materialize(p, mapper, out, T, init, max_depth_prev, best_iteration,
         best_iteration=best_iteration,
         gain=host["gain"],
         train_state={"best_value": best_value, "stale": int(stale)},
+        default_left=host["default_left"],
     )
 
 
@@ -242,9 +247,20 @@ def train_device(
         return _grads_jit(p_key, N, K, pad, score, y, weight, qoff_j,
                           rank_row, rank_col, rank_Q, rank_S)
 
+    learn_missing = data.has_missing
+    if jax.process_count() > 1:
+        # multi-host: the flag is a static jit arg and rows are sharded per
+        # process — agree globally (any host has missing => all scan both
+        # planes) or hosts would trace divergent programs and grow
+        # different trees, breaking N-shard ≡ 1-shard
+        from jax.experimental import multihost_utils
+
+        learn_missing = bool(
+            multihost_utils.process_allgather(np.int32(learn_missing)).max())
+
     def step(out, score, g_all, h_all, bag, fmask, t, k):
-        return _step_jit(p_key, B, has_cat, mesh, plat, out, score, Xb,
-                         g_all, h_all, bag, fmask, is_cat_feat, t, k)
+        return _step_jit(p_key, B, has_cat, mesh, plat, learn_missing, out,
+                         score, Xb, g_all, h_all, bag, fmask, is_cat_feat, t, k)
 
     # ---- resume / warm start -------------------------------------------------
     out = _empty_out_device(T, p.max_nodes, CAT_WORDS)

@@ -27,6 +27,9 @@ _SO = os.path.join(_HERE, "libdryad_native.so")
 
 _lib: Optional[ctypes.CDLL] = None
 _tried = False
+# must equal dryad_abi_version() in the .so; a stale binary that failed to
+# rebuild would otherwise be called through the wrong signature
+_ABI_VERSION = 2
 
 _i64 = ctypes.c_int64
 _f32p = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
@@ -67,6 +70,11 @@ def _load() -> Optional[ctypes.CDLL]:
     try:
         lib = ctypes.CDLL(_SO)
 
+        lib.dryad_abi_version.restype = _i64
+        lib.dryad_abi_version.argtypes = []
+        if lib.dryad_abi_version() != _ABI_VERSION:
+            return None
+
         lib.sketch_numerical.restype = _i64
         lib.sketch_numerical.argtypes = [_f32p, _i64, _i64, _f32p]
         lib.bin_matrix.restype = None
@@ -76,8 +84,8 @@ def _load() -> Optional[ctypes.CDLL]:
         ]
         lib.predict_accumulate.restype = None
         lib.predict_accumulate.argtypes = [
-            _u16p, _i64, _i64, _i32p, _i32p, _i32p, _i32p, _u8p, _u32p, _f32p,
-            _i64, _i64, _i64, _i64, _i64, _f32p,
+            _u16p, _i64, _i64, _i32p, _i32p, _i32p, _i32p, _u8p, _u32p, _u8p,
+            _f32p, _i64, _i64, _i64, _i64, _i64, _f32p,
         ]
     except (OSError, AttributeError):
         # stale/incompatible binary: fall back to numpy rather than crash
@@ -162,6 +170,9 @@ def predict_accumulate(
         np.ascontiguousarray(trees["right"], np.int32),
         np.ascontiguousarray(trees["is_cat"], np.uint8),
         cat_bitset,
+        np.ascontiguousarray(
+            trees.get("default_left", np.ones_like(trees["feature"], dtype=bool)),
+            np.uint8),
         np.ascontiguousarray(trees["value"], np.float32),
         int(num_trees), max_nodes, cat_words, int(K), max(int(depth_bound), 1),
         score,

@@ -21,6 +21,11 @@
 
 extern "C" {
 
+// Bumped on any signature change; the ctypes loader refuses a mismatched
+// (or symbol-less, pre-versioning) binary and falls back to numpy instead
+// of calling through a stale ABI.
+int64_t dryad_abi_version() { return 2; }
+
 // ---------------------------------------------------------------------------
 // Numerical quantile sketch: reproduce _sketch_numerical (data/sketch.py).
 //   col: n float32 values (may contain NaN/inf)
@@ -153,7 +158,8 @@ void tree_leaves(const uint16_t* Xb, int64_t n, int64_t F,
                  const int32_t* feature, const int32_t* threshold,
                  const int32_t* left, const int32_t* right,
                  const uint8_t* is_cat, const uint32_t* cat_bitset,
-                 int64_t cat_words, int64_t depth_bound, int32_t* out_leaf) {
+                 const uint8_t* default_left, int64_t cat_words,
+                 int64_t depth_bound, int32_t* out_leaf) {
     for (int64_t i = 0; i < n; ++i) {
         int32_t node = 0;
         for (int64_t d = 0; d < depth_bound; ++d) {
@@ -166,7 +172,9 @@ void tree_leaves(const uint16_t* Xb, int64_t n, int64_t F,
                 if (w > cat_words - 1) w = cat_words - 1;
                 go_left = (cat_bitset[node * cat_words + w] >> (b & 31)) & 1u;
             } else {
-                go_left = b <= threshold[node];
+                // learned missing direction: bin 0 only goes left when the
+                // node's default_left bit is set (cpu/predict.py contract)
+                go_left = b <= threshold[node] && (default_left[node] || b != 0);
             }
             node = go_left ? left[node] : right[node];
         }
@@ -179,6 +187,7 @@ void predict_accumulate(const uint16_t* Xb, int64_t n, int64_t F,
                         const int32_t* feature, const int32_t* threshold,
                         const int32_t* left, const int32_t* right,
                         const uint8_t* is_cat, const uint32_t* cat_bitset,
+                        const uint8_t* default_left,
                         const float* value, int64_t num_trees, int64_t max_nodes,
                         int64_t cat_words, int64_t K, int64_t depth_bound,
                         float* score) {
@@ -187,7 +196,7 @@ void predict_accumulate(const uint16_t* Xb, int64_t n, int64_t F,
         const int64_t off = t * max_nodes;
         tree_leaves(Xb, n, F, feature + off, threshold + off, left + off,
                     right + off, is_cat + off, cat_bitset + off * cat_words,
-                    cat_words, depth_bound, leaves.data());
+                    default_left + off, cat_words, depth_bound, leaves.data());
         const float* vt = value + off;
         const int64_t k = t % K;
         for (int64_t i = 0; i < n; ++i) {

@@ -1,0 +1,109 @@
+"""Learned per-node missing-value default direction (SURVEY.md §2 #3-6:
+LightGBM/XGBoost-family engines learn which child missing rows follow)."""
+
+import numpy as np
+import pytest
+
+import dryad_tpu as dryad
+from dryad_tpu.metrics import auc
+
+
+def _informative_missing(n=4000, seed=11):
+    """Missing x0 behaves like LARGE x0: y = (x0 > 1) OR isnan(x0).
+
+    A single stump can only be consistent with this rule by sending missing
+    RIGHT at the x0 <= 1 split — the always-left rule needs two levels."""
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, 4)).astype(np.float32)
+    miss = rng.random(n) < 0.3
+    y = ((X[:, 0] > 1.0) | miss).astype(np.float32)
+    X[miss, 0] = np.nan
+    return X, y
+
+
+def test_stump_learns_missing_right():
+    X, y = _informative_missing()
+    ds = dryad.Dataset(X, y, max_bins=64)
+    assert ds.has_missing
+    b = dryad.train(dict(objective="binary", num_trees=1, num_leaves=2,
+                         max_depth=1, max_bins=64, learning_rate=1.0,
+                         min_data_in_leaf=1), ds, backend="cpu")
+    # the root must split on x0 with missing sent right
+    assert b.feature[0, 0] == 0
+    assert not b.default_left[0, 0]
+    # and that stump separates the classes essentially perfectly
+    a = auc(y, b.predict(X))
+    assert a > 0.99
+
+
+@pytest.mark.parametrize("growth", ["leafwise", "depthwise"])
+def test_missing_direction_cpu_tpu_parity(growth):
+    rng = np.random.default_rng(13)
+    X = rng.normal(size=(3000, 6)).astype(np.float32)
+    y = (X[:, 0] - X[:, 1] + 0.3 * rng.normal(size=3000) > 0).astype(np.float32)
+    X[rng.random(X.shape) < 0.2] = np.nan  # 20% missing everywhere
+    ds = dryad.Dataset(X, y, max_bins=32)
+    p = dict(objective="binary", num_trees=10, num_leaves=15, max_bins=32)
+    if growth == "depthwise":
+        p.update(growth="depthwise", max_depth=4)
+    b_cpu = dryad.train(p, ds, backend="cpu")
+    b_tpu = dryad.train(p, ds, backend="tpu")
+    np.testing.assert_array_equal(b_cpu.feature, b_tpu.feature)
+    np.testing.assert_array_equal(b_cpu.threshold, b_tpu.threshold)
+    np.testing.assert_array_equal(b_cpu.default_left, b_tpu.default_left)
+    # bit-identical predict on the same booster across backends
+    np.testing.assert_array_equal(
+        b_cpu.predict_binned(ds.X_binned, raw_score=True, backend="cpu"),
+        b_cpu.predict_binned(ds.X_binned, raw_score=True, backend="tpu"),
+    )
+    # some direction bit must actually have been learned on this data
+    internal = b_cpu.feature >= 0
+    assert (~b_cpu.default_left[internal]).any()
+
+
+def test_missing_free_data_keeps_all_left():
+    rng = np.random.default_rng(17)
+    X = rng.normal(size=(2000, 4)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+    ds = dryad.Dataset(X, y, max_bins=32)
+    assert not ds.has_missing
+    b = dryad.train(dict(objective="binary", num_trees=5, num_leaves=7,
+                         max_bins=32), ds, backend="cpu")
+    assert b.default_left.all()
+
+
+def test_save_load_roundtrip_preserves_direction(tmp_path):
+    X, y = _informative_missing(seed=19)
+    ds = dryad.Dataset(X, y, max_bins=64)
+    b = dryad.train(dict(objective="binary", num_trees=8, num_leaves=15,
+                         max_bins=64), ds, backend="cpu")
+    path = str(tmp_path / "m.dryad")
+    b.save(path)
+    b2 = dryad.Booster.load(path)
+    np.testing.assert_array_equal(b.default_left, b2.default_left)
+    np.testing.assert_array_equal(b.predict(X, raw_score=True),
+                                  b2.predict(X, raw_score=True))
+
+
+def test_native_predict_honors_direction():
+    from dryad_tpu import native
+
+    if not native.available():
+        pytest.skip("native library unavailable")
+    X, y = _informative_missing(seed=23)
+    ds = dryad.Dataset(X, y, max_bins=64)
+    b = dryad.train(dict(objective="binary", num_trees=6, num_leaves=15,
+                         max_bins=64), ds, backend="cpu")
+    internal = b.feature >= 0
+    assert (~b.default_left[internal]).any()
+    got = native.predict_accumulate(
+        np.ascontiguousarray(ds.X_binned, np.uint16), b.tree_arrays(),
+        b.init_score, b.num_total_trees, 1, b.max_depth_seen)
+    from dryad_tpu.cpu.predict import predict_tree_leaves
+
+    want = np.broadcast_to(b.init_score, (X.shape[0], 1)).astype(np.float32).copy()
+    for t in range(b.num_total_trees):
+        leaves = predict_tree_leaves(b.tree_arrays(), ds.X_binned, t,
+                                     b.max_depth_seen)
+        want[:, 0] += b.value[t, leaves]
+    np.testing.assert_array_equal(got, want)
