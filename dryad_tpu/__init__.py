@@ -29,6 +29,7 @@ def train(
     train_set: Optional[Dataset] = None,
     valid_sets: Optional[list[Dataset]] = None,
     *,
+    valid_names: Optional[list[str]] = None,
     backend: str = "auto",
     init_booster: Optional[Booster] = None,
     callback=None,
@@ -53,7 +54,13 @@ def train(
     p = make_params(params, **kw)
     if train_set is None:
         raise ValueError("train_set is required")
-    valid = valid_sets[0] if valid_sets else None
+    # every valid set is evaluated and logged per iteration; early stopping
+    # watches the FIRST one (LightGBM semantics)
+    valid = list(valid_sets) if valid_sets else None
+    if valid_names is not None:
+        if valid is None or len(valid_names) != len(valid):
+            raise ValueError("valid_names must match valid_sets in length")
+        valid = list(zip(valid_names, valid))
     if backend == "auto":
         backend = "tpu" if (_accelerator_present() and _engine_present()) else "cpu"
 

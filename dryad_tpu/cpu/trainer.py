@@ -66,6 +66,38 @@ def goss_select_np(params: Params, g_all: np.ndarray, u: np.ndarray):
     return is_top | picked, weight
 
 
+def normalize_valids(valid) -> list[tuple[str, Dataset]]:
+    """Accept None | Dataset | list[Dataset | (name, Dataset)] → [(name, ds)].
+
+    A single anonymous set keeps the historic name ``valid`` (JSONL keys
+    like ``valid_auc``); multiple anonymous sets become ``valid_0``,
+    ``valid_1``, ... (LightGBM-style).  Early stopping always watches the
+    first set."""
+    if valid is None:
+        return []
+    if isinstance(valid, Dataset):
+        return [("valid", valid)]
+    out: list[tuple[str, Dataset]] = []
+    single = len(valid) == 1
+    for i, v in enumerate(valid):
+        if isinstance(v, tuple):
+            out.append((str(v[0]), v[1]))
+        else:
+            out.append(("valid" if single else f"valid_{i}", v))
+    return out
+
+
+def update_best(best_iteration, best_value, stale, iteration, value, higher):
+    """Early-stopping bookkeeping shared by every eval path (CPU sync,
+    device sync, device deferred replay) — one definition so the three can
+    never diverge.  Returns (best_iteration, best_value, stale)."""
+    improved = best_value is None or (
+        value > best_value if higher else value < best_value)
+    if improved:
+        return iteration + 1, value, 0
+    return best_iteration, best_value, stale + 1
+
+
 def sample_masks(params: Params, iteration: int, num_rows: int, num_features: int):
     """Host-side deterministic bagging/colsample masks, shared by both backends."""
     row_mask = None
@@ -312,17 +344,18 @@ def train_cpu(
         start_iter = prev.num_iterations
         max_depth_seen = prev.max_depth_seen
 
-    # validation / early stopping state (SURVEY.md §5 metrics stream)
-    vXb = valid.X_binned if valid is not None else None
-    vscore = (
+    # validation / early stopping state (SURVEY.md §5 metrics stream);
+    # every set is scored, the FIRST drives early stopping
+    valids = normalize_valids(valid)
+    vXbs = [v.X_binned for _, v in valids]
+    vscores = [
         np.broadcast_to(init, (vXb.shape[0], K)).astype(np.float32).copy()
-        if valid is not None
-        else None
-    )
+        for vXb in vXbs
+    ]
     best_iteration, best_value, stale = -1, None, 0
     if init_booster is not None:
         # resume continues the eval/early-stop state exactly where it stopped
-        if valid is not None:
+        for vXb, vscore in zip(vXbs, vscores):
             for t in range(init_booster.num_total_trees):
                 vleaves = predict_tree_leaves(
                     init_booster.tree_arrays(), vXb, t, init_booster.max_depth_seen)
@@ -335,7 +368,7 @@ def train_cpu(
     for it in range(start_iter, T // K):
         # resuming from a checkpoint taken at the early-stop boundary must
         # not grow past it (the restored stale counter already says stop)
-        if (valid is not None and p.early_stopping_rounds
+        if (valids and p.early_stopping_rounds
                 and stale >= p.early_stopping_rounds):
             T = it * K
             break
@@ -361,7 +394,7 @@ def train_cpu(
             max_depth_seen = max(max_depth_seen, d)
             leaves = predict_tree_leaves(out, Xb, t, max(max_depth_seen, 1))
             score[:, k] += out["value"][t, leaves]
-            if valid is not None:
+            for vXb, vscore in zip(vXbs, vscores):
                 vleaves = predict_tree_leaves(out, vXb, t, max(max_depth_seen, 1))
                 vscore[:, k] += out["value"][t, vleaves]
 
@@ -370,22 +403,23 @@ def train_cpu(
         # the training tail is never silently unscored
         eval_now = (it + 1) % p.eval_period == 0 or it + 1 == T // K
         stop = False
-        if valid is not None and eval_now:
+        if valids and eval_now:
             from dryad_tpu.metrics import evaluate_raw
 
-            name, value, higher = evaluate_raw(
-                p.objective, p.metric, valid.y, vscore if K > 1 else vscore[:, 0],
-                valid.query_offsets, p.ndcg_at,
-            )
-            info[f"valid_{name}"] = value
-            improved = best_value is None or (value > best_value if higher else value < best_value)
-            if improved:
-                best_iteration, best_value, stale = it + 1, value, 0
-            else:
-                stale += 1
-            if p.early_stopping_rounds and stale >= p.early_stopping_rounds:
-                stop = True
-                T = (it + 1) * K  # trim unfilled trailing trees
+            for vi, ((vname, vds), vscore) in enumerate(zip(valids, vscores)):
+                name, value, higher = evaluate_raw(
+                    p.objective, p.metric, vds.y,
+                    vscore if K > 1 else vscore[:, 0],
+                    vds.query_offsets, p.ndcg_at,
+                )
+                info[f"{vname}_{name}"] = value
+                if vi > 0:
+                    continue  # early stopping watches the first set only
+                best_iteration, best_value, stale = update_best(
+                    best_iteration, best_value, stale, it, value, higher)
+                if p.early_stopping_rounds and stale >= p.early_stopping_rounds:
+                    stop = True
+                    T = (it + 1) * K  # trim unfilled trailing trees
         # stop falls through to the callback and the due boundary checkpoint
         # before breaking — same checkpoint stream as the device trainer
         if callback is not None:

@@ -30,7 +30,7 @@ import numpy as np
 
 from dryad_tpu.booster import CAT_WORDS, Booster
 from dryad_tpu.config import Params
-from dryad_tpu.cpu.trainer import goss_uniform, sample_masks
+from dryad_tpu.cpu.trainer import goss_uniform, sample_masks, update_best
 from dryad_tpu.dataset import Dataset
 from dryad_tpu.engine.grower import grow_any
 from dryad_tpu.engine.predict import _accumulate, tree_leaves
@@ -288,15 +288,32 @@ def train_device(
         start_iter = prev.num_iterations
         max_depth_prev = prev.max_depth_seen
 
-    vXb = jnp.asarray(valid.X_binned) if valid is not None else None
-    vscore = (
-        jnp.broadcast_to(jnp.asarray(init), (valid.num_rows, K)).astype(jnp.float32)
-        if valid is not None
-        else None
-    )
-    if valid is not None and init_booster is not None:
-        vscore = _accumulate(prev_trees, vXb, jnp.asarray(init),
-                             max(max_depth_prev, 1))
+    # every valid set is scored ON DEVICE (metrics/device.py); the FIRST
+    # drives early stopping.  When something needs the value mid-run (early
+    # stopping, a callback, checkpoint state) each eval fetches ONE f32
+    # scalar; otherwise all evals stay device-side until training ends and
+    # best_iteration is replayed from the bulk fetch — zero per-iteration
+    # syncs even with validation.
+    from dryad_tpu.cpu.trainer import normalize_valids
+    from dryad_tpu.metrics.device import make_evaluator
+
+    valids = normalize_valids(valid)
+    evaluators = [make_evaluator(p.objective, p.metric, vds, p.ndcg_at)
+                  for _, vds in valids]
+    sync_eval = (bool(p.early_stopping_rounds) or callback is not None
+                 or checkpointer is not None)
+    deferred: list[tuple[int, list]] = []
+    vXbs = [jnp.asarray(v.X_binned) for _, v in valids]
+    vscores = [
+        jnp.broadcast_to(jnp.asarray(init), (v.num_rows, K)).astype(jnp.float32)
+        for _, v in valids
+    ]
+    if init_booster is not None:
+        vscores = [
+            _accumulate(prev_trees, vXb, jnp.asarray(init),
+                        max(max_depth_prev, 1))
+            for vXb in vXbs
+        ]
     best_iteration, best_value, stale = -1, None, 0
     if init_booster is not None:
         # resume continues the eval/early-stop state exactly where it stopped
@@ -314,7 +331,7 @@ def train_device(
     for it in range(start_iter, T // K):
         # a checkpoint taken AT the early-stop boundary restores stale >=
         # rounds; growing anything past it would diverge from the stopped run
-        if (valid is not None and p.early_stopping_rounds
+        if (valids and p.early_stopping_rounds
                 and stale >= p.early_stopping_rounds):
             T = it * K
             break
@@ -339,9 +356,9 @@ def train_device(
         for k in range(K):
             t = it * K + k
             out, score = step(out, score, g_all, h_all, bag, fmask, t, k)
-            if valid is not None:
-                vscore = vscore.at[:, k].set(
-                    _apply_valid_jit(out, t, vXb, vscore[:, k],
+            for vi, vXb in enumerate(vXbs):
+                vscores[vi] = vscores[vi].at[:, k].set(
+                    _apply_valid_jit(out, t, vXb, vscores[vi][:, k],
                                      out["max_depth"][t])
                 )
 
@@ -350,23 +367,24 @@ def train_device(
         # eval every eval_period-th iteration, always including the last so
         # the training tail is never silently unscored
         eval_now = (it + 1) % p.eval_period == 0 or it + 1 == T // K
-        if valid is not None and eval_now:
-            from dryad_tpu.metrics import evaluate_raw
-
-            vs = np.asarray(vscore)  # forced sync: metric eval on host
-            name, value, higher = evaluate_raw(
-                p.objective, p.metric, valid.y, vs if K > 1 else vs[:, 0],
-                valid.query_offsets, p.ndcg_at,
-            )
-            info[f"valid_{name}"] = value
-            improved = best_value is None or (
-                value > best_value if higher else value < best_value)
-            if improved:
-                best_iteration, best_value, stale = it + 1, value, 0
+        if valids and eval_now:
+            vals_dev = [fn(vscores[vi])
+                        for vi, (_, _, fn) in enumerate(evaluators)]
+            if not sync_eval:
+                deferred.append((it, vals_dev))
             else:
-                stale += 1
-            if p.early_stopping_rounds and stale >= p.early_stopping_rounds:
-                stop = True
+                vals = jax.device_get(vals_dev)  # ONE fetch for all sets
+                for vi, ((vname, _), (mname, higher, _)) in enumerate(
+                        zip(valids, evaluators)):
+                    value = float(vals[vi])
+                    info[f"{vname}_{mname}"] = value
+                    if vi > 0:
+                        continue  # early stopping watches the first set only
+                    best_iteration, best_value, stale = update_best(
+                        best_iteration, best_value, stale, it, value, higher)
+                    if (p.early_stopping_rounds
+                            and stale >= p.early_stopping_rounds):
+                        stop = True
         if callback is not None:
             callback(it, info)
         if checkpointer is not None and checkpointer.due(it + 1):
@@ -379,6 +397,30 @@ def train_device(
             T = (it + 1) * K
             break
 
+    # deferred evals: one bulk fetch, then replay the improvement bookkeeping
+    # (first set) via the shared update_best so best_iteration matches the
+    # synchronous path exactly; the full per-set history lands on the
+    # booster (train_state["eval_history"]) since no callback saw it live
+    eval_history = None
+    if deferred:
+        fetched = jax.device_get([vals for _, vals in deferred])
+        _, higher0, _ = evaluators[0]
+        eval_history = {
+            f"{vname}_{mname}": [] for (vname, _), (mname, _, _)
+            in zip(valids, evaluators)
+        }
+        for (it_d, _), vals in zip(deferred, fetched):
+            for vi, ((vname, _), (mname, _, _)) in enumerate(
+                    zip(valids, evaluators)):
+                eval_history[f"{vname}_{mname}"].append(
+                    [it_d, float(vals[vi])])
+            best_iteration, best_value, stale = update_best(
+                best_iteration, best_value, stale, it_d, float(vals[0]),
+                higher0)
+
     # ---- the single end-of-training fetch ------------------------------------
-    return _materialize(p, data.mapper, out, T, init, max_depth_prev,
-                        best_iteration, best_value, stale)
+    booster = _materialize(p, data.mapper, out, T, init, max_depth_prev,
+                           best_iteration, best_value, stale)
+    if eval_history is not None:
+        booster.train_state["eval_history"] = eval_history
+    return booster

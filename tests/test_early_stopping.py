@@ -24,6 +24,42 @@ def test_valid_eval_and_early_stopping():
     np.testing.assert_array_equal(p_best, p_explicit)
 
 
+@pytest.mark.parametrize("backend", ["cpu", "tpu"])
+def test_multiple_valid_sets(backend):
+    X, y = datasets.higgs_like(9000, seed=21)
+    ds = dryad.Dataset(X[:6000], y[:6000])
+    dv1 = ds.bind(X[6000:7500], y[6000:7500])
+    dv2 = ds.bind(X[7500:], y[7500:])
+    seen = []
+    b = dryad.train(
+        {"objective": "binary", "num_trees": 15, "num_leaves": 15,
+         "early_stopping_rounds": 4},
+        ds, valid_sets=[dv1, dv2], backend=backend,
+        callback=lambda it, info: seen.append(info),
+    )
+    evaled = [s for s in seen if len(s) > 1]
+    # both sets scored every evaluation, under per-set names
+    assert all("valid_0_auc" in s and "valid_1_auc" in s for s in evaled)
+    assert b.best_iteration > 0
+    # early stopping tracked the FIRST set: best_iteration argmaxes its curve
+    curve = [s["valid_0_auc"] for s in evaled]
+    assert curve[b.best_iteration - 1] == max(curve[: b.best_iteration])
+
+
+def test_valid_names():
+    X, y = datasets.higgs_like(4000, seed=23)
+    ds = dryad.Dataset(X[:3000], y[:3000])
+    dv = ds.bind(X[3000:], y[3000:])
+    seen = []
+    dryad.train({"objective": "binary", "num_trees": 5, "num_leaves": 7},
+                ds, valid_sets=[dv, ds], valid_names=["holdout", "train"],
+                backend="cpu", callback=lambda it, info: seen.append(info))
+    assert all("holdout_auc" in s and "train_auc" in s for s in seen)
+    with pytest.raises(ValueError, match="valid_names"):
+        dryad.train({"objective": "binary", "num_trees": 2}, ds,
+                    valid_sets=[dv], valid_names=["a", "b"], backend="cpu")
+
+
 def test_depthwise_grows_balanced_levels():
     X, y = datasets.higgs_like(6000, seed=3)
     ds = dryad.Dataset(X, y)
