@@ -300,9 +300,17 @@ def train_device(
     valids = normalize_valids(valid)
     evaluators = [make_evaluator(p.objective, p.metric, vds, p.ndcg_at)
                   for _, vds in valids]
-    sync_eval = (bool(p.early_stopping_rounds) or callback is not None
-                 or checkpointer is not None)
+    # a checkpointer does NOT force per-eval syncs: deferred evals are
+    # flushed (bulk fetch + replay) right before each due checkpoint so the
+    # saved best_iteration/stale state is exact
+    sync_eval = bool(p.early_stopping_rounds) or callback is not None
     deferred: list[tuple[int, list]] = []
+    # resume keeps the prior segment's deferred history so the merged run
+    # matches the uninterrupted one (CLAUDE.md resume invariant)
+    eval_history: dict[str, list] | None = None
+    if init_booster is not None and init_booster.train_state.get("eval_history"):
+        eval_history = {k: list(v) for k, v in
+                        init_booster.train_state["eval_history"].items()}
     vXbs = [jnp.asarray(v.X_binned) for _, v in valids]
     vscores = [
         jnp.broadcast_to(jnp.asarray(init), (v.num_rows, K)).astype(jnp.float32)
@@ -320,6 +328,28 @@ def train_device(
         best_iteration = init_booster.best_iteration
         best_value = init_booster.train_state.get("best_value")
         stale = init_booster.train_state.get("stale", 0)
+
+    def flush_deferred():
+        """Bulk-fetch pending deferred evals and replay the bookkeeping via
+        the shared update_best — called before each due checkpoint and at
+        training end, so the deferred path's state is exact wherever it is
+        observed while staying fetch-free in between."""
+        nonlocal best_iteration, best_value, stale, eval_history
+        if not deferred:
+            return
+        fetched = jax.device_get([vals for _, vals in deferred])
+        _, higher0, _ = evaluators[0]
+        if eval_history is None:
+            eval_history = {}
+        for (it_d, _), vals in zip(deferred, fetched):
+            for vi, ((vname, _), (mname, _, _)) in enumerate(
+                    zip(valids, evaluators)):
+                eval_history.setdefault(f"{vname}_{mname}", []).append(
+                    [it_d, float(vals[vi])])
+            best_iteration, best_value, stale = update_best(
+                best_iteration, best_value, stale, it_d, float(vals[0]),
+                higher0)
+        deferred.clear()
 
     # pad rows are bagged out permanently: they must never touch a histogram
     ones_rows = jnp.asarray(np.pad(np.ones((N,), bool), (0, pad)))
@@ -388,35 +418,21 @@ def train_device(
         if callback is not None:
             callback(it, info)
         if checkpointer is not None and checkpointer.due(it + 1):
-            checkpointer.save(
-                _materialize(p, data.mapper, out, (it + 1) * K, init,
-                             max_depth_prev, best_iteration, best_value, stale),
-                it + 1,
-            )
+            flush_deferred()
+            ckpt = _materialize(p, data.mapper, out, (it + 1) * K, init,
+                                max_depth_prev, best_iteration, best_value,
+                                stale)
+            if eval_history is not None:
+                ckpt.train_state["eval_history"] = eval_history
+            checkpointer.save(ckpt, it + 1)
         if stop:
             T = (it + 1) * K
             break
 
-    # deferred evals: one bulk fetch, then replay the improvement bookkeeping
-    # (first set) via the shared update_best so best_iteration matches the
-    # synchronous path exactly; the full per-set history lands on the
-    # booster (train_state["eval_history"]) since no callback saw it live
-    eval_history = None
-    if deferred:
-        fetched = jax.device_get([vals for _, vals in deferred])
-        _, higher0, _ = evaluators[0]
-        eval_history = {
-            f"{vname}_{mname}": [] for (vname, _), (mname, _, _)
-            in zip(valids, evaluators)
-        }
-        for (it_d, _), vals in zip(deferred, fetched):
-            for vi, ((vname, _), (mname, _, _)) in enumerate(
-                    zip(valids, evaluators)):
-                eval_history[f"{vname}_{mname}"].append(
-                    [it_d, float(vals[vi])])
-            best_iteration, best_value, stale = update_best(
-                best_iteration, best_value, stale, it_d, float(vals[0]),
-                higher0)
+    # deferred evals: one final bulk fetch + replay; the full per-set
+    # history lands on the booster (train_state["eval_history"]) since no
+    # callback saw the values live
+    flush_deferred()
 
     # ---- the single end-of-training fetch ------------------------------------
     booster = _materialize(p, data.mapper, out, T, init, max_depth_prev,

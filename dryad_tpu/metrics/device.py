@@ -160,6 +160,9 @@ def make_evaluator(objective: str, metric: str, valid_ds, ndcg_at: int = 10):
     returned fn is a reusable jitted program keyed on (metric, shapes)."""
     name = metric or DEFAULT_METRIC[objective]
     name = _METRIC_ALIASES.get(name, name)
+    if name not in HIGHER_BETTER:
+        # same exception type as the CPU backend's evaluate_raw
+        raise ValueError(f"unknown metric {name!r}")
     y = jnp.asarray(np.asarray(valid_ds.y, np.float32))
     qids = None
     if name == "ndcg":

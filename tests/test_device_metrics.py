@@ -93,6 +93,31 @@ def test_ndcg_matches_ragged_queries():
     assert abs(got - want) < 1e-5
 
 
+def test_checkpointer_keeps_deferred_eval_and_resume_merges_history(tmp_path):
+    """A checkpointer must not force per-eval fetches: deferred evals flush
+    at due() boundaries, and a resumed run merges the prior segment's
+    history so it matches the uninterrupted run."""
+    from dryad_tpu.datasets import higgs_like
+
+    X, y = higgs_like(5000, seed=47)
+    ds = dryad.Dataset(X[:4000], y[:4000], max_bins=32)
+    dv = ds.bind(X[4000:], y[4000:])
+    p = dict(objective="binary", num_trees=12, num_leaves=7, max_bins=32)
+    full = dryad.train(p, ds, valid_sets=[dv], backend="tpu")
+    # interrupted: checkpoint every 5, resume from iteration 5 or 10
+    d = str(tmp_path / "ck")
+    dryad.train(dict(p, num_trees=7), ds, valid_sets=[dv], backend="tpu",
+                checkpoint_dir=d, checkpoint_every=5)
+    b = dryad.train(p, ds, valid_sets=[dv], backend="tpu",
+                    checkpoint_dir=d, checkpoint_every=5, resume=True)
+    want = full.train_state["eval_history"]["valid_auc"]
+    got = b.train_state["eval_history"]["valid_auc"]
+    assert [it for it, _ in got] == [it for it, _ in want] == list(range(12))
+    np.testing.assert_allclose([v for _, v in got], [v for _, v in want],
+                               rtol=1e-6)
+    assert b.best_iteration == full.best_iteration
+
+
 def test_trainer_uses_device_eval_and_sets_best_iteration():
     from dryad_tpu.datasets import higgs_like
 

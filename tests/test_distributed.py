@@ -93,6 +93,60 @@ def test_sharded_depthwise_levelwise_path(mesh):
         np.testing.assert_array_equal(b1.tree_arrays()[k], b8.tree_arrays()[k])
 
 
+def test_sharded_goss_parity(mesh):
+    """GOSS's global |grad| quantile (a GSPMD sort over the sharded array —
+    the one collective beyond the histogram psum, documented in CLAUDE.md)
+    must select identical rows on any mesh."""
+    X, y = higgs_like(4096, seed=41)
+    ds = dryad.Dataset(X, y, max_bins=32)
+    from dryad_tpu.engine.train import train_device
+    from dryad_tpu.config import make_params
+
+    p = make_params(dict(objective="binary", num_trees=5, num_leaves=15,
+                         max_bins=32, boosting="goss", goss_top_rate=0.3,
+                         goss_other_rate=0.2, seed=7))
+    b1 = train_device(p, ds)
+    b8 = train_device(p, ds, mesh=mesh)
+    for k in ("feature", "threshold", "left", "right"):
+        np.testing.assert_array_equal(b1.tree_arrays()[k], b8.tree_arrays()[k])
+    np.testing.assert_allclose(b1.value, b8.value, atol=1e-3)
+
+
+def test_sharded_goss_padded_rows(mesh):
+    """Padded rows carry fake zero gradients — they must never enter the
+    top-quantile pick nor the Bernoulli pool when N % mesh != 0."""
+    X, y = higgs_like(4001, seed=43)
+    ds = dryad.Dataset(X, y, max_bins=32)
+    from dryad_tpu.engine.train import train_device
+    from dryad_tpu.config import make_params
+
+    p = make_params(dict(objective="binary", num_trees=4, num_leaves=8,
+                         max_bins=32, boosting="goss"))
+    b1 = train_device(p, ds)
+    b8 = train_device(p, ds, mesh=mesh)
+    np.testing.assert_array_equal(b1.feature, b8.feature)
+    np.testing.assert_array_equal(b1.threshold, b8.threshold)
+
+
+def test_sharded_lambdarank_parity(mesh):
+    """LambdaMART's padded-query scatter (PaddingPlan row/col ids) crosses
+    shard boundaries when queries straddle them; the sharded run must still
+    reproduce the single-device trees."""
+    from dryad_tpu.datasets import mslr_like
+    from dryad_tpu.engine.train import train_device
+    from dryad_tpu.config import make_params
+
+    X, y, group = mslr_like(120, seed=45)  # ragged queries, N % 8 != 0 likely
+    ds = dryad.Dataset(X, y, group=group, max_bins=32)
+    p = make_params(dict(objective="lambdarank", num_trees=4, num_leaves=15,
+                         max_bins=32))
+    b1 = train_device(p, ds)
+    b8 = train_device(p, ds, mesh=mesh)
+    for k in ("feature", "threshold", "left", "right"):
+        np.testing.assert_array_equal(b1.tree_arrays()[k], b8.tree_arrays()[k])
+    np.testing.assert_allclose(b1.value, b8.value, atol=1e-3)
+
+
 def test_sharded_weighted_parity(mesh):
     """Weights survive mesh padding/sharding (pad rows excluded by bag mask)."""
     rng = np.random.Generator(np.random.Philox(23))
