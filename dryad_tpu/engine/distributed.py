@@ -32,7 +32,6 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from dryad_tpu.config import Params
 from dryad_tpu.engine.grower import grow_any
-from dryad_tpu.engine.predict import tree_leaves
 
 AXIS = "data"
 
@@ -71,7 +70,8 @@ def grow_sharded(params: Params, total_bins: int, has_cat: bool,
             has_cat=has_cat, axis_name=AXIS, platform=platform,
             learn_missing=learn_missing,
         )
-        leaves = tree_leaves(tree, Xb_l, tree["max_depth"])
+        # per-shard leaf ids straight from the grower's partition state
+        leaves = tree.pop("row_leaf")
         return tree, leaves
 
     row = P(AXIS)

@@ -175,8 +175,11 @@ def grow_tree(
         )
 
     def hist_of(mask):
+        # bag gates HISTOGRAMS only; the row partition routes every row so
+        # the final row_slot directly yields each row's leaf (no separate
+        # post-grow traversal — at 10M rows that gather loop cost ~5 s/tree)
         return build_hist(
-            Xb, g, h, mask, B,
+            Xb, g, h, mask & bag_mask, B,
             rows_per_chunk=p.rows_per_chunk, axis_name=axis_name,
             precision=p.hist_precision, backend=p.hist_backend,
             platform=platform,
@@ -189,7 +192,10 @@ def grow_tree(
     # level and is the TPU throughput path.
 
     # ---- root ---------------------------------------------------------------
-    row_slot = jnp.where(bag_mask, 0, L).astype(jnp.int32)
+    # ALL rows partitioned (see hist_of); derived from bag_mask so the init
+    # inherits the varying-manual-axes of the shard under shard_map (a plain
+    # constant would make the grow-loop cond branches' vma types diverge)
+    row_slot = jnp.where(bag_mask, 0, 0).astype(jnp.int32)
     hist0 = hist_of(row_slot == 0)
     G0, H0, C0 = root_stats(hist0)
     ninf, pinf = jnp.float32(-jnp.inf), jnp.float32(jnp.inf)
@@ -365,4 +371,8 @@ def grow_tree(
         "cat_bitset": cat_bitset,
         "default_left": st["node_dleft"],
         "max_depth": st["max_depth"],
+        # per-row leaf node id, straight from the partition state — the
+        # train step's score update uses this instead of re-traversing
+        "row_leaf": jnp.maximum(st["slot_node"], 0)[
+            jnp.minimum(st["row_slot"], L - 1)],
     }

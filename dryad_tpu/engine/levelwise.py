@@ -88,8 +88,11 @@ def grow_tree_levelwise(
         )
 
     # ---- root (shared canonical construction) --------------------------------
-    row_slot = jnp.where(bag_mask, 0, L).astype(jnp.int32)
-    hist0 = build_hist(Xb, g, h, row_slot == 0, B,
+    # ALL rows are partitioned (bag gates histograms only) so the final
+    # row_slot yields each row's leaf without a separate traversal pass;
+    # derived from bag_mask to inherit the shard's varying-manual-axes
+    row_slot = jnp.where(bag_mask, 0, 0).astype(jnp.int32)
+    hist0 = build_hist(Xb, g, h, bag_mask, B,
                        rows_per_chunk=p.rows_per_chunk, axis_name=axis_name,
                        precision=p.hist_precision, backend=p.hist_backend,
                        platform=platform)
@@ -231,12 +234,16 @@ def grow_tree_levelwise(
             left_smaller = CL <= CR
             small_slot = jnp.where(left_smaller, sj, right_slot)
             large_slot = jnp.where(left_smaller, right_slot, sj)
-            # non-do candidates scatter to L+1 (out of bounds, dropped) so
-            # colof[L] stays P and out-of-bag rows are never selected
+            # non-do candidates scatter to L+1 (out of bounds, dropped);
+            # out-of-bag rows are excluded by the explicit bag_mask gate
+            # below — row_slot itself stays in [0, L-1] for every row now
+            # that the partition routes the whole dataset
             colof = jnp.full((L + 1,), P, jnp.int32).at[
                 jnp.where(do, small_slot, L + 1)].set(
                     jnp.arange(P, dtype=jnp.int32), mode="drop")
-            smallsel = colof[jnp.minimum(row_slot, L)]
+            # bag gates the histogram selection; out-of-bag rows are
+            # partitioned but never accumulated
+            smallsel = jnp.where(bag_mask, colof[jnp.minimum(row_slot, L)], P)
             # Single device, smaller children cover at most half the rows
             # (min(left,right) <= parent/2, parents disjoint) -> half the tile
             # grid.  Under shard_map the smaller child is chosen on GLOBAL
@@ -258,7 +265,9 @@ def grow_tree_levelwise(
                     jnp.where(do, large_slot, L + 1)].set(
                         jnp.arange(P, dtype=jnp.int32), mode="drop")
                 hist_large = build_hist_multi(
-                    Xb, g, h, largesel[jnp.minimum(row_slot, L)], P, B,
+                    Xb, g, h,
+                    jnp.where(bag_mask, largesel[jnp.minimum(row_slot, L)], P),
+                    P, B,
                     rows_per_chunk=p.rows_per_chunk, axis_name=axis_name,
                 precision=p.hist_precision,
                 )
@@ -351,4 +360,7 @@ def grow_tree_levelwise(
         "cat_bitset": cat_bitset,
         "default_left": st["node_dleft"],
         "max_depth": st["max_depth"],
+        # per-row leaf node id from the partition state (no re-traversal)
+        "row_leaf": jnp.maximum(st["slot_node"], 0)[
+            jnp.minimum(st["row_slot"], L - 1)],
     }

@@ -40,18 +40,12 @@ _TREE_KEYS = ("feature", "threshold", "left", "right", "value", "is_cat",
               "cat_bitset", "gain", "default_left")
 
 
-@partial(jax.jit,
-         static_argnames=("p", "B", "has_cat", "mesh", "platform",
-                          "learn_missing"),
-         donate_argnums=(6, 7))
-def _step_jit(p, B, has_cat, mesh, platform, learn_missing, out, score, Xb,
-              g_all, h_all, bag, fmask, is_cat_feat, t, k):
+def _step_body(p, B, has_cat, mesh, platform, learn_missing, out, score, Xb,
+               g_all, h_all, bag, fmask, is_cat_feat, t, k):
     """One (iteration, class) tree: grow, record into slot t, update scores.
 
-    Module-level jit keyed on the static (params, bins, mesh) triple — the
-    compiled program is reused across ``train_device`` calls (a closure-local
-    jit would recompile per call and dwarf the training itself).  ``out`` and
-    ``score`` are donated: the tree tables update in place on device.
+    Shared by the per-iteration ``_step_jit`` dispatch and the chunked
+    ``_chunk_jit`` fast path, so the two can never diverge.
     """
     out = dict(out)
     g = jnp.take(g_all, k, axis=1)
@@ -67,11 +61,9 @@ def _step_jit(p, B, has_cat, mesh, platform, learn_missing, out, score, Xb,
         tree = grow_any(p, B, Xb, g, h, bag, fmask, is_cat_feat,
                         has_cat=has_cat, platform=platform,
                         learn_missing=learn_missing)
-        # a static depth bound keeps the traversal a fori_loop (a traced
-        # bound lowers to a slower while_loop); depthwise growth has one
-        depth_bound = (p.max_depth if p.growth == "depthwise" and p.max_depth > 0
-                       else tree["max_depth"])
-        leaves = tree_leaves(tree, Xb, depth_bound)
+        # each row's leaf comes straight out of the grower's partition
+        # state — re-traversing 10M rows cost ~5 s/tree (gather-bound)
+        leaves = tree.pop("row_leaf")
     col = jnp.take(score, k, axis=1) + tree["value"][leaves]
     score = jax.lax.dynamic_update_index_in_dim(score, col, k, axis=1)
     for key in _TREE_KEYS:
@@ -80,14 +72,23 @@ def _step_jit(p, B, has_cat, mesh, platform, learn_missing, out, score, Xb,
     return out, score
 
 
-@partial(jax.jit, static_argnames=("p", "N", "K", "pad", "rank_Q", "rank_S"))
-def _grads_jit(p, N, K, pad, score, y, weight, qoff, rank_row_ids,
-               rank_col_ids, rank_Q, rank_S):
+_step_jit = partial(jax.jit,
+                    static_argnames=("p", "B", "has_cat", "mesh", "platform",
+                                     "learn_missing"))(_step_body)
+# Module-level jit keyed on the static (params, bins, mesh) triple — the
+# compiled program is reused across ``train_device`` calls (a closure-local
+# jit would recompile per call and dwarf the training itself).  out/score
+# are NOT donated: through the axon tunnel each donated buffer costs
+# ~220 ms of dispatch-time bookkeeping (measured; 18 ms undonated), and
+# double-buffering a 40 MB score is free next to the grower's working set.
+
+
+def _grads_body(p, N, K, pad, score, y, weight, qoff, rank_row_ids,
+                rank_col_ids, rank_Q, rank_S):
     """Per-iteration grad/hess (N+pad, K) from the pre-iteration score.
 
     All K class trees of one boosting iteration share this single pass —
-    exactly the CPU reference's semantics.  Module-level jit: reused across
-    ``train_device`` calls.
+    exactly the CPU reference's semantics.
     """
     obj = get_objective(p)
     if p.objective == "lambdarank":
@@ -107,6 +108,43 @@ def _grads_jit(p, N, K, pad, score, y, weight, qoff, rank_row_ids,
         return obj.grad_hess_jax(score, y, weight)
     g, h = obj.grad_hess_jax(score[:, 0], y, weight)
     return g[:, None], h[:, None]
+
+
+_grads_jit = partial(jax.jit,
+                     static_argnames=("p", "N", "K", "pad", "rank_Q",
+                                      "rank_S"))(_grads_body)
+
+
+@partial(jax.jit,
+         static_argnames=("p", "B", "has_cat", "mesh", "platform",
+                          "learn_missing", "N", "K", "pad", "rank_Q",
+                          "rank_S"))
+def _chunk_jit(p, B, has_cat, mesh, platform, learn_missing, N, K, pad,
+               rank_Q, rank_S, out, score, Xb, y, weight, bag, fmask,
+               is_cat_feat, qoff, rank_row, rank_col, it0, n_iters):
+    """``n_iters`` whole boosting iterations inside ONE program.
+
+    Through a remote device tunnel every host dispatch costs seconds at 10M
+    rows (measured ~5 s/iter of pure dispatch overhead vs the same body in
+    a fori_loop), so when no per-iteration host input is needed (no
+    bagging/colsample draw, no GOSS uniforms, no eval sync) the boosting
+    loop itself runs on device: grads are recomputed from the carried score
+    each trip — identical semantics to per-iteration dispatch.  ``it0`` and
+    ``n_iters`` are traced, so one compiled program serves every chunk and
+    tail length.
+    """
+    def body(i, carry):
+        out, score = carry
+        g_all, h_all = _grads_body(p, N, K, pad, score, y, weight, qoff,
+                                   rank_row, rank_col, rank_Q, rank_S)
+        for k in range(K):
+            t = (it0 + i) * K + k
+            out, score = _step_body(p, B, has_cat, mesh, platform,
+                                    learn_missing, out, score, Xb, g_all,
+                                    h_all, bag, fmask, is_cat_feat, t, k)
+        return out, score
+
+    return jax.lax.fori_loop(0, n_iters, body, (out, score))
 
 
 @partial(jax.jit, static_argnames=("p", "N"))
@@ -356,6 +394,55 @@ def train_device(
     if mesh is not None:
         ones_rows = shard_rows(mesh, ones_rows)[0]
     ones_feat = jnp.ones((F,), bool)
+
+    # ---- chunked fast path: whole iterations inside one program --------------
+    # When nothing needs the host between iterations (no bagging/colsample
+    # Philox draw, no GOSS uniforms, no validation sync) the boosting loop
+    # runs on device in blocks — through the remote tunnel each host
+    # dispatch costs ~5 s at 10M rows, the dominant non-compute cost.
+    chunkable = (not valids and p.boosting == "gbdt"
+                 and p.subsample >= 1.0 and p.colsample >= 1.0)
+    if chunkable:
+        # the tunnel kills single programs running longer than ~60 s
+        # (measured: 45 s OK, 65 s crashes the worker) — budget ~40 s per
+        # chunk from a measured ~1.6e-7 s/row/class/pass iteration cost.
+        # Depthwise pays one batched histogram pass per level; leaf-wise
+        # pays one full-N masked pass per SPLIT (L-1 of them), so its
+        # estimate scales with the leaf budget, not the depth.
+        if p.growth == "depthwise" and p.max_depth > 0:
+            passes_est = p.max_depth
+        else:
+            passes_est = max(8, p.effective_num_leaves - 1)
+        est_iter_s = 1.6e-7 * NP * K * passes_est
+        CH = max(1, min(16, int(40.0 / max(est_iter_s, 1e-3))))
+        total_iters = T // K
+        it = start_iter
+        while it < total_iters:
+            n = min(CH, total_iters - it)
+            if checkpointer is not None:
+                # land chunk ends exactly on checkpoint boundaries
+                n = min(n, checkpointer.every - (it % checkpointer.every))
+            out, score = _chunk_jit(
+                p_key, B, has_cat, mesh, plat, learn_missing, N, K, pad,
+                rank_Q, rank_S, out, score, Xb, y, weight, ones_rows,
+                ones_feat, is_cat_feat, qoff_j, rank_row, rank_col,
+                jnp.int32(it), jnp.int32(n))
+            if callback is not None:
+                for j in range(it, it + n):
+                    callback(j, {"iteration": j})
+            it += n
+            if checkpointer is not None and checkpointer.due(it):
+                ckpt = _materialize(p, data.mapper, out, it * K, init,
+                                    max_depth_prev, best_iteration,
+                                    best_value, stale)
+                if eval_history is not None:  # carried through from resume
+                    ckpt.train_state["eval_history"] = eval_history
+                checkpointer.save(ckpt, it)
+        booster = _materialize(p, data.mapper, out, T, init, max_depth_prev,
+                               best_iteration, best_value, stale)
+        if eval_history is not None:
+            booster.train_state["eval_history"] = eval_history
+        return booster
 
     # ---- boosting loop: async dispatch, zero per-iteration syncs -------------
     for it in range(start_iter, T // K):

@@ -1,0 +1,105 @@
+"""Per-level cost breakdown of the depthwise grower at scale.
+
+Times the pieces a deep level pays (segmented histogram + its tile-plan
+sort, row partition gathers, vmapped split finder) with the fori-loop
+methodology, to locate the non-kernel tail (CLAUDE.md open item).
+
+Usage: PYTHONPATH=... python scripts/profile_level.py [rows] [P]
+"""
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dryad_tpu.config import make_params
+from dryad_tpu.engine.histogram import build_hist_segmented
+from dryad_tpu.engine.split import find_best_split
+
+
+def main():
+    N = int(sys.argv[1]) if len(sys.argv) > 1 else 10_000_000
+    P = int(sys.argv[2]) if len(sys.argv) > 2 else 128
+    K = int(sys.argv[3]) if len(sys.argv) > 3 else 5
+    F, B, L = 28, 256, 255
+    rng = np.random.default_rng(0)
+    plat = jax.devices()[0].platform
+    print(f"rows={N} P={P} reps={K} device={jax.devices()[0]}")
+
+    Xb = jnp.asarray(rng.integers(1, B, size=(N, F), dtype=np.uint8))
+    g = jnp.asarray(rng.normal(size=N).astype(np.float32))
+    h = jnp.asarray(rng.uniform(0.1, 1.0, size=N).astype(np.float32))
+    row_slot = jnp.asarray(rng.integers(0, L, size=N).astype(np.int32))
+    sel = jnp.asarray(rng.integers(0, 2 * P, size=N).astype(np.int32))
+    sel = jnp.where(sel < P, sel, P)  # half the rows selected
+    p = make_params(dict(objective="binary", num_leaves=L, max_depth=8,
+                         growth="depthwise"))
+
+    def loop_time(step, *arrays):
+        f = jax.jit(lambda s0, *a: jax.lax.fori_loop(
+            0, K, lambda i, s: step(s, *a), s0))
+        _ = float(f(jnp.float32(0.0), *arrays))
+        t0 = time.perf_counter()
+        _ = float(f(jnp.float32(0.0), *arrays))
+        return (time.perf_counter() - t0) / K
+
+    # segmented histogram (the per-level kernel call, incl. its tile plan)
+    t = loop_time(lambda s, X, gg, hh, ss: build_hist_segmented(
+        X, gg + s, hh, ss, P, B, rows_per_chunk=p.rows_per_chunk,
+        platform=plat, rows_bound=N // 2 + 1)[0, 0, 0, 0] * 1e-30,
+        Xb, g, h, sel)
+    print(f"seg hist P={P} (bound N/2): {t*1e3:9.1f} ms")
+
+    # the tile-plan's stable sort alone
+    t = loop_time(lambda s, ss: jnp.argsort(
+        ss + (s * 1e-30).astype(jnp.int32), stable=True)[0].astype(jnp.float32)
+        * 1e-30, sel)
+    print(f"stable argsort (N,):       {t*1e3:9.1f} ms")
+
+    # row partition gathers (one level's worth)
+    def part(s, X, rs):
+        rf = jnp.maximum(rs % F, 0)
+        bins_rf = jnp.take_along_axis(
+            X, rf[:, None].astype(jnp.int32), axis=1)[:, 0].astype(jnp.int32)
+        go_left = bins_rf <= (rs + s.astype(jnp.int32))
+        new_slot = jnp.where(go_left, rs, rs + 1)
+        return new_slot[0].astype(jnp.float32) * 1e-30
+    t = loop_time(part, Xb, row_slot)
+    print(f"partition gathers:         {t*1e3:9.1f} ms")
+
+    # vmapped split finder over 2P children
+    hists = jnp.asarray(rng.normal(size=(2 * P, 3, F, B)).astype(np.float32))
+    fmask = jnp.ones((F,), bool)
+    iscat = jnp.zeros((F,), bool)
+
+    def best(hist, G, H, C, allow):
+        return find_best_split(
+            hist, G, H, C, lambda_l2=1.0, min_child_weight=1e-3,
+            min_data_in_leaf=20, min_split_gain=0.0, feat_mask=fmask,
+            is_cat_feat=iscat, allow=allow, has_cat=False)
+    GHC = jnp.abs(hists[:, :3, :, :].sum(axis=(2, 3)))
+    allow = jnp.ones((2 * P,), bool)
+
+    def split_step(s, hh):
+        res = jax.vmap(best, in_axes=(0, 0, 0, 0, 0))(
+            hh + s, GHC[:, 0], GHC[:, 1], GHC[:, 2], allow)
+        return res.gain[0] * 1e-30
+    t = loop_time(split_step, hists)
+    print(f"vmap split finder 2P:      {t*1e3:9.1f} ms")
+
+    # hists scatter update (two (L,3,F,B) .at[].set per level)
+    big = jnp.zeros((L, 3, F, B), jnp.float32)
+    idx = jnp.arange(P, dtype=jnp.int32)
+
+    def scat(s, bg, hh):
+        bg = bg.at[idx].set(hh[:P] + s)
+        bg = bg.at[idx + P].set(hh[P:])
+        return bg[0, 0, 0, 0] * 1e-30
+    t = loop_time(scat, big, hists)
+    print(f"hists scatter 2x(L,...):   {t*1e3:9.1f} ms")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,103 @@
+"""Component timing for the 10M-row train step (VERDICT r1 item 2).
+
+CLAUDE.md methodology: K dependent iterations inside ONE jit via
+lax.fori_loop, wall-clock / K.  Each stage's step consumes a scalar
+perturbation and emits a scalar so the loop carries a true dependency.
+Big arrays are jit ARGUMENTS (remote compile rejects large constants).
+
+Usage: PYTHONPATH=/root/.axon_site:/root/repo python scripts/profile_step.py [rows] [K]
+"""
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dryad_tpu.config import make_params
+from dryad_tpu.engine.grower import grow_any
+from dryad_tpu.engine.predict import tree_leaves
+from dryad_tpu.objectives import get_objective
+
+
+def main():
+    N = int(sys.argv[1]) if len(sys.argv) > 1 else 10_000_000
+    K = int(sys.argv[2]) if len(sys.argv) > 2 else 3
+    F, B = 28, 256
+    rng = np.random.default_rng(0)
+    plat = jax.devices()[0].platform
+    print(f"rows={N} features={F} bins={B} reps={K} device={jax.devices()[0]}")
+
+    Xb_h = rng.integers(1, B, size=(N, F), dtype=np.uint8)
+    Xb = jnp.asarray(Xb_h)
+    y = jnp.asarray((rng.random(N) < 0.5).astype(np.float32))
+    g = jnp.asarray(rng.normal(size=N).astype(np.float32))
+    h = jnp.asarray(rng.uniform(0.1, 1.0, size=N).astype(np.float32))
+    bag = jnp.ones((N,), bool)
+    fmask = jnp.ones((F,), bool)
+    iscat = jnp.zeros((F,), bool)
+
+    p = make_params(dict(objective="binary", num_leaves=255, max_depth=8,
+                         growth="depthwise"))
+    obj = get_objective(p)
+
+    def loop_time(make_step, *arrays):
+        """make_step(s, *arrays) -> scalar; K dependent reps in one jit."""
+        def prog(s0, *arrays):
+            return jax.lax.fori_loop(
+                0, K, lambda i, s: make_step(s, *arrays), s0)
+        f = jax.jit(prog)
+        _ = float(f(jnp.float32(0.0), *arrays))  # compile + warm
+        t0 = time.perf_counter()
+        _ = float(f(jnp.float32(0.0), *arrays))
+        return (time.perf_counter() - t0) / K
+
+    # grad/hess
+    t = loop_time(lambda s, yy: obj.grad_hess_jax(g + s, yy)[0][0] * 1e-30, y)
+    print(f"grad/hess:            {t*1e3:9.1f} ms")
+
+    # grower
+    def grow_step(s, X, gg, hh):
+        tr = grow_any(p, B, X, gg + s, hh, bag, fmask, iscat,
+                      has_cat=False, platform=plat)
+        return tr["value"][0] * 1e-30
+    t_grow = loop_time(grow_step, Xb, g, h)
+    print(f"grower (depthwise):   {t_grow*1e3:9.1f} ms")
+
+    # traversal on a grown tree (tree arrays as args)
+    tree = jax.jit(lambda X, gg, hh: grow_any(
+        p, B, X, gg, hh, bag, fmask, iscat, has_cat=False, platform=plat),
+        )(Xb, g, h)
+    tree = {k: v for k, v in tree.items()}
+
+    def trav_step(s, X, tr):
+        lv = tree_leaves({**tr, "value": tr["value"] + s}, X, p.max_depth)
+        return lv[0].astype(jnp.float32) * 1e-30
+    t_trav = loop_time(trav_step, Xb, tree)
+    print(f"traversal (d={p.max_depth}):     {t_trav*1e3:9.1f} ms")
+
+    # score update given leaves
+    leaves = jax.jit(lambda X, tr: tree_leaves(tr, X, p.max_depth))(Xb, tree)
+
+    def upd_step(s, lv, val, sc):
+        col = jnp.take(sc, 0, axis=1) + (val + s)[lv]
+        sc2 = jax.lax.dynamic_update_index_in_dim(sc, col, 0, axis=1)
+        return sc2[0, 0] * 1e-30
+    sc = jnp.zeros((N, 1), jnp.float32)
+    t_upd = loop_time(upd_step, leaves, tree["value"], sc)
+    print(f"score update:         {t_upd*1e3:9.1f} ms")
+
+    # full step: grow + score update via the grower's row_leaf (no traversal)
+    def full_step(s, X, gg, hh, sc):
+        tr = grow_any(p, B, X, gg + s, hh, bag, fmask, iscat,
+                      has_cat=False, platform=plat)
+        col = jnp.take(sc, 0, axis=1) + tr["value"][tr["row_leaf"]]
+        return col[0] * 1e-30
+    t_full = loop_time(full_step, Xb, g, h, sc)
+    print(f"grow+update(rowleaf): {t_full*1e3:9.1f} ms")
+    print(f"  outside-grower:     {(t_full-t_grow)*1e3:9.1f} ms")
+
+
+if __name__ == "__main__":
+    main()
