@@ -323,15 +323,27 @@ def tile_plan(sel: jnp.ndarray, N: int, P: int, T: int,
         seg_base, jnp.int32(n_tiles) - (P - jnp.arange(P + 1, dtype=jnp.int32)))
     cap_rows = (seg_base[1:] - seg_base[:-1]) * T         # (P,)
 
-    pos = jnp.arange(N, dtype=jnp.int32)
-    l_of = jnp.minimum(sel_sorted, P - 1)
-    in_leaf = pos - start[l_of]
-    dest = jnp.where((sel_sorted < P) & (in_leaf < cap_rows[l_of]),
-                     seg_base[l_of] * T + in_leaf, n_tiles * T)
-    buf = jnp.full((n_tiles * T,), N, jnp.int32).at[dest].set(
-        order.astype(jnp.int32), mode="drop")
     tile_leaf = jnp.searchsorted(seg_base[1:], jnp.arange(n_tiles, dtype=jnp.int32),
                                  side="right").astype(jnp.int32)
+    # Fill tile slots by GATHERING from the sorted order (TPU scatters
+    # serialize — the old (N,)-scatter construction cost ~250 ms at 10M
+    # rows; this gather formulation is the same plan ~4x cheaper): slot j
+    # of tile t holds the (j + t*T - seg_base[leaf]*T)-th row of leaf's
+    # contiguous run in `order`, sentinel N when past the leaf's count/cap.
+    # All plan lookups happen per TILE (n_tiles ≈ N/T entries) and broadcast
+    # across the T slot positions — only the final order[src] gather touches
+    # an (N,)-sized table.  tile_leaf == P marks trailing pad tiles.
+    tile_idx = jnp.arange(n_tiles, dtype=jnp.int32)
+    lc = jnp.minimum(tile_leaf, P - 1)                     # (n_tiles,)
+    base_t = tile_idx * T - seg_base[lc] * T               # first slot's in-leaf offset
+    cnt_t = jnp.minimum(counts[lc], cap_rows[lc])
+    start_t = start[lc]
+    j = jnp.arange(T, dtype=jnp.int32)
+    off = base_t[:, None] + j[None, :]                     # (n_tiles, T)
+    ok = (tile_leaf < P)[:, None] & (off >= 0) & (off < cnt_t[:, None])
+    src = start_t[:, None] + off
+    buf = jnp.where(ok, order[jnp.clip(src, 0, N - 1)].astype(jnp.int32),
+                    N).reshape(-1)
     tile_leaf = jnp.minimum(tile_leaf, P - 1)             # clamp trailing pad tiles
     tile_first = jnp.concatenate([
         jnp.ones((1,), jnp.int32),
@@ -359,13 +371,18 @@ def hist_from_plan(
     T = _TILE_ROWS
     n_tiles = buf.shape[0] // T
 
-    Xp = jnp.concatenate([Xb.astype(jnp.int32), jnp.zeros((1, F), jnp.int32)])
-    gp = jnp.concatenate([g.astype(jnp.float32), jnp.zeros((1,), jnp.float32)])
-    hp = jnp.concatenate([h.astype(jnp.float32), jnp.zeros((1,), jnp.float32)])
-    Xt = _tiles_from_rows(Xp[buf], n_tiles, T, B)
+    # gather in the narrow storage dtype, cast AFTER: the gathered tile set
+    # is ~half the rows at deep levels, so the int32 materialization is
+    # half-price and the (N, F) gather moves 4x fewer bytes; g and h ride
+    # ONE two-column gather instead of two separate (N,)-table gathers
+    Xp = jnp.concatenate([Xb, jnp.zeros((1, F), Xb.dtype)])
+    ghp = jnp.concatenate([jnp.stack([g.astype(jnp.float32),
+                                      h.astype(jnp.float32)], axis=1),
+                           jnp.zeros((1, 2), jnp.float32)])
+    Xt = _tiles_from_rows(Xp[buf].astype(jnp.int32), n_tiles, T, B)
     valid = (buf < N).reshape(n_tiles, T)
-    Wt = _pack_weights(gp[buf].reshape(n_tiles, T), hp[buf].reshape(n_tiles, T),
-                       valid)
+    ght = ghp[buf].reshape(n_tiles, T, 2)
+    Wt = _pack_weights(ght[:, :, 0], ght[:, :, 1], valid)
 
     hist = _hist_tiles(
         Xt, Wt, tile_leaf, tile_first,

@@ -54,15 +54,16 @@ def main():
         return (time.perf_counter() - t0) / K
 
     # grad/hess
-    t = loop_time(lambda s, yy: obj.grad_hess_jax(g + s, yy)[0][0] * 1e-30, y)
+    t = loop_time(lambda s, gg, yy: obj.grad_hess_jax(gg + s, yy)[0][0] * 1e-30,
+                  g, y)
     print(f"grad/hess:            {t*1e3:9.1f} ms")
 
     # grower
-    def grow_step(s, X, gg, hh):
-        tr = grow_any(p, B, X, gg + s, hh, bag, fmask, iscat,
+    def grow_step(s, X, gg, hh, bb):
+        tr = grow_any(p, B, X, gg + s, hh, bb, fmask, iscat,
                       has_cat=False, platform=plat)
         return tr["value"][0] * 1e-30
-    t_grow = loop_time(grow_step, Xb, g, h)
+    t_grow = loop_time(grow_step, Xb, g, h, bag)
     print(f"grower (depthwise):   {t_grow*1e3:9.1f} ms")
 
     # traversal on a grown tree (tree arrays as args)
@@ -89,12 +90,12 @@ def main():
     print(f"score update:         {t_upd*1e3:9.1f} ms")
 
     # full step: grow + score update via the grower's row_leaf (no traversal)
-    def full_step(s, X, gg, hh, sc):
-        tr = grow_any(p, B, X, gg + s, hh, bag, fmask, iscat,
+    def full_step(s, X, gg, hh, bb, sc):
+        tr = grow_any(p, B, X, gg + s, hh, bb, fmask, iscat,
                       has_cat=False, platform=plat)
         col = jnp.take(sc, 0, axis=1) + tr["value"][tr["row_leaf"]]
         return col[0] * 1e-30
-    t_full = loop_time(full_step, Xb, g, h, sc)
+    t_full = loop_time(full_step, Xb, g, h, bag, sc)
     print(f"grow+update(rowleaf): {t_full*1e3:9.1f} ms")
     print(f"  outside-grower:     {(t_full-t_grow)*1e3:9.1f} ms")
 
