@@ -45,12 +45,14 @@ _BIG_DEPTH = jnp.int32(2**30)
 
 def grow_any(params, total_bins, Xb, g, h, bag_mask, feat_mask, is_cat_feat,
              *, has_cat=False, axis_name=None, platform=None,
-             learn_missing=False):
+             learn_missing=False, root_hist=None):
     """Route to the fastest grower for the growth policy.
 
     Depth-wise growth takes the level-synchronous path (one batched
     histogram pass per level — levelwise.py); leaf-wise keeps the exact
-    one-split-at-a-time reference semantics below.
+    one-split-at-a-time reference semantics below.  ``root_hist`` skips
+    the root histogram pass when the caller already has it (multiclass
+    shared-plan roots — histogram.build_hist_classes).
     """
     if params.growth == "depthwise" and params.max_depth > 0:
         from dryad_tpu.engine.levelwise import grow_tree_levelwise
@@ -58,12 +60,12 @@ def grow_any(params, total_bins, Xb, g, h, bag_mask, feat_mask, is_cat_feat,
         return grow_tree_levelwise(
             params, total_bins, Xb, g, h, bag_mask, feat_mask, is_cat_feat,
             has_cat=has_cat, axis_name=axis_name, platform=platform,
-            learn_missing=learn_missing,
+            learn_missing=learn_missing, root_hist=root_hist,
         )
     return grow_tree(
         params, total_bins, Xb, g, h, bag_mask, feat_mask, is_cat_feat,
         has_cat=has_cat, axis_name=axis_name, platform=platform,
-        learn_missing=learn_missing,
+        learn_missing=learn_missing, root_hist=root_hist,
     )
 
 
@@ -140,6 +142,7 @@ def grow_tree(
     axis_name: str | None = None,
     platform: str | None = None,
     learn_missing: bool = False,
+    root_hist: jnp.ndarray | None = None,
 ) -> dict[str, Any]:
     """Grow one tree; returns SoA tree arrays (max_nodes,) + max_depth.
 
@@ -196,7 +199,7 @@ def grow_tree(
     # inherits the varying-manual-axes of the shard under shard_map (a plain
     # constant would make the grow-loop cond branches' vma types diverge)
     row_slot = jnp.where(bag_mask, 0, 0).astype(jnp.int32)
-    hist0 = hist_of(row_slot == 0)
+    hist0 = root_hist if root_hist is not None else hist_of(row_slot == 0)
     G0, H0, C0 = root_stats(hist0)
     ninf, pinf = jnp.float32(-jnp.inf), jnp.float32(jnp.inf)
     root = best(hist0, G0, H0, C0, jnp.int32(0), ninf, pinf)

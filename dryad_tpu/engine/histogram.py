@@ -98,55 +98,91 @@ def build_hist(
                 Xb, g, h, mask, total_bins, axis_name=axis_name,
                 platform=platform,
             )
+    # the XLA path IS the K=1 case of the shared-plan builder — one
+    # implementation, so the bitwise contract between the per-class and
+    # shared-plan root passes holds by construction
+    return build_hist_classes(
+        Xb, g[:, None], h[:, None], mask, total_bins,
+        rows_per_chunk=rows_per_chunk, precision=precision,
+        axis_name=axis_name,
+    )[0]
+
+
+@partial(jax.jit, static_argnames=("total_bins", "rows_per_chunk"))
+def build_hist_jit(Xb, g, h, mask, total_bins, rows_per_chunk=65536):
+    return build_hist(Xb, g, h, mask, total_bins, rows_per_chunk=rows_per_chunk)
+
+
+def build_hist_classes(
+    Xb: jnp.ndarray,
+    g_all: jnp.ndarray,   # (N, K) f32
+    h_all: jnp.ndarray,   # (N, K) f32
+    mask: jnp.ndarray,
+    total_bins: int,
+    *,
+    rows_per_chunk: int = 65536,
+    precision: str = "exact",
+    axis_name: str | None = None,
+) -> jnp.ndarray:
+    """Shared-plan histograms for K classes in ONE pass -> (K, 3, F, B).
+
+    Multiclass iterations grow K trees whose ROOT level histograms all
+    cover the same rows (trees only diverge after the first split), so the
+    K per-class root passes collapse into a single matmul whose weight
+    matrix carries 2K+1 rows (g_0..g_{K-1}, h_0..h_{K-1} + one shared
+    count) — the MXU pads the row dimension to 8/128 anyway, so K=7 costs
+    the same pass a single class does (CLAUDE.md open item; Covertype).
+
+    ``build_hist``'s XLA path delegates here with K=1, so per-class slices
+    are bitwise identical to it by construction.
+    """
     N, F = Xb.shape
     B = int(total_bins)
+    K = g_all.shape[1]
     prec = _resolve_precision(precision)
     C = _chunk_rows(N, F, B, rows_per_chunk)
     pad = (-N) % C
     if pad:
         Xb = jnp.pad(Xb, ((0, pad), (0, 0)))
-        g = jnp.pad(g, (0, pad))
-        h = jnp.pad(h, (0, pad))
+        g_all = jnp.pad(g_all, ((0, pad), (0, 0)))
+        h_all = jnp.pad(h_all, ((0, pad), (0, 0)))
         mask = jnp.pad(mask, (0, pad))
     n_chunks = (N + pad) // C
 
     Xc = Xb.reshape(n_chunks, C, F)
     m = mask.astype(jnp.float32).reshape(n_chunks, C)
-    # weights (n_chunks, 3, C): grad, hess, count — one matmul covers all three
-    w = jnp.stack(
-        [g.astype(jnp.float32).reshape(n_chunks, C) * m,
-         h.astype(jnp.float32).reshape(n_chunks, C) * m,
-         m],
-        axis=1,
-    )
+    gc = g_all.astype(jnp.float32).reshape(n_chunks, C, K)
+    hc = h_all.astype(jnp.float32).reshape(n_chunks, C, K)
     iota = jnp.arange(B, dtype=jnp.int32)
 
     def body(acc, chunk):
-        xc, wc = chunk
+        xc, gk, hk, mk = chunk
         onehot = (xc.astype(jnp.int32)[:, :, None] == iota).astype(jnp.float32)
+        # (2K+1, C) rows: g_0..g_{K-1}, h_0..h_{K-1}, count — block layout
+        # keeps the per-chunk relayout to two (C, K) transposes
+        w = jnp.concatenate([(gk * mk[:, None]).T, (hk * mk[:, None]).T,
+                             mk[None, :]])
         part = jax.lax.dot_general(
-            wc, onehot.reshape(C, F * B),
+            w, onehot.reshape(C, F * B),
             (((1,), (0,)), ((), ())),
             precision=prec,
             preferred_element_type=jnp.float32,
         )
         return acc + part, None
 
-    acc0 = jnp.zeros((3, F * B), jnp.float32)
+    acc0 = jnp.zeros((2 * K + 1, F * B), jnp.float32)
     if axis_name is not None:
         # under shard_map the carry must be marked device-varying to match
         # the varying per-chunk partials (JAX vma tracking)
         acc0 = jax.lax.pcast(acc0, axis_name, to="varying")
-    acc, _ = jax.lax.scan(body, acc0, (Xc, w))
-    hist = acc.reshape(3, F, B)
+    acc, _ = jax.lax.scan(body, acc0, (Xc, gc, hc, m))
+    gs = acc[:K].reshape(K, 1, F, B)
+    hs = acc[K: 2 * K].reshape(K, 1, F, B)
+    cnt = jnp.broadcast_to(acc[2 * K].reshape(1, 1, F, B), (K, 1, F, B))
+    hist = jnp.concatenate([gs, hs, cnt], axis=1)  # (K, 3, F, B)
     if axis_name is not None:
         hist = jax.lax.psum(hist, axis_name)  # the NCCL-allreduce equivalent
     return hist
-
-
-@partial(jax.jit, static_argnames=("total_bins", "rows_per_chunk"))
-def build_hist_jit(Xb, g, h, mask, total_bins, rows_per_chunk=65536):
-    return build_hist(Xb, g, h, mask, total_bins, rows_per_chunk=rows_per_chunk)
 
 
 def build_hist_multi(

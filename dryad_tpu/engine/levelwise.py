@@ -57,6 +57,7 @@ def grow_tree_levelwise(
     axis_name: str | None = None,
     platform: str | None = None,
     learn_missing: bool = False,
+    root_hist: jnp.ndarray | None = None,
 ) -> dict[str, Any]:
     p = params
     N, F = Xb.shape
@@ -92,10 +93,11 @@ def grow_tree_levelwise(
     # row_slot yields each row's leaf without a separate traversal pass;
     # derived from bag_mask to inherit the shard's varying-manual-axes
     row_slot = jnp.where(bag_mask, 0, 0).astype(jnp.int32)
-    hist0 = build_hist(Xb, g, h, bag_mask, B,
-                       rows_per_chunk=p.rows_per_chunk, axis_name=axis_name,
-                       precision=p.hist_precision, backend=p.hist_backend,
-                       platform=platform)
+    hist0 = root_hist if root_hist is not None else build_hist(
+        Xb, g, h, bag_mask, B,
+        rows_per_chunk=p.rows_per_chunk, axis_name=axis_name,
+        precision=p.hist_precision, backend=p.hist_backend,
+        platform=platform)
     G0, H0, C0 = root_stats(hist0)
     ninf, pinf = jnp.float32(-jnp.inf), jnp.float32(jnp.inf)
     root = best(hist0, G0, H0, C0,

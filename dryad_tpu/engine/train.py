@@ -41,11 +41,13 @@ _TREE_KEYS = ("feature", "threshold", "left", "right", "value", "is_cat",
 
 
 def _step_body(p, B, has_cat, mesh, platform, learn_missing, out, score, Xb,
-               g_all, h_all, bag, fmask, is_cat_feat, t, k):
+               g_all, h_all, bag, fmask, is_cat_feat, t, k, root_hist=None):
     """One (iteration, class) tree: grow, record into slot t, update scores.
 
     Shared by the per-iteration ``_step_jit`` dispatch and the chunked
-    ``_chunk_jit`` fast path, so the two can never diverge.
+    ``_chunk_jit`` fast path, so the two can never diverge.  ``root_hist``
+    carries the class's slice of the shared-plan multiclass root pass
+    (single-device path only).
     """
     out = dict(out)
     g = jnp.take(g_all, k, axis=1)
@@ -60,7 +62,7 @@ def _step_body(p, B, has_cat, mesh, platform, learn_missing, out, score, Xb,
     else:
         tree = grow_any(p, B, Xb, g, h, bag, fmask, is_cat_feat,
                         has_cat=has_cat, platform=platform,
-                        learn_missing=learn_missing)
+                        learn_missing=learn_missing, root_hist=root_hist)
         # each row's leaf comes straight out of the grower's partition
         # state — re-traversing 10M rows cost ~5 s/tree (gather-bound)
         leaves = tree.pop("row_leaf")
@@ -137,14 +139,43 @@ def _chunk_jit(p, B, has_cat, mesh, platform, learn_missing, N, K, pad,
         out, score = carry
         g_all, h_all = _grads_body(p, N, K, pad, score, y, weight, qoff,
                                    rank_row, rank_col, rank_Q, rank_S)
+        roots = None
+        if K > 1 and mesh is None and _shared_roots_ok(p, platform):
+            # shared-plan multiclass roots: all K trees' root histograms in
+            # one matmul pass (2K+1 weight rows — histogram.py)
+            from dryad_tpu.engine.histogram import build_hist_classes
+
+            roots = build_hist_classes(
+                Xb, g_all, h_all, bag, B, rows_per_chunk=p.rows_per_chunk,
+                precision=p.hist_precision)
         for k in range(K):
             t = (it0 + i) * K + k
-            out, score = _step_body(p, B, has_cat, mesh, platform,
-                                    learn_missing, out, score, Xb, g_all,
-                                    h_all, bag, fmask, is_cat_feat, t, k)
+            out, score = _step_body(
+                p, B, has_cat, mesh, platform, learn_missing, out, score,
+                Xb, g_all, h_all, bag, fmask, is_cat_feat, t, k,
+                root_hist=None if roots is None else roots[k])
         return out, score
 
     return jax.lax.fori_loop(0, n_iters, body, (out, score))
+
+
+def _shared_roots_ok(p, platform) -> bool:
+    """Shared-plan roots only when the root pass resolves to the XLA
+    builder anyway — a forced hist_backend='pallas' root must keep its
+    accumulation order on every path or 1-shard and N-shard runs (which
+    skip the shared plan) could flip a near-tie root argmax."""
+    from dryad_tpu.engine.histogram import resolve_backend
+
+    return resolve_backend(p.hist_backend, platform=platform) == "xla"
+
+
+@partial(jax.jit, static_argnames=("B", "rpc", "precision"))
+def _roots_jit(B, rpc, precision, Xb, g_all, h_all, bag):
+    """Shared-plan multiclass root histograms (per-iteration dispatch path)."""
+    from dryad_tpu.engine.histogram import build_hist_classes
+
+    return build_hist_classes(Xb, g_all, h_all, bag, B, rows_per_chunk=rpc,
+                              precision=precision)
 
 
 @partial(jax.jit, static_argnames=("p", "N"))
@@ -296,9 +327,10 @@ def train_device(
         learn_missing = bool(
             multihost_utils.process_allgather(np.int32(learn_missing)).max())
 
-    def step(out, score, g_all, h_all, bag, fmask, t, k):
+    def step(out, score, g_all, h_all, bag, fmask, t, k, root_hist=None):
         return _step_jit(p_key, B, has_cat, mesh, plat, learn_missing, out,
-                         score, Xb, g_all, h_all, bag, fmask, is_cat_feat, t, k)
+                         score, Xb, g_all, h_all, bag, fmask, is_cat_feat, t, k,
+                         root_hist)
 
     # ---- resume / warm start -------------------------------------------------
     out = _empty_out_device(T, p.max_nodes, CAT_WORDS)
@@ -470,9 +502,17 @@ def train_device(
                 u = shard_rows(mesh, u)[0]
             g_all, h_all, goss_mask = _goss_jit(p_key, N, g_all, h_all, u, bag)
             bag = goss_mask
+        roots = None
+        if K > 1 and mesh is None and _shared_roots_ok(p, plat):
+            # shared-plan multiclass roots (one pass for all K classes);
+            # the histogram is feat_mask-independent — masked features'
+            # columns simply never win the split scan
+            roots = _roots_jit(B, p.rows_per_chunk, p.hist_precision,
+                               Xb, g_all, h_all, bag)
         for k in range(K):
             t = it * K + k
-            out, score = step(out, score, g_all, h_all, bag, fmask, t, k)
+            out, score = step(out, score, g_all, h_all, bag, fmask, t, k,
+                              None if roots is None else roots[k])
             for vi, vXb in enumerate(vXbs):
                 vscores[vi] = vscores[vi].at[:, k].set(
                     _apply_valid_jit(out, t, vXb, vscores[vi][:, k],

@@ -156,3 +156,32 @@ def test_segmented_histogram_matches_multi_and_cpu():
         rows = np.nonzero(sel == col)[0].astype(np.int64)
         ref = build_hist_cpu(Xb, g, h, rows, B)
         np.testing.assert_allclose(seg[col], ref, rtol=2e-5, atol=2e-4)
+
+
+def test_build_hist_classes_matches_per_class():
+    """Shared-plan K-class root pass must be BITWISE equal to K separate
+    build_hist calls (the grower consumes either interchangeably)."""
+    import jax.numpy as jnp
+
+    from dryad_tpu.engine.histogram import build_hist, build_hist_classes
+
+    rng = np.random.default_rng(53)
+    N, F, B, K = 5000, 6, 32, 7
+    Xb = jnp.asarray(rng.integers(0, B, size=(N, F), dtype=np.uint8))
+    g = jnp.asarray(rng.normal(size=(N, K)).astype(np.float32))
+    h = jnp.asarray(rng.uniform(0.1, 1, size=(N, K)).astype(np.float32))
+    mask = jnp.asarray(rng.random(N) < 0.8)
+    # rows_per_chunk=1024 forces the multi-chunk scan + row padding — the
+    # parts of the shared implementation where accumulation order could
+    # actually drift from the per-class path
+    shared = np.asarray(build_hist_classes(Xb, g, h, mask, B,
+                                           rows_per_chunk=1024))
+    assert shared.shape == (K, 3, F, B)
+    for k in range(K):
+        single = np.asarray(build_hist(Xb, g[:, k], h[:, k], mask, B,
+                                       rows_per_chunk=1024))
+        np.testing.assert_array_equal(shared[k], single)
+    # and the defaults (single chunk) agree with the chunked result's shape
+    np.testing.assert_array_equal(
+        np.asarray(build_hist_classes(Xb, g, h, mask, B))[0],
+        np.asarray(build_hist(Xb, g[:, 0], h[:, 0], mask, B)))
