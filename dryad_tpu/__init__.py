@@ -54,6 +54,14 @@ def train(
     p = make_params(params, **kw)
     if train_set is None:
         raise ValueError("train_set is required")
+    if (any(p.monotone_constraints)
+            and getattr(train_set.mapper, "bundled_mask", None) is not None):
+        # EFB reorders/stacks columns, so positional per-feature constraints
+        # would land on the wrong (and non-ordinal) columns
+        raise ValueError(
+            "monotone_constraints are positional over the original features "
+            "and are incompatible with feature bundling — rebuild the "
+            "Dataset with bundle=False")
     # every valid set is evaluated and logged per iteration; early stopping
     # watches the FIRST one (LightGBM semantics)
     valid = list(valid_sets) if valid_sets else None

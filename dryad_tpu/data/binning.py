@@ -16,6 +16,8 @@ from dryad_tpu.data.sketch import BinMapper
 
 def bin_matrix(X: np.ndarray, mapper: BinMapper) -> np.ndarray:
     """Dense raw features → bin ids (N, F) uint8/uint16."""
+    if hasattr(mapper, "fold"):   # BundledMapper: bin via base, then fold
+        return mapper.transform(X)
     from dryad_tpu import native
 
     out = native.bin_matrix(np.asarray(X, np.float32), mapper)

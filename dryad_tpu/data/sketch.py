@@ -210,6 +210,10 @@ class BinMapper:
     @classmethod
     def from_bytes(cls, data: bytes) -> "BinMapper":
         with np.load(io.BytesIO(data)) as z:
+            if "efb_base" in z.files:   # bundled-mapper container (EFB)
+                from dryad_tpu.data.bundling import BundledMapper
+
+                return BundledMapper.from_bytes(data)
             n = z["is_cat"].shape[0]
             feats = [
                 FeatureBins(

@@ -33,16 +33,38 @@ class Dataset:
         max_bins: int = 256,
         mapper: Optional[BinMapper] = None,
         csr: Optional[tuple[np.ndarray, np.ndarray, np.ndarray, int]] = None,
+        bundle: bool = True,
     ):
         if (X is None) == (csr is None):
             raise ValueError("provide exactly one of X (dense) or csr=(indptr, indices, values, num_features)")
         self.categorical_features = tuple(int(c) for c in categorical_features)
         if csr is not None:
+            from dryad_tpu.data.bundling import BundledMapper, plan_bundles
+
             indptr, indices, values, num_features = csr
             if mapper is None:
-                mapper = _sketch_csr(indptr, indices, values, num_features, max_bins, self.categorical_features)
-            self.mapper = mapper
-            self.X_binned = bin_csr(indptr, indices, values, num_features, mapper)
+                base = _sketch_csr(indptr, indices, values, num_features,
+                                   max_bins, self.categorical_features)
+                Xb0 = bin_csr(indptr, indices, values, num_features, base)
+                plan = plan_bundles(Xb0, base, max_bins) if bundle else []
+                if plan:
+                    # exclusive feature bundling: fold strictly-exclusive
+                    # sparse columns (deterministic plan, stored in the
+                    # mapper) — the grower sees fewer, denser features
+                    mapper = BundledMapper(base, plan)
+                    self.mapper = mapper
+                    self.X_binned = mapper.fold(Xb0)
+                else:
+                    self.mapper = base
+                    self.X_binned = Xb0
+            elif isinstance(mapper, BundledMapper):
+                self.mapper = mapper
+                self.X_binned = mapper.fold(
+                    bin_csr(indptr, indices, values, num_features, mapper.base))
+            else:
+                self.mapper = mapper
+                self.X_binned = bin_csr(indptr, indices, values, num_features,
+                                        mapper)
         else:
             X = np.asarray(X, np.float32)
             if mapper is None:
@@ -64,7 +86,13 @@ class Dataset:
         missing learns its direction through subset membership instead.)"""
         if self._has_missing is None:
             zero_cols = (self.X_binned == 0).any(axis=0)
-            self._has_missing = bool((zero_cols & ~self.mapper.is_categorical).any())
+            eligible = ~self.mapper.is_categorical
+            # bundled (EFB) columns: bin 0 means "all members default",
+            # never "missing" — they must not trigger the two-plane scan
+            bundled = getattr(self.mapper, "bundled_mask", None)
+            if bundled is not None:
+                eligible &= ~bundled
+            self._has_missing = bool((zero_cols & eligible).any())
         return self._has_missing
 
     def _attach_targets(self, y, weight, group) -> None:

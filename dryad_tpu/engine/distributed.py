@@ -56,19 +56,21 @@ def shard_rows(mesh: Mesh, *arrays):
 
 def grow_sharded(params: Params, total_bins: int, has_cat: bool,
                  mesh: Mesh, Xb, g, h, bag_mask, feat_mask, is_cat_feat,
-                 platform=None, learn_missing=False):
+                 platform=None, learn_missing=False, root_hist=None):
     """One sharded tree grow; returns (replicated tree, row-sharded leaves).
 
     Called inside the device train step's jit: the tree arrays come back
     replicated, the per-row leaf assignment keeps the row sharding so the
-    caller's score update stays shard-local.
+    caller's score update stays shard-local.  ``root_hist`` (replicated)
+    carries the class's slice of the shared-plan multiclass root pass.
     """
 
-    def run(Xb_l, g_l, h_l, bag_l, fmask, iscat):
+    def run(Xb_l, g_l, h_l, bag_l, fmask, iscat, *maybe_root):
         tree = grow_any(
             params, total_bins, Xb_l, g_l, h_l, bag_l, fmask, iscat,
             has_cat=has_cat, axis_name=AXIS, platform=platform,
             learn_missing=learn_missing,
+            root_hist=maybe_root[0] if maybe_root else None,
         )
         # per-shard leaf ids straight from the grower's partition state
         leaves = tree.pop("row_leaf")
@@ -82,8 +84,31 @@ def grow_sharded(params: Params, total_bins: int, has_cat: bool,
         "value": rep, "gain": rep, "is_cat": rep, "cat_bitset": rep,
         "default_left": rep, "max_depth": rep,
     }
+    extra = () if root_hist is None else (root_hist,)
     return jax.shard_map(
         run, mesh=mesh,
-        in_specs=(row2, row, row, row, rep, rep),
+        in_specs=(row2, row, row, row, rep, rep) + ((rep,) if extra else ()),
         out_specs=(tree_specs, row),
-    )(Xb, g, h, bag_mask, feat_mask, is_cat_feat)
+    )(Xb, g, h, bag_mask, feat_mask, is_cat_feat, *extra)
+
+
+def roots_sharded(mesh: Mesh, Xb, g_all, h_all, bag, total_bins,
+                  rows_per_chunk, precision):
+    """Shared-plan multiclass root histograms over the mesh -> replicated
+    (K, 3, F, B); one fused psum carries all K classes' stats.  Runs the
+    SAME builder program as the single-device path so near-tie root
+    argmaxes cannot differ between 1-shard and N-shard runs (the MXU's
+    lowering of the (2K+1)-row pass is fusion-sensitive — measured NOT
+    bitwise vs the 3-row per-class pass on real hardware)."""
+    from dryad_tpu.engine.histogram import build_hist_classes
+
+    def run(X, gs, hs, bg):
+        return build_hist_classes(
+            X, gs, hs, bg, total_bins, rows_per_chunk=rows_per_chunk,
+            precision=precision, axis_name=AXIS)
+
+    row = P(AXIS)
+    row2 = P(AXIS, None)
+    return jax.shard_map(
+        run, mesh=mesh, in_specs=(row2, row2, row2, row), out_specs=P(),
+    )(Xb, g_all, h_all, bag)

@@ -58,6 +58,7 @@ def _step_body(p, B, has_cat, mesh, platform, learn_missing, out, score, Xb,
         tree, leaves = grow_sharded(
             p, B, has_cat, mesh, Xb, g, h, bag, fmask, is_cat_feat,
             platform=platform, learn_missing=learn_missing,
+            root_hist=root_hist,
         )
     else:
         tree = grow_any(p, B, Xb, g, h, bag, fmask, is_cat_feat,
@@ -140,14 +141,25 @@ def _chunk_jit(p, B, has_cat, mesh, platform, learn_missing, N, K, pad,
         g_all, h_all = _grads_body(p, N, K, pad, score, y, weight, qoff,
                                    rank_row, rank_col, rank_Q, rank_S)
         roots = None
-        if K > 1 and mesh is None and _shared_roots_ok(p, platform):
+        if K > 1 and _shared_roots_ok(p, platform):
             # shared-plan multiclass roots: all K trees' root histograms in
-            # one matmul pass (2K+1 weight rows — histogram.py)
-            from dryad_tpu.engine.histogram import build_hist_classes
+            # one matmul pass (2K+1 weight rows — histogram.py).  The mesh
+            # path runs the SAME builder under shard_map: the (2K+1)-row
+            # MXU lowering is fusion-sensitive (measured NOT bitwise vs the
+            # 3-row pass on device), so both paths must share one program
+            # or near-tie root argmaxes could differ 1-shard vs N-shard.
+            if mesh is not None:
+                from dryad_tpu.engine.distributed import roots_sharded
 
-            roots = build_hist_classes(
-                Xb, g_all, h_all, bag, B, rows_per_chunk=p.rows_per_chunk,
-                precision=p.hist_precision)
+                roots = roots_sharded(mesh, Xb, g_all, h_all, bag, B,
+                                      p.rows_per_chunk, p.hist_precision)
+            else:
+                from dryad_tpu.engine.histogram import build_hist_classes
+
+                roots = build_hist_classes(
+                    Xb, g_all, h_all, bag, B,
+                    rows_per_chunk=p.rows_per_chunk,
+                    precision=p.hist_precision)
         for k in range(K):
             t = (it0 + i) * K + k
             out, score = _step_body(
@@ -169,9 +181,14 @@ def _shared_roots_ok(p, platform) -> bool:
     return resolve_backend(p.hist_backend, platform=platform) == "xla"
 
 
-@partial(jax.jit, static_argnames=("B", "rpc", "precision"))
-def _roots_jit(B, rpc, precision, Xb, g_all, h_all, bag):
-    """Shared-plan multiclass root histograms (per-iteration dispatch path)."""
+@partial(jax.jit, static_argnames=("B", "rpc", "precision", "mesh"))
+def _roots_jit(B, rpc, precision, mesh, Xb, g_all, h_all, bag):
+    """Shared-plan multiclass root histograms (per-iteration dispatch path);
+    with a mesh, the same builder runs under shard_map + one fused psum."""
+    if mesh is not None:
+        from dryad_tpu.engine.distributed import roots_sharded
+
+        return roots_sharded(mesh, Xb, g_all, h_all, bag, B, rpc, precision)
     from dryad_tpu.engine.histogram import build_hist_classes
 
     return build_hist_classes(Xb, g_all, h_all, bag, B, rows_per_chunk=rpc,
@@ -503,11 +520,11 @@ def train_device(
             g_all, h_all, goss_mask = _goss_jit(p_key, N, g_all, h_all, u, bag)
             bag = goss_mask
         roots = None
-        if K > 1 and mesh is None and _shared_roots_ok(p, plat):
+        if K > 1 and _shared_roots_ok(p, plat):
             # shared-plan multiclass roots (one pass for all K classes);
             # the histogram is feat_mask-independent — masked features'
             # columns simply never win the split scan
-            roots = _roots_jit(B, p.rows_per_chunk, p.hist_precision,
+            roots = _roots_jit(B, p.rows_per_chunk, p.hist_precision, mesh,
                                Xb, g_all, h_all, bag)
         for k in range(K):
             t = it * K + k

@@ -1,0 +1,152 @@
+"""Exclusive feature bundling (EFB) — data/bundling.py (SURVEY.md §7 step 6)."""
+
+import numpy as np
+import pytest
+
+import dryad_tpu as dryad
+from dryad_tpu.data.bundling import BundledMapper, plan_bundles
+from dryad_tpu.metrics import auc
+
+
+def _onehot_csr(n=6000, groups=6, levels=5, num_dense=3, seed=61):
+    """num_dense dense numeric cols + groups x levels one-hot numeric cols
+    (each group strictly exclusive), CSR encoded.  y depends on the groups."""
+    rng = np.random.default_rng(seed)
+    F = num_dense + groups * levels
+    dense = rng.normal(size=(n, num_dense)).astype(np.float32)
+    cat = rng.integers(0, levels, size=(n, groups))
+    rows, cols, vals = [], [], []
+    for i in range(n):
+        for d in range(num_dense):
+            rows.append(i); cols.append(d); vals.append(dense[i, d])
+        for gix in range(groups):
+            rows.append(i)
+            cols.append(num_dense + gix * levels + cat[i, gix])
+            vals.append(1.0)
+    order = np.lexsort((cols, rows))
+    rows = np.asarray(rows)[order]
+    cols = np.asarray(cols, np.int64)[order]
+    vals = np.asarray(vals, np.float32)[order]
+    indptr = np.searchsorted(rows, np.arange(n + 1)).astype(np.int64)
+    logits = (dense[:, 0] + (cat[:, 0] == 2) * 1.5 - (cat[:, 1] >= 3) * 1.0
+              + 0.3 * rng.normal(size=n))
+    y = (logits > 0).astype(np.float32)
+    return (indptr, cols, vals, F), y
+
+
+def test_plan_is_deterministic_and_strictly_exclusive():
+    (indptr, cols, vals, F), y = _onehot_csr()
+    ds = dryad.Dataset(None, y, csr=(indptr, cols, vals, F), max_bins=64,
+                       bundle=False)
+    plan1 = plan_bundles(ds.X_binned, ds.mapper, 64)
+    plan2 = plan_bundles(ds.X_binned, ds.mapper, 64)
+    assert plan1 == plan2 and len(plan1) >= 1
+    # strict exclusivity on the planned members
+    from dryad_tpu.data.binning import zero_bins
+
+    zb = zero_bins(ds.mapper)
+    for members in plan1:
+        nz = np.stack([ds.X_binned[:, f] != zb[f] for f in members])
+        assert (nz.sum(axis=0) <= 1).all()
+
+
+def test_fold_roundtrip_unique_encoding():
+    (indptr, cols, vals, F), y = _onehot_csr(n=2000)
+    ds = dryad.Dataset(None, y, csr=(indptr, cols, vals, F), max_bins=64,
+                       bundle=False)
+    plan = plan_bundles(ds.X_binned, ds.mapper, 64)
+    bm = BundledMapper(ds.mapper, plan)
+    folded = bm.fold(ds.X_binned)
+    assert folded.shape == (2000, bm.num_features)
+    assert bm.num_features < F
+    # each bundle bin decodes to exactly one (member, bin) pair: rebuild the
+    # members' columns from the folded one and compare
+    from dryad_tpu.data.binning import zero_bins
+
+    zb = zero_bins(ds.mapper)
+    nb = ds.mapper.n_bins
+    for bi, members in enumerate(plan):
+        enc = folded[:, bi].astype(np.int64)
+        off = 1
+        for f in members:
+            inside = (enc >= off) & (enc < off + int(nb[f]))
+            rebuilt = np.where(inside, enc - off, zb[f])
+            np.testing.assert_array_equal(rebuilt, ds.X_binned[:, f])
+            off += int(nb[f])
+
+
+@pytest.mark.parametrize("backend", ["cpu", "tpu"])
+def test_bundled_training_quality_and_speed_shape(backend):
+    (indptr, cols, vals, F), y = _onehot_csr()
+    ds_b = dryad.Dataset(None, y, csr=(indptr, cols, vals, F), max_bins=64)
+    ds_u = dryad.Dataset(None, y, csr=(indptr, cols, vals, F), max_bins=64,
+                         bundle=False)
+    assert ds_b.num_features < ds_u.num_features  # bundling engaged
+    p = dict(objective="binary", num_trees=20, num_leaves=15, max_bins=64)
+    a_b = auc(y, dryad.train(p, ds_b, backend=backend).predict_binned(ds_b.X_binned))
+    a_u = auc(y, dryad.train(p, ds_u, backend=backend).predict_binned(ds_u.X_binned))
+    assert a_b > 0.8
+    assert a_b > a_u - 0.01  # identical-or-better quality
+
+
+def test_bundled_save_load_and_raw_predict(tmp_path):
+    (indptr, cols, vals, F), y = _onehot_csr(n=3000)
+    ds = dryad.Dataset(None, y, csr=(indptr, cols, vals, F), max_bins=64)
+    assert isinstance(ds.mapper, BundledMapper)
+    b = dryad.train(dict(objective="binary", num_trees=8, num_leaves=15,
+                         max_bins=64), ds, backend="cpu")
+    # raw-X predict folds through the stored plan
+    dense = np.zeros((3000, F), np.float32)
+    for i in range(3000):
+        sl = slice(indptr[i], indptr[i + 1])
+        dense[i, cols[sl]] = vals[sl]
+    p_raw = b.predict(dense, raw_score=True)
+    p_binned = b.predict_binned(ds.X_binned, raw_score=True)
+    np.testing.assert_array_equal(p_raw, p_binned)
+    path = str(tmp_path / "m.dryad")
+    b.save(path)
+    b2 = dryad.Booster.load(path)
+    np.testing.assert_array_equal(p_raw, b2.predict(dense, raw_score=True))
+
+
+def test_monotone_constraints_reject_bundling():
+    (indptr, cols, vals, F), y = _onehot_csr(n=2000)
+    ds = dryad.Dataset(None, y, csr=(indptr, cols, vals, F), max_bins=64)
+    assert isinstance(ds.mapper, BundledMapper)
+    with pytest.raises(ValueError, match="bundle=False"):
+        dryad.train(dict(objective="binary", num_trees=2,
+                         monotone_constraints=(1,) + (0,) * (F - 1)),
+                    ds, backend="cpu")
+
+
+def test_plan_verifies_exclusivity_beyond_sample():
+    """Members exclusive in the planning prefix but conflicting later must
+    be evicted by the full-data verification pass."""
+    rng = np.random.default_rng(67)
+    n, S = 3000, 1000
+    X = np.zeros((n, 3), np.float32)
+    X[:, 2] = rng.normal(size=n)          # dense col keeps sketch sane
+    # cols 0/1: disjoint in the first S rows, overlapping after
+    X[: S // 2, 0] = 1.0
+    X[S // 2: S, 1] = 1.0
+    X[S:, 0] = 1.0
+    X[S:, 1] = 1.0                        # conflict zone
+    from dryad_tpu.data.sketch import sketch_features
+
+    mapper = sketch_features(X, max_bins=16)
+    Xb = mapper.transform(X)
+    plan = plan_bundles(Xb, mapper, 16, sample_rows=S)
+    for members in plan:
+        assert not (0 in members and 1 in members), plan
+    (indptr, cols, vals, F), y = _onehot_csr()
+    n_tr = 4500
+    tr = (indptr[: n_tr + 1], cols[: indptr[n_tr]], vals[: indptr[n_tr]], F)
+    ds = dryad.Dataset(None, y[:n_tr], csr=tr, max_bins=64)
+    va_indptr = (indptr[n_tr:] - indptr[n_tr]).astype(np.int64)
+    va = (va_indptr, cols[indptr[n_tr]:], vals[indptr[n_tr]:], F)
+    dv = dryad.Dataset(None, y[n_tr:], csr=va, max_bins=64, mapper=ds.mapper)
+    assert dv.X_binned.shape[1] == ds.X_binned.shape[1]
+    b = dryad.train(dict(objective="binary", num_trees=10, num_leaves=15,
+                         max_bins=64, early_stopping_rounds=5),
+                    ds, valid_sets=[dv], backend="cpu")
+    assert b.best_iteration > 0
