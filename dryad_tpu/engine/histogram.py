@@ -98,14 +98,59 @@ def build_hist(
                 Xb, g, h, mask, total_bins, axis_name=axis_name,
                 platform=platform,
             )
-    # the XLA path IS the K=1 case of the shared-plan builder — one
-    # implementation, so the bitwise contract between the per-class and
-    # shared-plan root passes holds by construction
-    return build_hist_classes(
-        Xb, g[:, None], h[:, None], mask, total_bins,
-        rows_per_chunk=rows_per_chunk, precision=precision,
-        axis_name=axis_name,
-    )[0]
+    # NOTE: this body must stay accumulation-order-identical to
+    # build_hist_classes (its K=1 case) — test_build_hist_classes_matches_
+    # per_class pins the bitwise contract with a multi-chunk fixture, and
+    # scripts/smoke_tpu.py re-asserts it on the real device (the lowering
+    # is fusion-sensitive there).  Delegating to the classes builder was
+    # tried and measured 3.6x slower per call; unifying the other way
+    # (precomputing w in the classes builder) would materialize (2K+1)*N
+    # floats in HBM — 600 MB for K=7 at 10M rows — so the two bodies stay
+    # separate on purpose.
+    N, F = Xb.shape
+    B = int(total_bins)
+    prec = _resolve_precision(precision)
+    C = _chunk_rows(N, F, B, rows_per_chunk)
+    pad = (-N) % C
+    if pad:
+        Xb = jnp.pad(Xb, ((0, pad), (0, 0)))
+        g = jnp.pad(g, (0, pad))
+        h = jnp.pad(h, (0, pad))
+        mask = jnp.pad(mask, (0, pad))
+    n_chunks = (N + pad) // C
+
+    Xc = Xb.reshape(n_chunks, C, F)
+    m = mask.astype(jnp.float32).reshape(n_chunks, C)
+    # weights (n_chunks, 3, C): grad, hess, count — one matmul covers all three
+    w = jnp.stack(
+        [g.astype(jnp.float32).reshape(n_chunks, C) * m,
+         h.astype(jnp.float32).reshape(n_chunks, C) * m,
+         m],
+        axis=1,
+    )
+    iota = jnp.arange(B, dtype=jnp.int32)
+
+    def body(acc, chunk):
+        xc, wc = chunk
+        onehot = (xc.astype(jnp.int32)[:, :, None] == iota).astype(jnp.float32)
+        part = jax.lax.dot_general(
+            wc, onehot.reshape(C, F * B),
+            (((1,), (0,)), ((), ())),
+            precision=prec,
+            preferred_element_type=jnp.float32,
+        )
+        return acc + part, None
+
+    acc0 = jnp.zeros((3, F * B), jnp.float32)
+    if axis_name is not None:
+        # under shard_map the carry must be marked device-varying to match
+        # the varying per-chunk partials (JAX vma tracking)
+        acc0 = jax.lax.pcast(acc0, axis_name, to="varying")
+    acc, _ = jax.lax.scan(body, acc0, (Xc, w))
+    hist = acc.reshape(3, F, B)
+    if axis_name is not None:
+        hist = jax.lax.psum(hist, axis_name)  # the NCCL-allreduce equivalent
+    return hist
 
 
 @partial(jax.jit, static_argnames=("total_bins", "rows_per_chunk"))
@@ -133,8 +178,10 @@ def build_hist_classes(
     count) — the MXU pads the row dimension to 8/128 anyway, so K=7 costs
     the same pass a single class does (CLAUDE.md open item; Covertype).
 
-    ``build_hist``'s XLA path delegates here with K=1, so per-class slices
-    are bitwise identical to it by construction.
+    Per-class slices are accumulation-order-identical to ``build_hist``
+    (same chunking, same products, same dot) — the bitwise contract is
+    pinned by test_build_hist_classes_matches_per_class on a multi-chunk
+    fixture; keep the two bodies in sync.
     """
     N, F = Xb.shape
     B = int(total_bins)
@@ -151,16 +198,19 @@ def build_hist_classes(
 
     Xc = Xb.reshape(n_chunks, C, F)
     m = mask.astype(jnp.float32).reshape(n_chunks, C)
-    gc = g_all.astype(jnp.float32).reshape(n_chunks, C, K)
-    hc = h_all.astype(jnp.float32).reshape(n_chunks, C, K)
+    # class-MAJOR chunk layout (n_chunks, K, C): the row dimension C stays
+    # in lanes.  A (C, K) minor-dim-K layout pads K up to 128 under XLA's
+    # (8, 128) tiling — measured 5x slower build_hist calls at K=1 when
+    # this function became the shared implementation (CLAUDE.md lane rule)
+    gc = g_all.astype(jnp.float32).T.reshape(K, n_chunks, C).transpose(1, 0, 2)
+    hc = h_all.astype(jnp.float32).T.reshape(K, n_chunks, C).transpose(1, 0, 2)
     iota = jnp.arange(B, dtype=jnp.int32)
 
     def body(acc, chunk):
-        xc, gk, hk, mk = chunk
+        xc, gk, hk, mk = chunk                      # gk/hk: (K, C)
         onehot = (xc.astype(jnp.int32)[:, :, None] == iota).astype(jnp.float32)
-        # (2K+1, C) rows: g_0..g_{K-1}, h_0..h_{K-1}, count — block layout
-        # keeps the per-chunk relayout to two (C, K) transposes
-        w = jnp.concatenate([(gk * mk[:, None]).T, (hk * mk[:, None]).T,
+        # (2K+1, C) rows: g_0..g_{K-1}, h_0..h_{K-1}, count
+        w = jnp.concatenate([gk * mk[None, :], hk * mk[None, :],
                              mk[None, :]])
         part = jax.lax.dot_general(
             w, onehot.reshape(C, F * B),

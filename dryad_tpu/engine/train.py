@@ -454,16 +454,27 @@ def train_device(
     if chunkable:
         # the tunnel kills single programs running longer than ~60 s
         # (measured: 45 s OK, 65 s crashes the worker) — budget ~40 s per
-        # chunk from a measured ~1.6e-7 s/row/class/pass iteration cost.
-        # Depthwise pays one batched histogram pass per level; leaf-wise
-        # pays one full-N masked pass per SPLIT (L-1 of them), so its
-        # estimate scales with the leaf budget, not the depth.
+        # chunk from a measured iteration-cost model calibrated at 10M
+        # rows x 28 features x 256 bins (1.6e-7 s/row/class/pass) and
+        # scaled by F·B, since histogram work is O(N·F·B) per pass
+        # (Epsilon's 2000 features once packed a chunk ~70x past the
+        # budget and the watchdog killed the worker).  Depthwise pays one
+        # batched pass per level; leaf-wise one full-N masked pass per
+        # SPLIT (L-1), so its estimate scales with the leaf budget.
         if p.growth == "depthwise" and p.max_depth > 0:
             passes_est = p.max_depth
         else:
             passes_est = max(8, p.effective_num_leaves - 1)
-        est_iter_s = 1.6e-7 * NP * K * passes_est
+        est_iter_s = (1.6e-7 * NP * K * passes_est
+                      * max(F / 28.0, 1.0) * max(B / 256.0, 1.0))
         CH = max(1, min(16, int(40.0 / max(est_iter_s, 1e-3))))
+        # a 1-iteration chunk batches nothing — and the fori_loop wrapper
+        # measurably inflates remote-compile size/time on very wide data
+        # (Epsilon 2000-feature programs failed to compile through the
+        # tunnel), a property of program WIDTH, not runtime — so gate on
+        # F*B directly as well: wide-but-short data must not chunk either
+        chunkable = CH >= 2 and F * B <= (1 << 16)
+    if chunkable:
         total_iters = T // K
         it = start_iter
         while it < total_iters:

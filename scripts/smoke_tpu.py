@@ -1,0 +1,59 @@
+"""On-device smoke checks that forced-CPU CI cannot cover (CLAUDE.md:
+"new kernel shapes must be smoke-run on the real device once"; MXU
+lowerings are fusion-sensitive, so program-level contracts need a check
+on real hardware).
+
+Run after touching histogram builders or the Pallas kernel:
+    PYTHONPATH=/root/.axon_site:/root/repo python scripts/smoke_tpu.py
+"""
+
+import numpy as np
+
+
+def smoke_shared_vs_per_class():
+    """build_hist_classes per-class slices == build_hist, bitwise, on the
+    attached device (the shared multiclass root pass rides on this)."""
+    import jax.numpy as jnp
+
+    from dryad_tpu.engine.histogram import build_hist, build_hist_classes
+
+    rng = np.random.default_rng(53)
+    N, F, B, K = 200_000, 28, 256, 7
+    Xb = jnp.asarray(rng.integers(0, B, size=(N, F), dtype=np.uint8))
+    g = jnp.asarray(rng.normal(size=(N, K)).astype(np.float32))
+    h = jnp.asarray(rng.uniform(0.1, 1, size=(N, K)).astype(np.float32))
+    mask = jnp.asarray(rng.random(N) < 0.8)
+    shared = np.asarray(build_hist_classes(Xb, g, h, mask, B,
+                                           rows_per_chunk=32768))
+    for k in range(K):
+        single = np.asarray(build_hist(Xb, g[:, k], h[:, k], mask, B,
+                                       rows_per_chunk=32768))
+        np.testing.assert_array_equal(shared[k], single)
+    print(f"shared-vs-per-class roots: bitwise equal for all {K} classes")
+
+
+def smoke_pallas_vs_xla():
+    """Pallas segmented histogram vs the XLA oracle on the device."""
+    import jax
+    import jax.numpy as jnp
+
+    from dryad_tpu.engine.histogram import build_hist_segmented
+
+    if jax.devices()[0].platform == "cpu":
+        print("pallas-vs-xla: skipped (no accelerator attached)")
+        return
+    rng = np.random.default_rng(59)
+    N, F, B, P = 100_000, 12, 64, 16
+    Xb = jnp.asarray(rng.integers(0, B, size=(N, F), dtype=np.uint8))
+    g = jnp.asarray(rng.normal(size=N).astype(np.float32))
+    h = jnp.asarray(rng.uniform(0.1, 1, N).astype(np.float32))
+    sel = jnp.asarray(rng.integers(0, P + 1, N).astype(np.int32))
+    got = np.asarray(build_hist_segmented(Xb, g, h, sel, P, B, backend="pallas"))
+    want = np.asarray(build_hist_segmented(Xb, g, h, sel, P, B, backend="xla"))
+    np.testing.assert_allclose(got, want, atol=2e-2, rtol=2e-5)
+    print("pallas-vs-xla segmented histogram: agree to tolerance")
+
+
+if __name__ == "__main__":
+    smoke_shared_vs_per_class()
+    smoke_pallas_vs_xla()
