@@ -10,7 +10,7 @@ an identical (sub-sampled) config — no published Dryad-on-A100 number exists
 in this environment (BASELINE.md), so the CPU reference is the recorded
 baseline the driver tracks across rounds.
 
-Env knobs: BENCH_ROWS (default 200000), BENCH_TREES (default 20),
+Env knobs: BENCH_ROWS (default 200000), BENCH_TREES (default 50),
 BENCH_LEAVES (default 255), BENCH_GROWTH (default depthwise).
 """
 
@@ -25,7 +25,10 @@ import numpy as np
 
 def main() -> None:
     rows = int(os.environ.get("BENCH_ROWS", 200_000))
-    trees = int(os.environ.get("BENCH_TREES", 20))
+    # 50 trees: long enough that the steady-state chunked pipeline dominates
+    # (20 trees left ~30% of wall in fixed per-run costs), short enough for
+    # a ~2-minute bench incl. the identical-shape warmup run
+    trees = int(os.environ.get("BENCH_TREES", 50))
     leaves = int(os.environ.get("BENCH_LEAVES", 255))
     growth = os.environ.get("BENCH_GROWTH", "depthwise")
 
