@@ -21,6 +21,13 @@ Bundle encoding (bundle members f_1..f_m with bin counts n_1..n_m):
 Missing values (member bin 0) encode at offset_k + 0, so a bundled column's
 bin 0 never means "missing" — bundled columns are excluded from the
 missing-direction machinery (Dataset.has_missing).
+
+Bundling runs automatically on the in-memory CSR ingest path
+(``Dataset(csr=..., bundle=True)``, the default).  The out-of-core
+streaming ingest (data/streaming.py) does NOT auto-bundle — its binned
+matrix is built chunk-by-chunk before a global plan exists; fold it
+afterwards via ``BundledMapper(base, plan_bundles(Xb, base, max_bins))``
+and ``Dataset.from_binned`` when the matrix fits in memory.
 """
 
 from __future__ import annotations
