@@ -324,6 +324,7 @@ def build_hist_segmented(
     backend: str = "xla",
     rows_bound: int | None = None,
     platform: str | None = None,
+    records: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
     """Histograms for ``num_cols`` leaves -> (P, 3, F, B) fp32, O(N·F·B) work.
 
@@ -344,7 +345,7 @@ def build_hist_segmented(
         if pallas_hist.supports(total_bins):
             return pallas_hist.build_hist_segmented_pallas(
                 Xb, g, h, sel, num_cols, total_bins, axis_name=axis_name,
-                rows_bound=rows_bound, platform=platform,
+                rows_bound=rows_bound, platform=platform, records=records,
             )
     N, F = Xb.shape
     B = int(total_bins)

@@ -67,6 +67,19 @@ def grow_tree_levelwise(
     depth_cap = p.max_depth
     assert depth_cap > 0, "levelwise growth requires max_depth > 0"
 
+    # one per-TREE record table [g, h, X] for the Pallas levels: every
+    # level's segmented histogram then pays ONE row gather instead of an X
+    # gather + a g/h gather (pallas_hist.make_records)
+    from dryad_tpu.engine.histogram import resolve_backend
+
+    records = None
+    if resolve_backend(p.hist_backend, segmented=True,
+                       platform=platform) == "pallas":
+        from dryad_tpu.engine import pallas_hist
+
+        if pallas_hist.supports(B):
+            records = pallas_hist.make_records(Xb, g, h)
+
     from dryad_tpu.engine.grower import _monotone_array
 
     mono = _monotone_array(p, F)
@@ -216,21 +229,72 @@ def grow_tree_levelwise(
                                                  mode="drop")
 
             # ---- row partition: every splitting leaf in one vectorized pass -----
-            slot_do = jnp.zeros((L,), bool).at[jnp.where(do, sj, L)].set(True, mode="drop")
-            slot_right = jnp.full((L,), L, jnp.int32).at[
-                jnp.where(do, sj, L)].set(right_slot, mode="drop")
+            # Two measured rules shape this block (exp_level_bisect.py, 10M):
+            # a per-row column gather (take_along_axis into the (N, F)
+            # matrix) costs ~320 ms/level — random element access — while a
+            # masked reduce over the feature axis reads the matrix
+            # CONTIGUOUSLY and costs ~30 ms; and each (N,)-gather from a
+            # small per-slot table costs ~30 ms, so the five per-slot
+            # lookups ride ONE packed two-word record gather instead.
+            # Integer/bool results are bit-identical to the gather
+            # formulation, so every parity invariant is untouched.
             rs = jnp.minimum(row_slot, L - 1)
-            row_do = slot_do[rs] & (row_slot < L)
-            rf = jnp.maximum(sp_feature[rs], 0)
-            bins_rf = jnp.take_along_axis(Xb, rf[:, None].astype(jnp.int32), axis=1)[:, 0]
-            bins_rf = bins_rf.astype(jnp.int32)
-            go_left = bins_rf <= sp_thresh[rs]
-            if learn_missing:
-                go_left &= sp_dleft[rs] | (bins_rf > 0)
-            if has_cat:
-                cat_row = sp_catmask[rs, jnp.minimum(bins_rf, Bc - 1)]
-                go_left = jnp.where(is_cat_feat[rf], cat_row, go_left)
-            row_slot = jnp.where(row_do & ~go_left, slot_right[rs], row_slot)
+            if B <= (1 << 13) and L < (1 << 16):
+                # cat_split above is already the per-candidate cat flag (its
+                # & do is a no-op here: records only scatter where do holds)
+                cat_c = cat_split if has_cat else jnp.zeros((P,), bool)
+                w0_c = ((jnp.uint32(1) << 31)
+                        | (sp_dleft[sj].astype(jnp.uint32) << 30)
+                        | (cat_c.astype(jnp.uint32) << 29)
+                        | (jnp.clip(thr, 0, B - 1).astype(jnp.uint32) << 16)
+                        | right_slot.astype(jnp.uint32))
+                rec_t = jnp.zeros((L + 1, 2), jnp.uint32).at[
+                    jnp.where(do, sj, L + 1)].set(
+                        jnp.stack([w0_c,
+                                   jnp.maximum(sf, 0).astype(jnp.uint32)],
+                                  axis=1), mode="drop")
+                rec_r = rec_t[rs]                      # ONE small-table gather
+                w0r = rec_r[:, 0]
+                rf = rec_r[:, 1].astype(jnp.int32)
+                row_do = ((w0r >> 31) != 0) & (row_slot < L)
+                # masked reduce over F: at most one column matches per row
+                iota_f = jnp.arange(F, dtype=jnp.int32)
+                bins_rf = jnp.max(
+                    jnp.where(rf[:, None] == iota_f[None, :], Xb,
+                              jnp.zeros((), Xb.dtype)),
+                    axis=1).astype(jnp.int32)
+                thr_r = ((w0r >> 16) & jnp.uint32(0x1FFF)).astype(jnp.int32)
+                go_left = bins_rf <= thr_r
+                if learn_missing:
+                    go_left &= ((w0r >> 30) & 1).astype(bool) | (bins_rf > 0)
+                if has_cat:
+                    cat_row = sp_catmask[rs, jnp.minimum(bins_rf, Bc - 1)]
+                    go_left = jnp.where(((w0r >> 29) & 1).astype(bool),
+                                        cat_row, go_left)
+                row_slot = jnp.where(
+                    row_do & ~go_left,
+                    (w0r & jnp.uint32(0xFFFF)).astype(jnp.int32), row_slot)
+            else:
+                # exotic shapes (bins > 8192 or leaves >= 65536) exceed the
+                # packed-word budget: keep the gather formulation (static
+                # per-config choice, so every shard still runs one program)
+                slot_do = jnp.zeros((L,), bool).at[
+                    jnp.where(do, sj, L)].set(True, mode="drop")
+                slot_right = jnp.full((L,), L, jnp.int32).at[
+                    jnp.where(do, sj, L)].set(right_slot, mode="drop")
+                row_do = slot_do[rs] & (row_slot < L)
+                rf = jnp.maximum(sp_feature[rs], 0)
+                bins_rf = jnp.take_along_axis(
+                    Xb, rf[:, None].astype(jnp.int32), axis=1)[:, 0]
+                bins_rf = bins_rf.astype(jnp.int32)
+                go_left = bins_rf <= sp_thresh[rs]
+                if learn_missing:
+                    go_left &= sp_dleft[rs] | (bins_rf > 0)
+                if has_cat:
+                    cat_row = sp_catmask[rs, jnp.minimum(bins_rf, Bc - 1)]
+                    go_left = jnp.where(is_cat_feat[rf], cat_row, go_left)
+                row_slot = jnp.where(row_do & ~go_left, slot_right[rs],
+                                     row_slot)
 
             # ---- one batched histogram pass for all smaller children ------------
             left_smaller = CL <= CR
@@ -258,7 +322,7 @@ def grow_tree_levelwise(
                 rows_per_chunk=p.rows_per_chunk, axis_name=axis_name,
                 precision=p.hist_precision, backend=p.hist_backend,
                 rows_bound=(N // 2 + 1) if bound_ok else None,
-                platform=platform,
+                platform=platform, records=records,
             )
             if p.hist_subtraction:
                 hist_large = hists[sj] - hist_small

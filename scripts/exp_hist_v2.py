@@ -1,0 +1,259 @@
+"""Experimental v2 segmented-histogram pipeline — measure before integrating.
+
+Changes vs engine/pallas_hist.py, each separately toggleable:
+  1. tile_plan: packed uint32 single-key sort (slot<<24 | row) replacing
+     argsort + sel[order]; plan construction reads slot and row id from the
+     same sorted word.
+  2. One per-level gather of (9,) int32 RECORDS [g, h, X as 7 words] from a
+     per-TREE record table, replacing separate X row + g/h gathers and the
+     per-level sentinel concatenates.
+  3. uint8 tile buffers with in-kernel cast (4x less tile HBM traffic).
+  4. Weight rows packed (n_tiles, 8, T) instead of padded to 128; the
+     kernel pads to the MXU tile in VMEM.
+
+Prints times and bitwise-compares against the current pipeline.
+"""
+
+import functools
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from dryad_tpu.engine.pallas_hist import (
+    _MXU_M, _TILE_ROWS, _WROWS, _feature_chunk, _hist_tiles, _pack_weights,
+    _pow2_bins, _split3, _tiles_from_rows, tile_plan,
+)
+
+T = _TILE_ROWS
+
+
+# ---------------------------------------------------------------------------
+# 1. packed-sort tile plan
+# ---------------------------------------------------------------------------
+def tile_plan_v2(sel, N, P, T, rows_bound=None):
+    """Same plan as tile_plan, via ONE uint32 sort of (slot<<24 | row_id).
+
+    Valid when N <= 2^24 and P < 256.  Returns (buf, tile_leaf, tile_first)
+    with identical values to tile_plan (stable grouping by construction:
+    row id in the low bits makes keys strictly increasing within a slot).
+    """
+    bound = N if rows_bound is None else min(int(rows_bound), N)
+    n_tiles = bound // T + P + 1
+    key = (sel.astype(jnp.uint32) << jnp.uint32(24)) | jnp.arange(
+        N, dtype=jnp.uint32)
+    srt = jnp.sort(key)
+    sel_sorted = (srt >> jnp.uint32(24)).astype(jnp.int32)
+    start = jnp.searchsorted(sel_sorted, jnp.arange(P + 1, dtype=jnp.int32),
+                             side="left").astype(jnp.int32)
+    counts = start[1:] - start[:-1]
+    leaf_tiles = jnp.maximum((counts + (T - 1)) // T, 1)
+    seg_base = jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                                jnp.cumsum(leaf_tiles).astype(jnp.int32)])
+    seg_base = jnp.minimum(
+        seg_base, jnp.int32(n_tiles) - (P - jnp.arange(P + 1, dtype=jnp.int32)))
+    cap_rows = (seg_base[1:] - seg_base[:-1]) * T
+
+    tile_leaf = jnp.searchsorted(seg_base[1:],
+                                 jnp.arange(n_tiles, dtype=jnp.int32),
+                                 side="right").astype(jnp.int32)
+    tile_idx = jnp.arange(n_tiles, dtype=jnp.int32)
+    lc = jnp.minimum(tile_leaf, P - 1)
+    base_t = tile_idx * T - seg_base[lc] * T
+    cnt_t = jnp.minimum(counts[lc], cap_rows[lc])
+    start_t = start[lc]
+    j = jnp.arange(T, dtype=jnp.int32)
+    off = base_t[:, None] + j[None, :]
+    ok = (tile_leaf < P)[:, None] & (off >= 0) & (off < cnt_t[:, None])
+    src = start_t[:, None] + off
+    row_sorted = (srt & jnp.uint32(0xFFFFFF)).astype(jnp.int32)
+    buf = jnp.where(ok, row_sorted[jnp.clip(src, 0, N - 1)], N).reshape(-1)
+    tile_leaf = jnp.minimum(tile_leaf, P - 1)
+    tile_first = jnp.concatenate([
+        jnp.ones((1,), jnp.int32),
+        (tile_leaf[1:] != tile_leaf[:-1]).astype(jnp.int32),
+    ])
+    return buf, tile_leaf, tile_first
+
+
+# ---------------------------------------------------------------------------
+# 2+3+4. record-gather pipeline + u8 kernel with in-kernel weight pad
+# ---------------------------------------------------------------------------
+def make_records(Xb, g, h):
+    """Per-TREE (N, 2 + ceil(F/4)) int32 record table: [g, h, X words]."""
+    N, F = Xb.shape
+    fw = -(-F) // 4
+    Xw = jnp.pad(Xb, ((0, 0), (0, fw * 4 - F)))
+    Xw = jax.lax.bitcast_convert_type(Xw.reshape(N, fw, 4),
+                                      jnp.int32).reshape(N, fw)
+    gw = jax.lax.bitcast_convert_type(g.astype(jnp.float32), jnp.int32)
+    hw = jax.lax.bitcast_convert_type(h.astype(jnp.float32), jnp.int32)
+    return jnp.concatenate([gw[:, None], hw[:, None], Xw], axis=1)
+
+
+def _hist_kernel_v2(tile_leaf_ref, tile_first_ref, x_ref, w_ref, o_ref, *,
+                    padded_bins: int):
+    i = pl.program_id(1)
+    x = x_ref[0, 0].astype(jnp.int32)              # (Fc, T) u8 -> i32
+    Fc, Tl = x.shape
+    Bp = padded_bins
+    shift = Fc.bit_length() - 1
+    x_rep = pltpu.repeat(x, Bp, axis=0)
+    iota_b = jax.lax.broadcasted_iota(jnp.int32, (Fc * Bp, Tl), 0) >> shift
+    onehot = (x_rep == iota_b).astype(jnp.bfloat16)
+    w = jnp.concatenate(
+        [w_ref[0], jnp.zeros((_MXU_M - _WROWS, Tl), jnp.bfloat16)], axis=0)
+    part = jax.lax.dot_general(
+        w, onehot, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )[:_WROWS]
+
+    @pl.when(tile_first_ref[i] == 1)
+    def _():
+        o_ref[0] = part
+
+    @pl.when(tile_first_ref[i] == 0)
+    def _():
+        o_ref[0] = o_ref[0] + part
+
+
+@functools.partial(jax.jit, static_argnames=("num_cols", "total_bins",
+                                             "num_features", "wpad"))
+def _hist_tiles_v2(Xt, Wt, tile_leaf, tile_first, *, num_cols, total_bins,
+                   num_features, wpad=False):
+    n_fb, n_tiles, Fc, Tl = Xt.shape
+    B = int(total_bins)
+    P = int(num_cols)
+    F = int(num_features)
+    Bp = _pow2_bins(B)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(n_fb, n_tiles),
+        in_specs=[
+            pl.BlockSpec((1, 1, Fc, Tl), lambda j, i, tl, tf: (j, i, 0, 0)),
+            pl.BlockSpec((1, _WROWS, Tl), lambda j, i, tl, tf: (i, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, _WROWS, Fc * Bp),
+                               lambda j, i, tl, tf: (tl[i], 0, j)),
+    )
+    out = pl.pallas_call(
+        functools.partial(_hist_kernel_v2, padded_bins=Bp),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((P, _WROWS, n_fb * Fc * Bp),
+                                       jnp.float32),
+    )(tile_leaf, tile_first, Xt, Wt)
+
+    out = (out.reshape(P, _WROWS, n_fb, Bp, Fc)
+              .transpose(0, 1, 2, 4, 3)
+              .reshape(P, _WROWS, n_fb * Fc, Bp))[:, :, :F, :B]
+    hg = out[:, 0] + out[:, 1] + out[:, 2]
+    hh = out[:, 3] + out[:, 4] + out[:, 5]
+    hc = out[:, 6]
+    return jnp.stack([hg, hh, hc], axis=1)
+
+
+def hist_v2(records, sel, N, F, P, B, rows_bound):
+    """Whole v2 per-level pipeline from the per-tree record table."""
+    buf, tile_leaf, tile_first = tile_plan_v2(sel, N, P, T,
+                                              rows_bound=rows_bound)
+    n_tiles = buf.shape[0] // T
+    safe = jnp.minimum(buf, N - 1)
+    rec = records[safe]                            # ONE gather (n_rows, 2+fw)
+    valid = (buf < N).reshape(n_tiles, T)
+    gh = jax.lax.bitcast_convert_type(rec[:, :2], jnp.float32)
+    gt = jnp.where(valid.reshape(-1), gh[:, 0], 0.0).reshape(n_tiles, T)
+    ht = jnp.where(valid.reshape(-1), gh[:, 1], 0.0).reshape(n_tiles, T)
+    fw = rec.shape[1] - 2
+    Xr = jax.lax.bitcast_convert_type(rec[:, 2:], jnp.uint8).reshape(
+        n_tiles * T, fw * 4)[:, :F]
+    # u8 feature-chunked tiles (no int32 cast — the kernel converts)
+    Fc = _feature_chunk(F, _pow2_bins(B))
+    fpad = (-F) % Fc
+    if fpad:
+        Xr = jnp.pad(Xr, ((0, 0), (0, fpad)))
+    n_fb = (F + fpad) // Fc
+    Xt = Xr.reshape(n_tiles, T, n_fb, Fc).transpose(2, 0, 3, 1)
+    # 8-row weight pack (no 128 pad)
+    v = valid.astype(jnp.float32)
+    gv = gt * v
+    hv = ht * v
+    Wt = jnp.stack([*_split3(gv), *_split3(hv), v.astype(jnp.bfloat16)],
+                   axis=-2)
+    Wt = jnp.pad(Wt, ((0, 0), (0, _WROWS - 7), (0, 0)))
+    return _hist_tiles_v2(Xt, Wt, tile_leaf, tile_first, num_cols=P,
+                          total_bins=B, num_features=F)
+
+
+def main():
+    N = int(sys.argv[1]) if len(sys.argv) > 1 else 10_000_000
+    P = int(sys.argv[2]) if len(sys.argv) > 2 else 128
+    K = int(sys.argv[3]) if len(sys.argv) > 3 else 5
+    F, B = 28, 256
+    rng = np.random.default_rng(0)
+    plat = jax.devices()[0].platform
+    print(f"rows={N} P={P} reps={K} device={jax.devices()[0]}")
+    bound = N // 2 + 1
+
+    Xb = jnp.asarray(rng.integers(1, B, size=(N, F), dtype=np.uint8))
+    g = jnp.asarray(rng.normal(size=N).astype(np.float32))
+    h = jnp.asarray(rng.uniform(0.1, 1.0, size=N).astype(np.float32))
+    sel_np = rng.integers(0, 2 * P, size=N).astype(np.int32)
+    sel_np = np.where(sel_np < P, sel_np, P)
+    sel = jnp.asarray(sel_np)
+
+    def loop_time(tag, step, *arrays):
+        f = jax.jit(lambda s0, *a: jax.lax.fori_loop(
+            0, K, lambda i, s: step(s, *a), s0))
+        _ = float(f(jnp.float32(0.0), *arrays))
+        t0 = time.perf_counter()
+        _ = float(f(jnp.float32(0.0), *arrays))
+        dt = (time.perf_counter() - t0) / K
+        print(f"{tag:42s} {dt*1e3:9.1f} ms")
+        return dt
+
+    j32 = lambda s: (s * 1e-30).astype(jnp.int32)
+
+    # correctness: v2 plan == v1 plan
+    b1, tl1, tf1 = jax.jit(lambda s: tile_plan(s, N, P, T, rows_bound=bound))(sel)
+    b2, tl2, tf2 = jax.jit(lambda s: tile_plan_v2(s, N, P, T, rows_bound=bound))(sel)
+    print("plan buf equal:", bool((b1 == b2).all()),
+          " tl equal:", bool((tl1 == tl2).all()),
+          " tf equal:", bool((tf1 == tf2).all()))
+
+    # correctness: v2 hist vs current segmented pallas path
+    from dryad_tpu.engine.pallas_hist import build_hist_segmented_pallas
+
+    hist1 = jax.jit(lambda X, gg, hh, ss: build_hist_segmented_pallas(
+        X, gg, hh, ss, P, B, rows_bound=bound, platform=plat))(Xb, g, h, sel)
+    records = jax.jit(make_records)(Xb, g, h)
+    hist2 = jax.jit(lambda r, ss: hist_v2(r, ss, N, F, P, B, bound))(records, sel)
+    hist1, hist2 = np.asarray(hist1), np.asarray(hist2)
+    print("hist bitwise equal:", bool((hist1 == hist2).all()),
+          " max abs diff:", float(np.abs(hist1 - hist2).max()))
+
+    loop_time("tile_plan v1", lambda s, ss: tile_plan(
+        ss + j32(s), N, P, T, rows_bound=bound)[0][0].astype(jnp.float32)
+        * 1e-30, sel)
+    loop_time("tile_plan v2 (packed sort)", lambda s, ss: tile_plan_v2(
+        ss + j32(s), N, P, T, rows_bound=bound)[0][0].astype(jnp.float32)
+        * 1e-30, sel)
+
+    loop_time("v1 whole (current)", lambda s, X, gg, hh, ss:
+              build_hist_segmented_pallas(
+                  X, gg + s, hh, ss, P, B, rows_bound=bound,
+                  platform=plat)[0, 0, 0, 0] * 1e-30, Xb, g, h, sel)
+    loop_time("v2 whole (records+u8+packed)", lambda s, r, ss:
+              hist_v2(r + j32(s)[None, None] * 0, ss, N, F, P, B,
+                      bound)[0, 0, 0, 0] * 1e-30, records, sel)
+    loop_time("make_records (per tree, /8 levels)", lambda s, X, gg, hh:
+              make_records(X, gg + s, hh)[0, 0].astype(jnp.float32) * 1e-30,
+              Xb, g, h)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,134 @@
+"""Bisect the composed level-wise body cost at 10M rows.
+
+profile_plan.py's isolated stages sum to ~300 ms/level but the real grower
+pays ~800+ ms/level — this script rebuilds the level body stage by stage
+(cumulative variants inside one 8-trip fori, like the real grower) to find
+where the composed program loses the time.
+
+Usage: PYTHONPATH=... python scripts/exp_level_bisect.py [rows] [stage...]
+"""
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dryad_tpu.config import make_params
+from dryad_tpu.engine.histogram import build_hist_segmented
+from dryad_tpu.engine.pallas_hist import make_records
+from dryad_tpu.engine.split import NEG_INF, find_best_split
+
+N = int(sys.argv[1]) if len(sys.argv) > 1 else 10_000_000
+F, B, L, P = 28, 256, 255, 128
+DEPTH = 8
+rng = np.random.default_rng(0)
+plat = jax.devices()[0].platform
+print(f"rows={N} P={P} levels={DEPTH} device={jax.devices()[0]}")
+
+Xb = jnp.asarray(rng.integers(1, B, size=(N, F), dtype=np.uint8))
+g = jnp.asarray(rng.normal(size=N).astype(np.float32))
+h = jnp.asarray(rng.uniform(0.1, 1.0, size=N).astype(np.float32))
+row_slot0 = jnp.asarray(rng.integers(0, L, size=N).astype(np.int32))
+fmask = jnp.ones((F,), bool)
+iscat = jnp.zeros((F,), bool)
+p = make_params(dict(objective="binary", num_leaves=L, max_depth=DEPTH,
+                     growth="depthwise"))
+
+
+def loop_time(tag, prog, *arrays):
+    f = jax.jit(prog)
+    _ = float(f(jnp.float32(0.0), *arrays))
+    t0 = time.perf_counter()
+    _ = float(f(jnp.float32(0.0), *arrays))
+    dt = time.perf_counter() - t0
+    print(f"{tag:46s} {dt*1e3/DEPTH:9.1f} ms/level  ({dt:.2f}s total)")
+    return dt
+
+
+def make_prog(stage):
+    def prog(s0, Xb, g, h, row_slot0):
+        records = make_records(Xb, g, h)
+        hists0 = jnp.zeros((L, 3, F, B), jnp.float32)
+
+        def body(d, carry):
+            acc, row_slot, hists = carry
+            # synthetic per-level candidate state (data-independent, cheap)
+            sj = (jnp.arange(P, dtype=jnp.int32) * 2
+                  + acc.astype(jnp.int32) % 1)
+            do = jnp.ones((P,), bool)
+            right_slot = jnp.minimum(sj + 1, L - 1)
+
+            # ---- stage >= 1: smallsel derivation from row_slot ----------
+            colof = jnp.full((L + 1,), P, jnp.int32).at[
+                jnp.where(do, sj, L + 1)].set(
+                    jnp.arange(P, dtype=jnp.int32), mode="drop")
+            smallsel = colof[jnp.minimum(row_slot, L)]
+
+            if stage == 0:
+                smallsel = jnp.minimum(row_slot % (P + 1), P)
+
+            # ---- seg hist (always) --------------------------------------
+            hist_small = build_hist_segmented(
+                Xb, g + acc, h, smallsel, P, B,
+                rows_per_chunk=p.rows_per_chunk,
+                precision="exact", backend="auto",
+                rows_bound=N // 2 + 1, platform=plat, records=records)
+
+            out = hist_small[0, 0, 0, 0]
+
+            # ---- stage >= 2: subtraction + hists writes ------------------
+            if stage >= 2:
+                hist_large = hists[sj] - hist_small
+                ls = (jnp.arange(P) % 2 == 0)[:, None, None, None]
+                hist_l = jnp.where(ls, hist_small, hist_large)
+                hist_r = jnp.where(ls, hist_large, hist_small)
+                hists = hists.at[jnp.where(do, sj, L)].set(
+                    hist_l, mode="drop")
+                hists = hists.at[jnp.where(do, right_slot, L)].set(
+                    hist_r, mode="drop")
+                out = out + hists[0, 0, 0, 0]
+
+            # ---- stage >= 3: vmapped split finder ------------------------
+            if stage >= 3:
+                ch_hist = jnp.concatenate([hist_l, hist_r])
+                GHC = jnp.abs(ch_hist[:, :3].sum(axis=(2, 3)))
+                allow = jnp.ones((2 * P,), bool)
+
+                def best(hist, G, H, C, al):
+                    return find_best_split(
+                        hist, G, H, C, lambda_l2=1.0, min_child_weight=1e-3,
+                        min_data_in_leaf=20, min_split_gain=0.0,
+                        feat_mask=fmask, is_cat_feat=iscat, allow=al,
+                        has_cat=False)
+                res = jax.vmap(best)(ch_hist, GHC[:, 0], GHC[:, 1],
+                                     GHC[:, 2], allow)
+                out = out + res.gain[0]
+
+            # ---- stage >= 4: row partition ------------------------------
+            if stage >= 4:
+                rs = jnp.minimum(row_slot, L - 1)
+                rf = rs % F
+                bins_rf = jnp.take_along_axis(
+                    Xb, rf[:, None].astype(jnp.int32), axis=1)[:, 0]
+                go_left = bins_rf.astype(jnp.int32) <= (rs % B)
+                row_slot = jnp.where(go_left, row_slot,
+                                     jnp.minimum(row_slot + 1, L - 1))
+
+            return (out * 1e-30 + acc, row_slot, hists)
+
+        acc, _, _ = jax.lax.fori_loop(0, DEPTH, body,
+                                      (s0, row_slot0, hists0))
+        return acc
+    return prog
+
+
+stages = [int(a) for a in sys.argv[2:]] or [0, 1, 2, 3, 4]
+names = {0: "seg hist only (synthetic sel)",
+         1: "+ smallsel from row_slot",
+         2: "+ subtraction + hists writes",
+         3: "+ vmap split finder",
+         4: "+ row partition"}
+for st in stages:
+    loop_time(names[st], make_prog(st), Xb, g, h, row_slot0)

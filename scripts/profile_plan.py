@@ -1,0 +1,157 @@
+"""Fine-grained stage breakdown of the segmented histogram pipeline at 10M.
+
+profile_level.py showed the whole build_hist_segmented call at ~675 ms with
+the Pallas kernel only ~107 ms of it — this script times each surrounding
+stage (tile plan, row gather, dtype cast, tile transpose, weight packing)
+and candidate replacements (packed single-word sort, uint8 tiles,
+unpadded weights, locality-structured gathers) in isolation with the
+fori-loop methodology, to pick the round-3 data-movement levers.
+
+Usage: PYTHONPATH=... python scripts/profile_plan.py [rows] [P] [reps]
+"""
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dryad_tpu.engine.pallas_hist import (
+    _TILE_ROWS, _hist_tiles, _pack_weights, _pow2_bins, _tiles_from_rows,
+    tile_plan,
+)
+
+
+def main():
+    N = int(sys.argv[1]) if len(sys.argv) > 1 else 10_000_000
+    P = int(sys.argv[2]) if len(sys.argv) > 2 else 128
+    K = int(sys.argv[3]) if len(sys.argv) > 3 else 5
+    F, B = 28, 256
+    T = _TILE_ROWS
+    rng = np.random.default_rng(0)
+    plat = jax.devices()[0].platform
+    print(f"rows={N} P={P} reps={K} device={jax.devices()[0]}")
+
+    Xb = jnp.asarray(rng.integers(1, B, size=(N, F), dtype=np.uint8))
+    g = jnp.asarray(rng.normal(size=N).astype(np.float32))
+    h = jnp.asarray(rng.uniform(0.1, 1.0, size=N).astype(np.float32))
+    sel_np = rng.integers(0, 2 * P, size=N).astype(np.int32)
+    sel_np = np.where(sel_np < P, sel_np, P)
+    sel = jnp.asarray(sel_np)
+    bound = N // 2 + 1
+
+    def loop_time(tag, step, *arrays):
+        f = jax.jit(lambda s0, *a: jax.lax.fori_loop(
+            0, K, lambda i, s: step(s, *a), s0))
+        _ = float(f(jnp.float32(0.0), *arrays))
+        t0 = time.perf_counter()
+        _ = float(f(jnp.float32(0.0), *arrays))
+        dt = (time.perf_counter() - t0) / K
+        print(f"{tag:42s} {dt*1e3:9.1f} ms")
+        return dt
+
+    j32 = lambda s: (s * 1e-30).astype(jnp.int32)
+
+    # ---- stage 1: plan ------------------------------------------------------
+    loop_time("argsort(sel) stable", lambda s, ss: jnp.argsort(
+        ss + j32(s), stable=True)[0].astype(jnp.float32) * 1e-30, sel)
+
+    def packed_sort(s, ss):
+        key = (ss + j32(s)).astype(jnp.uint32) * jnp.uint32(1 << 24) \
+            + jnp.arange(N, dtype=jnp.uint32)
+        srt = jnp.sort(key)
+        return (srt[0] & jnp.uint32(0xFFFFFF)).astype(jnp.float32) * 1e-30
+    loop_time("packed uint32 single sort", packed_sort, sel)
+
+    def plan_only(s, ss):
+        buf, tl, tf = tile_plan(ss + j32(s), N, P, T, rows_bound=bound)
+        return buf[0].astype(jnp.float32) * 1e-30
+    loop_time("tile_plan total", plan_only, sel)
+
+    buf, tile_leaf, tile_first = tile_plan(sel, N, P, T, rows_bound=bound)
+    buf = jax.block_until_ready(buf)
+    n_tiles = buf.shape[0] // T
+
+    # ---- stage 2: gathers ---------------------------------------------------
+    Xp = jnp.concatenate([Xb, jnp.zeros((1, F), Xb.dtype)])
+
+    def gx(s, xp, bb):
+        rows = xp[bb + j32(s)]
+        return rows[0, 0].astype(jnp.float32) * 1e-30
+    loop_time("X row gather uint8 (plan buf)", gx, Xp, buf)
+
+    # same gather with a locality-friendly buf (sorted within = sequential)
+    buf_sorted = jnp.sort(jnp.where(buf < N, buf, N))
+    loop_time("X row gather uint8 (sorted buf)", gx, Xp, buf_sorted)
+
+    ghp = jnp.concatenate([jnp.stack([g, h], axis=1),
+                           jnp.zeros((1, 2), jnp.float32)])
+
+    def ggh(s, gp, bb):
+        rows = gp[bb + j32(s)]
+        return rows[0, 0] * 1e-30
+    loop_time("g/h two-col gather", ggh, ghp, buf)
+
+    # ---- stage 3: cast + tile transpose ------------------------------------
+    Xrows = jax.block_until_ready(Xp[buf])
+
+    def cast_t(s, xr):
+        Xt = _tiles_from_rows(xr.astype(jnp.int32) + j32(s)[None, None],
+                              n_tiles, T, B)
+        return Xt[0, 0, 0, 0].astype(jnp.float32) * 1e-30
+    loop_time("astype(i32) + tiles transpose", cast_t, Xrows)
+
+    def t_u8(s, xr):
+        xr = xr + j32(s).astype(jnp.uint8)[None, None]
+        Fc = 32
+        fpad = (-F) % Fc
+        xrp = jnp.pad(xr, ((0, 0), (0, fpad)))
+        Xt = xrp.reshape(n_tiles, T, 1, Fc).transpose(2, 0, 3, 1)
+        return Xt[0, 0, 0, 0].astype(jnp.float32) * 1e-30
+    loop_time("uint8 tiles transpose (no cast)", t_u8, Xrows)
+
+    # ---- stage 4: weight packing -------------------------------------------
+    ght = jax.block_until_ready(ghp[buf].reshape(n_tiles, T, 2))
+    valid = (buf < N).reshape(n_tiles, T)
+
+    def packw(s, gt, vv):
+        Wt = _pack_weights(gt[:, :, 0] + s, gt[:, :, 1], vv)
+        return Wt[0, 0, 0].astype(jnp.float32) * 1e-30
+    loop_time("pack_weights (pad 128) write", packw, ght, valid)
+
+    def packw8(s, gt, vv):
+        from dryad_tpu.engine.pallas_hist import _split3
+        v = vv.astype(jnp.float32)
+        gv = (gt[:, :, 0] + s) * v
+        hv = gt[:, :, 1] * v
+        w = jnp.stack([*_split3(gv), *_split3(hv), v.astype(jnp.bfloat16)],
+                      axis=-2)
+        return w[0, 0, 0].astype(jnp.float32) * 1e-30
+    loop_time("pack_weights 7-row (no pad)", packw8, ght, valid)
+
+    # ---- stage 5: kernel alone ---------------------------------------------
+    Xt = jax.block_until_ready(_tiles_from_rows(Xp[buf].astype(jnp.int32),
+                                                n_tiles, T, B))
+    Wt = jax.block_until_ready(_pack_weights(ght[:, :, 0], ght[:, :, 1], valid))
+
+    def kern(s, xt, wt, tl, tf):
+        hist = _hist_tiles(xt, wt + s.astype(jnp.bfloat16), tl,
+                           tf, num_cols=P, total_bins=B,
+                           num_features=F, platform=plat)
+        return hist[0, 0, 0, 0] * 1e-30
+    loop_time("_hist_tiles kernel alone (i32 tiles)", kern, Xt, Wt,
+              tile_leaf, tile_first)
+
+    # ---- whole current pipeline for reference ------------------------------
+    from dryad_tpu.engine.histogram import build_hist_segmented
+
+    loop_time("build_hist_segmented (whole)", lambda s, X, gg, hh, ss:
+              build_hist_segmented(X, gg + s, hh, ss, P, B,
+                                   rows_per_chunk=65536, platform=plat,
+                                   rows_bound=bound)[0, 0, 0, 0] * 1e-30,
+              Xb, g, h, sel)
+
+
+if __name__ == "__main__":
+    main()

@@ -56,6 +56,25 @@ def test_segmented_hist_matches_xla():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-4)
 
 
+def test_segmented_records_path_bitwise():
+    """records= (per-tree fused-gather table) must reproduce the plain path
+    BITWISE, including F not divisible by 4 (the record rows pad to whole
+    int32 words) and uint16 bins (2-byte units)."""
+    from dryad_tpu.engine.pallas_hist import make_records
+
+    for f, b, dtype in ((6, 32, np.uint8), (9, 32, np.uint8),
+                        (5, 300, np.uint16)):
+        rng = np.random.default_rng(f)
+        Xb = jnp.asarray(rng.integers(0, b, size=(3000, f)).astype(dtype))
+        g = jnp.asarray(rng.normal(size=3000).astype(np.float32))
+        h = jnp.asarray(rng.uniform(0.1, 1.0, size=3000).astype(np.float32))
+        sel = jnp.asarray(rng.integers(0, 7, size=3000).astype(np.int32))
+        plain = build_hist_segmented_pallas(Xb, g, h, sel, 6, b)
+        rec = build_hist_segmented_pallas(Xb, g, h, sel, 6, b,
+                                          records=make_records(Xb, g, h))
+        np.testing.assert_array_equal(np.asarray(plain), np.asarray(rec))
+
+
 def test_segmented_hist_empty_and_single_leaf():
     Xb, g, h = _data(n=500, f=3, b=8, seed=5)
     P = 4
