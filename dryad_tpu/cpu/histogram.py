@@ -92,6 +92,7 @@ def find_best_split(
     lo: float = -np.inf,
     hi: float = np.inf,
     learn_missing: bool = False,
+    bundled_mask: np.ndarray | None = None,
 ) -> SplitInfo | None:
     """Best (feature, threshold) over the histogram; None when nothing valid.
 
@@ -167,6 +168,10 @@ def find_best_split(
         # plane-0 t=0 split (sides swapped) and fp noise could flip the
         # CPU/TPU argmax between the two representations (device mirrors)
         gain_r = np.where((C - CL_r) > hc[:, :1], gain_r, NEG_INF)
+        if bundled_mask is not None:
+            # EFB bundle columns: bin 0 means "all members default", never
+            # "missing" (mirrors engine/split.py exactly)
+            gain_r[bundled_mask] = NEG_INF
         if any_cat:
             gain_r[is_categorical] = NEG_INF
         flat2 = int(np.argmax(np.concatenate([gain.ravel(), gain_r.ravel()])))

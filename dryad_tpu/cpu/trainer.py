@@ -117,12 +117,14 @@ class _TreeGrower:
     """Grows one tree; mirrors engine/grower.py step-for-step."""
 
     def __init__(self, params: Params, Xb: np.ndarray, total_bins: int,
-                 is_categorical: np.ndarray, learn_missing: bool = False):
+                 is_categorical: np.ndarray, learn_missing: bool = False,
+                 bundled_mask: np.ndarray | None = None):
         self.p = params
         self.Xb = Xb
         self.B = total_bins
         self.is_cat_feat = is_categorical
         self.learn_missing = bool(learn_missing)
+        self.bundled_mask = bundled_mask
         self.mono = None
         if params.monotone_constraints and any(params.monotone_constraints):
             # pad/truncate to F (same policy as the device _monotone_array)
@@ -290,6 +292,7 @@ class _TreeGrower:
             lo=float(lo),
             hi=float(hi),
             learn_missing=self.learn_missing,
+            bundled_mask=self.bundled_mask,
         )
 
 
@@ -318,7 +321,12 @@ def train_cpu(
     init = np.asarray(obj.init_score(y, data.weight), np.float32).reshape(-1)
     score = np.broadcast_to(init, (N, K)).astype(np.float32).copy()
     qoff = data.query_offsets
-    grower = _TreeGrower(p, Xb, B, is_cat, learn_missing=data.has_missing)
+    bundled_np = getattr(data.mapper, "bundled_mask", None)
+    # the mask only matters when the missing-right plane is scanned at all
+    bundled = (bundled_np if data.has_missing and bundled_np is not None
+               and bundled_np.any() else None)
+    grower = _TreeGrower(p, Xb, B, is_cat, learn_missing=data.has_missing,
+                         bundled_mask=bundled)
     max_depth_seen = 0
 
     start_iter = 0

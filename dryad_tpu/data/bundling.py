@@ -122,18 +122,24 @@ def plan_bundles(
 
 def fold_bundles(Xb: np.ndarray, mapper: BinMapper,
                  bundles: Sequence[Sequence[int]],
-                 out_dtype: np.dtype) -> np.ndarray:
+                 out_dtype: np.dtype,
+                 conflict_out: list | None = None) -> np.ndarray:
     """Fold an original-feature binned matrix into the bundled layout.
 
     Output columns: bundle_0, bundle_1, ..., then the unbundled features in
     ascending id order (the layout ``BundledMapper`` describes).  Plans from
-    ``plan_bundles`` are strictly exclusive over the full data (verified
-    there); the lowest-member-wins rule below is defensive only."""
+    ``plan_bundles`` are strictly exclusive over the full TRAINING data
+    (verified there) — but validation/test/predict matrices carry no such
+    guarantee: when two members are non-default in the same row, the lowest
+    member wins and the other value is DROPPED.  Such conflicts are counted
+    and surfaced with a warning (and appended to ``conflict_out`` when
+    given) so silent feature loss cannot go unnoticed."""
     zb = zero_bins(mapper)
     n_bins = mapper.n_bins
     N = Xb.shape[0]
     in_bundle = np.zeros(mapper.num_features, bool)
     cols = []
+    conflicts = 0
     for members in bundles:
         enc = np.zeros(N, np.int32)
         taken = np.zeros(N, bool)
@@ -141,11 +147,23 @@ def fold_bundles(Xb: np.ndarray, mapper: BinMapper,
         for f in members:
             in_bundle[f] = True
             b = Xb[:, f].astype(np.int32)
-            nz = (b != zb[f]) & ~taken  # lowest member wins a (rare) conflict
+            on = b != zb[f]
+            conflicts += int(np.count_nonzero(on & taken))
+            nz = on & ~taken  # lowest member wins a conflict
             enc[nz] = off + b[nz]
             taken |= nz
             off += int(n_bins[f])
         cols.append(enc)
+    if conflict_out is not None:
+        conflict_out.append(conflicts)
+    if conflicts:
+        import warnings
+
+        warnings.warn(
+            f"EFB fold dropped {conflicts} non-default values: bundle "
+            "members exclusive on the training data conflicted in this "
+            "matrix (lowest member wins); predictions lose that feature "
+            "information", RuntimeWarning, stacklevel=2)
     rest = [Xb[:, f].astype(np.int32)
             for f in range(mapper.num_features) if not in_bundle[f]]
     return np.stack(cols + rest, axis=1).astype(out_dtype)
@@ -174,6 +192,9 @@ class BundledMapper:
         # not "missing" (Dataset.has_missing exclusion)
         self.bundled_mask = np.array(
             [True] * len(self.bundles) + [False] * len(self.rest), bool)
+        # conflicts dropped by the most recent transform()/fold() call
+        # (non-training matrices can violate the plan's exclusivity)
+        self.last_conflict_count = 0
 
     @property
     def num_features(self) -> int:
@@ -200,12 +221,20 @@ class BundledMapper:
     def transform(self, X: np.ndarray) -> np.ndarray:
         from dryad_tpu.data.binning import bin_matrix
 
-        return fold_bundles(bin_matrix(np.asarray(X, np.float32), self.base),
-                            self.base, self.bundles, self.bin_dtype)
+        out = []
+        Xb = fold_bundles(bin_matrix(np.asarray(X, np.float32), self.base),
+                          self.base, self.bundles, self.bin_dtype,
+                          conflict_out=out)
+        self.last_conflict_count = out[0]
+        return Xb
 
     def fold(self, Xb_base: np.ndarray) -> np.ndarray:
         """Fold an already-binned ORIGINAL-layout matrix (CSR ingest)."""
-        return fold_bundles(Xb_base, self.base, self.bundles, self.bin_dtype)
+        out = []
+        Xb = fold_bundles(Xb_base, self.base, self.bundles, self.bin_dtype,
+                          conflict_out=out)
+        self.last_conflict_count = out[0]
+        return Xb
 
     # ---- serialization -----------------------------------------------------
     def to_bytes(self) -> bytes:

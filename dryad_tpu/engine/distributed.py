@@ -56,7 +56,8 @@ def shard_rows(mesh: Mesh, *arrays):
 
 def grow_sharded(params: Params, total_bins: int, has_cat: bool,
                  mesh: Mesh, Xb, g, h, bag_mask, feat_mask, is_cat_feat,
-                 platform=None, learn_missing=False, root_hist=None):
+                 platform=None, learn_missing=False, root_hist=None,
+                 bundled_mask=None):
     """One sharded tree grow; returns (replicated tree, row-sharded leaves).
 
     Called inside the device train step's jit: the tree arrays come back
@@ -65,12 +66,15 @@ def grow_sharded(params: Params, total_bins: int, has_cat: bool,
     carries the class's slice of the shared-plan multiclass root pass.
     """
 
-    def run(Xb_l, g_l, h_l, bag_l, fmask, iscat, *maybe_root):
+    def run(Xb_l, g_l, h_l, bag_l, fmask, iscat, *extras):
+        extras = list(extras)
+        bmask_l = extras.pop(0) if bundled_mask is not None else None
         tree = grow_any(
             params, total_bins, Xb_l, g_l, h_l, bag_l, fmask, iscat,
             has_cat=has_cat, axis_name=AXIS, platform=platform,
             learn_missing=learn_missing,
-            root_hist=maybe_root[0] if maybe_root else None,
+            root_hist=extras[0] if extras else None,
+            bundled_mask=bmask_l,
         )
         # per-shard leaf ids straight from the grower's partition state
         leaves = tree.pop("row_leaf")
@@ -84,10 +88,11 @@ def grow_sharded(params: Params, total_bins: int, has_cat: bool,
         "value": rep, "gain": rep, "is_cat": rep, "cat_bitset": rep,
         "default_left": rep, "max_depth": rep,
     }
-    extra = () if root_hist is None else (root_hist,)
+    extra = () if bundled_mask is None else (bundled_mask,)
+    extra += () if root_hist is None else (root_hist,)
     return jax.shard_map(
         run, mesh=mesh,
-        in_specs=(row2, row, row, row, rep, rep) + ((rep,) if extra else ()),
+        in_specs=(row2, row, row, row, rep, rep) + (rep,) * len(extra),
         out_specs=(tree_specs, row),
     )(Xb, g, h, bag_mask, feat_mask, is_cat_feat, *extra)
 

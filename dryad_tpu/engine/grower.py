@@ -45,7 +45,7 @@ _BIG_DEPTH = jnp.int32(2**30)
 
 def grow_any(params, total_bins, Xb, g, h, bag_mask, feat_mask, is_cat_feat,
              *, has_cat=False, axis_name=None, platform=None,
-             learn_missing=False, root_hist=None):
+             learn_missing=False, root_hist=None, bundled_mask=None):
     """Route to the fastest grower for the growth policy.
 
     Depth-wise growth takes the level-synchronous path (one batched
@@ -61,11 +61,13 @@ def grow_any(params, total_bins, Xb, g, h, bag_mask, feat_mask, is_cat_feat,
             params, total_bins, Xb, g, h, bag_mask, feat_mask, is_cat_feat,
             has_cat=has_cat, axis_name=axis_name, platform=platform,
             learn_missing=learn_missing, root_hist=root_hist,
+            bundled_mask=bundled_mask,
         )
     return grow_tree(
         params, total_bins, Xb, g, h, bag_mask, feat_mask, is_cat_feat,
         has_cat=has_cat, axis_name=axis_name, platform=platform,
         learn_missing=learn_missing, root_hist=root_hist,
+        bundled_mask=bundled_mask,
     )
 
 
@@ -143,6 +145,7 @@ def grow_tree(
     platform: str | None = None,
     learn_missing: bool = False,
     root_hist: jnp.ndarray | None = None,
+    bundled_mask: jnp.ndarray | None = None,
 ) -> dict[str, Any]:
     """Grow one tree; returns SoA tree arrays (max_nodes,) + max_depth.
 
@@ -175,6 +178,7 @@ def grow_tree(
             lo=lo,
             hi=hi,
             learn_missing=learn_missing,
+            bundled_mask=bundled_mask,
         )
 
     def hist_of(mask):

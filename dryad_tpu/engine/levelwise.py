@@ -58,6 +58,7 @@ def grow_tree_levelwise(
     platform: str | None = None,
     learn_missing: bool = False,
     root_hist: jnp.ndarray | None = None,
+    bundled_mask: jnp.ndarray | None = None,
 ) -> dict[str, Any]:
     p = params
     N, F = Xb.shape
@@ -99,6 +100,7 @@ def grow_tree_levelwise(
             lo=lo,
             hi=hi,
             learn_missing=learn_missing,
+            bundled_mask=bundled_mask,
         )
 
     # ---- root (shared canonical construction) --------------------------------
@@ -257,12 +259,20 @@ def grow_tree_levelwise(
                 w0r = rec_r[:, 0]
                 rf = rec_r[:, 1].astype(jnp.int32)
                 row_do = ((w0r >> 31) != 0) & (row_slot < L)
-                # masked reduce over F: at most one column matches per row
-                iota_f = jnp.arange(F, dtype=jnp.int32)
-                bins_rf = jnp.max(
-                    jnp.where(rf[:, None] == iota_f[None, :], Xb,
-                              jnp.zeros((), Xb.dtype)),
-                    axis=1).astype(jnp.int32)
+                if F <= 256:
+                    # masked reduce over F (at most one column matches per
+                    # row): reads (N, F) CONTIGUOUSLY — ~10x faster than the
+                    # per-row random gather at F=28, but its traffic scales
+                    # with F while the gather's is ~per-access, so wide
+                    # matrices keep the gather (static per-config choice)
+                    iota_f = jnp.arange(F, dtype=jnp.int32)
+                    bins_rf = jnp.max(
+                        jnp.where(rf[:, None] == iota_f[None, :], Xb,
+                                  jnp.zeros((), Xb.dtype)),
+                        axis=1).astype(jnp.int32)
+                else:
+                    bins_rf = jnp.take_along_axis(
+                        Xb, rf[:, None], axis=1)[:, 0].astype(jnp.int32)
                 thr_r = ((w0r >> 16) & jnp.uint32(0x1FFF)).astype(jnp.int32)
                 go_left = bins_rf <= thr_r
                 if learn_missing:

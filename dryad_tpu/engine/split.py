@@ -52,6 +52,7 @@ def find_best_split(
     lo: jnp.ndarray | None = None,  # scalar f32: node output lower bound
     hi: jnp.ndarray | None = None,  # scalar f32: node output upper bound
     learn_missing: bool = False,    # static: scan missing-left AND missing-right
+    bundled_mask: jnp.ndarray | None = None,  # (F,) bool: EFB bundle columns
 ) -> SplitResult:
     hg, hh, hc = hist[0], hist[1], hist[2]
     F, B = hg.shape
@@ -128,6 +129,11 @@ def find_best_split(
         gain_r = jnp.where((C - CL_r) > c0, gain_r, NEG_INF)
         if has_cat:
             gain_r = jnp.where(is_cat_feat[:, None], NEG_INF, gain_r)
+        if bundled_mask is not None:
+            # EFB bundle columns: bin 0 means "all members default", never
+            # "missing" — a learned missing-right direction there would be
+            # fiction (mirrors cpu/histogram.py exactly)
+            gain_r = jnp.where(bundled_mask[:, None], NEG_INF, gain_r)
         flat2 = jnp.argmax(jnp.stack([gain.ravel(), gain_r.ravel()]).ravel())
         flat2 = flat2.astype(jnp.int32)
         dleft = flat2 < F * B

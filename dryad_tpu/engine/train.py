@@ -41,7 +41,8 @@ _TREE_KEYS = ("feature", "threshold", "left", "right", "value", "is_cat",
 
 
 def _step_body(p, B, has_cat, mesh, platform, learn_missing, out, score, Xb,
-               g_all, h_all, bag, fmask, is_cat_feat, t, k, root_hist=None):
+               g_all, h_all, bag, fmask, is_cat_feat, t, k, root_hist=None,
+               bmask=None):
     """One (iteration, class) tree: grow, record into slot t, update scores.
 
     Shared by the per-iteration ``_step_jit`` dispatch and the chunked
@@ -58,12 +59,13 @@ def _step_body(p, B, has_cat, mesh, platform, learn_missing, out, score, Xb,
         tree, leaves = grow_sharded(
             p, B, has_cat, mesh, Xb, g, h, bag, fmask, is_cat_feat,
             platform=platform, learn_missing=learn_missing,
-            root_hist=root_hist,
+            root_hist=root_hist, bundled_mask=bmask,
         )
     else:
         tree = grow_any(p, B, Xb, g, h, bag, fmask, is_cat_feat,
                         has_cat=has_cat, platform=platform,
-                        learn_missing=learn_missing, root_hist=root_hist)
+                        learn_missing=learn_missing, root_hist=root_hist,
+                        bundled_mask=bmask)
         # each row's leaf comes straight out of the grower's partition
         # state — re-traversing 10M rows cost ~5 s/tree (gather-bound)
         leaves = tree.pop("row_leaf")
@@ -124,7 +126,8 @@ _grads_jit = partial(jax.jit,
                           "rank_S"))
 def _chunk_jit(p, B, has_cat, mesh, platform, learn_missing, N, K, pad,
                rank_Q, rank_S, out, score, Xb, y, weight, bag, fmask,
-               is_cat_feat, qoff, rank_row, rank_col, it0, n_iters):
+               is_cat_feat, qoff, rank_row, rank_col, it0, n_iters,
+               bmask=None):
     """``n_iters`` whole boosting iterations inside ONE program.
 
     Through a remote device tunnel every host dispatch costs seconds at 10M
@@ -165,7 +168,7 @@ def _chunk_jit(p, B, has_cat, mesh, platform, learn_missing, N, K, pad,
             out, score = _step_body(
                 p, B, has_cat, mesh, platform, learn_missing, out, score,
                 Xb, g_all, h_all, bag, fmask, is_cat_feat, t, k,
-                root_hist=None if roots is None else roots[k])
+                root_hist=None if roots is None else roots[k], bmask=bmask)
         return out, score
 
     return jax.lax.fori_loop(0, n_iters, body, (out, score))
@@ -344,10 +347,18 @@ def train_device(
         learn_missing = bool(
             multihost_utils.process_allgather(np.int32(learn_missing)).max())
 
+    # EFB bundle columns are masked out of the missing-right split plane
+    # (their bin 0 means "all default", not "missing"); only materialized
+    # when the plane is scanned at all, so NaN-free programs are unchanged
+    bundled_np = getattr(data.mapper, "bundled_mask", None)
+    bmask = (jnp.asarray(bundled_np)
+             if learn_missing and bundled_np is not None and bundled_np.any()
+             else None)
+
     def step(out, score, g_all, h_all, bag, fmask, t, k, root_hist=None):
         return _step_jit(p_key, B, has_cat, mesh, plat, learn_missing, out,
                          score, Xb, g_all, h_all, bag, fmask, is_cat_feat, t, k,
-                         root_hist)
+                         root_hist, bmask)
 
     # ---- resume / warm start -------------------------------------------------
     out = _empty_out_device(T, p.max_nodes, CAT_WORDS)
@@ -449,6 +460,16 @@ def train_device(
     # Philox draw, no GOSS uniforms, no validation sync) the boosting loop
     # runs on device in blocks — through the remote tunnel each host
     # dispatch costs ~5 s at 10M rows, the dominant non-compute cost.
+    #
+    # ACCEPTED TOLERANCE (same class as the CPU↔TPU near-tie note in
+    # CLAUDE.md): the chunked program compiles the boosting step into a
+    # DIFFERENT fusion shape than per-iteration dispatch, so merely adding
+    # a validation set or subsample<1 (which switches paths) can flip a
+    # near-tie split argmax on device.  Path selection is a deterministic
+    # function of (params, valids), so resume and N-shard ≡ 1-shard — which
+    # never change the path mid-run — are unaffected; only configs that
+    # *straddle* the condition may see ulp-level tree differences, with
+    # model quality untouched.
     chunkable = (not valids and p.boosting == "gbdt"
                  and p.subsample >= 1.0 and p.colsample >= 1.0)
     if chunkable:
@@ -491,7 +512,7 @@ def train_device(
                 p_key, B, has_cat, mesh, plat, learn_missing, N, K, pad,
                 rank_Q, rank_S, out, score, Xb, y, weight, ones_rows,
                 ones_feat, is_cat_feat, qoff_j, rank_row, rank_col,
-                jnp.int32(it), jnp.int32(n))
+                jnp.int32(it), jnp.int32(n), bmask)
             if callback is not None:
                 for j in range(it, it + n):
                     callback(j, {"iteration": j})

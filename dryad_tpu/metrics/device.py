@@ -163,12 +163,36 @@ def make_evaluator(objective: str, metric: str, valid_ds, ndcg_at: int = 10):
     if name not in HIGHER_BETTER:
         # same exception type as the CPU backend's evaluate_raw
         raise ValueError(f"unknown metric {name!r}")
-    y = jnp.asarray(np.asarray(valid_ds.y, np.float32))
     qids = None
     if name == "ndcg":
         if valid_ds.query_offsets is None:
             raise ValueError("ndcg requires query groups on the validation set")
+        qoff = np.asarray(valid_ds.query_offsets, np.int64)
+        sizes = np.diff(qoff)
+        Q, S = sizes.size, int(sizes.max(initial=1))
+        N = int(qoff[-1])
+        # the dense (Q, S) plan explodes on skewed group sizes (100k tiny
+        # queries + one 1M-row group -> Q*S ~ 1e11 ids): when the padded
+        # view is much larger than the data, evaluate on the HOST instead —
+        # one score fetch per eval (the deferred-fetch optimization is lost,
+        # correctness is not)
+        if Q * S > max(8 * N, 1 << 24):
+            from dryad_tpu.metrics import ndcg_at_k
+
+            y_np = np.asarray(valid_ds.y)
+            qoff_np = qoff
+
+            def fn_host(vscore):
+                s = np.asarray(vscore)
+                if s.ndim == 2 and s.shape[1] == 1:
+                    s = s[:, 0]
+                return np.float32(ndcg_at_k(y_np, s, qoff_np, ndcg_at))
+
+            return name, HIGHER_BETTER[name], fn_host
         qids = jnp.asarray(_pad_queries(valid_ds.query_offsets)[0])
+
+    # labels upload only when a device evaluator is actually returned
+    y = jnp.asarray(np.asarray(valid_ds.y, np.float32))
 
     def fn(vscore):
         return _eval_jit(name, ndcg_at, y, vscore, qids)

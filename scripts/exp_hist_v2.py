@@ -1,4 +1,6 @@
-"""Experimental v2 segmented-histogram pipeline — measure before integrating.
+"""Experimental v2 segmented-histogram pipeline (HISTORICAL: integrated into
+engine/pallas_hist.py — kept as the measurement record; the integrated
+copies are canonical and this script is not maintained against them).
 
 Changes vs engine/pallas_hist.py, each separately toggleable:
   1. tile_plan: packed uint32 single-key sort (slot<<24 | row) replacing
@@ -86,7 +88,7 @@ def tile_plan_v2(sel, N, P, T, rows_bound=None):
 def make_records(Xb, g, h):
     """Per-TREE (N, 2 + ceil(F/4)) int32 record table: [g, h, X words]."""
     N, F = Xb.shape
-    fw = -(-F) // 4
+    fw = -(-F // 4)
     Xw = jnp.pad(Xb, ((0, 0), (0, fw * 4 - F)))
     Xw = jax.lax.bitcast_convert_type(Xw.reshape(N, fw, 4),
                                       jnp.int32).reshape(N, fw)

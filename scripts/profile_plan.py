@@ -118,7 +118,7 @@ def main():
     def packw(s, gt, vv):
         Wt = _pack_weights(gt[:, :, 0] + s, gt[:, :, 1], vv)
         return Wt[0, 0, 0].astype(jnp.float32) * 1e-30
-    loop_time("pack_weights (pad 128) write", packw, ght, valid)
+    loop_time("pack_weights (current engine)", packw, ght, valid)
 
     def packw8(s, gt, vv):
         from dryad_tpu.engine.pallas_hist import _split3
@@ -128,7 +128,7 @@ def main():
         w = jnp.stack([*_split3(gv), *_split3(hv), v.astype(jnp.bfloat16)],
                       axis=-2)
         return w[0, 0, 0].astype(jnp.float32) * 1e-30
-    loop_time("pack_weights 7-row (no pad)", packw8, ght, valid)
+    loop_time("pack_weights 7-row inline", packw8, ght, valid)
 
     # ---- stage 5: kernel alone ---------------------------------------------
     Xt = jax.block_until_ready(_tiles_from_rows(Xp[buf].astype(jnp.int32),

@@ -141,3 +141,29 @@ def test_trainer_uses_device_eval_and_sets_best_iteration():
                          callback=lambda it, info: seen.append(info))
     assert b_sync.best_iteration == b.best_iteration
     assert any("valid_auc" in s for s in seen)
+
+
+def test_ndcg_skewed_groups_fall_back_to_host():
+    """A skewed ranking valid set (many tiny queries + one huge one) must
+    not densify a (Q, S) plan with Q*S >> N — make_evaluator falls back to
+    the host-side NDCG (one fetch per eval, no memory blow-up) and the
+    value matches the oracle."""
+    import dryad_tpu as dryad
+    from dryad_tpu.metrics import ndcg_at_k
+    from dryad_tpu.metrics.device import make_evaluator
+
+    rng = np.random.default_rng(5)
+    # 60k singleton queries + one 12k-row group: Q*S ~ 7.2e8 >> 8*N
+    sizes = np.concatenate([np.ones(60_000, np.int64), [12_000]])
+    N = int(sizes.sum())
+    y = rng.integers(0, 3, size=N).astype(np.float32)
+    X = rng.normal(size=(N, 3)).astype(np.float32)
+    ds = dryad.Dataset(X, y, group=sizes)
+    name, higher, fn = make_evaluator("lambdarank", "ndcg", ds, 10)
+    assert name == "ndcg" and higher
+    import jax.numpy as jnp
+
+    score = rng.normal(size=N).astype(np.float32)
+    got = float(fn(jnp.asarray(score[:, None])))
+    want = ndcg_at_k(y, score, ds.query_offsets, 10)
+    assert abs(got - want) < 1e-6
