@@ -419,6 +419,10 @@ def hist_from_plan(
     which re-materialized the whole (N, F) matrix every level).
 
     ``records`` (make_records) collapses the X and g/h gathers into one.
+    CONTRACT: it must have been built from the SAME (Xb, g, h) passed here —
+    on the records path the g/h arguments are ignored (values come from the
+    table) and Xb contributes only shape/dtype; a stale table silently
+    yields histograms of the old gradients.
     """
     N, F = Xb.shape
     B = int(total_bins)

@@ -123,24 +123,48 @@ _grads_jit = partial(jax.jit,
 @partial(jax.jit,
          static_argnames=("p", "B", "has_cat", "mesh", "platform",
                           "learn_missing", "N", "K", "pad", "rank_Q",
-                          "rank_S"))
+                          "rank_S", "metric_names", "ndcg_at", "eval_period",
+                          "total_iters"))
 def _chunk_jit(p, B, has_cat, mesh, platform, learn_missing, N, K, pad,
                rank_Q, rank_S, out, score, Xb, y, weight, bag, fmask,
                is_cat_feat, qoff, rank_row, rank_col, it0, n_iters,
-               bmask=None):
+               bmask=None, bag_bits=None, fmask_chunk=None,
+               metric_names=(), ndcg_at=10, eval_period=1, total_iters=0,
+               vXbs=(), vys=(), vqids=(), vscores=(), eval_buf=None,
+               eval_its=None, eval_cnt=None):
     """``n_iters`` whole boosting iterations inside ONE program.
 
     Through a remote device tunnel every host dispatch costs seconds at 10M
     rows (measured ~5 s/iter of pure dispatch overhead vs the same body in
-    a fori_loop), so when no per-iteration host input is needed (no
-    bagging/colsample draw, no GOSS uniforms, no eval sync) the boosting
-    loop itself runs on device: grads are recomputed from the carried score
-    each trip — identical semantics to per-iteration dispatch.  ``it0`` and
-    ``n_iters`` are traced, so one compiled program serves every chunk and
-    tail length.
+    a fori_loop), so the boosting loop itself runs on device in blocks:
+    grads are recomputed from the carried score each trip — identical
+    semantics to per-iteration dispatch.  ``it0`` and ``n_iters`` are
+    traced, so one compiled program serves every chunk and tail length.
+
+    Round-3 extensions (VERDICT r2 #2) let realistic configs chunk too:
+
+    * **Bagging/colsample** — the host's Philox draws (the CPU-parity
+      anchor) upload per chunk: ``bag_bits`` (CH, ceil(NP/8)) uint8 packs
+      each iteration's row mask little-endian (unpacked on device),
+      ``fmask_chunk`` (CH, F) carries the per-iteration feature masks.
+    * **Validation** — per-tree valid-set scores update inside the loop
+      (tree_leaves on the freshly written tree slot) and every
+      ``eval_period``-th iteration evaluates ALL sets on device
+      (metrics.device.eval_value), appending one (n_sets,) row into the
+      carried ``eval_buf`` with its iteration id in ``eval_its``.  Nothing
+      is fetched here; the host decides when to look.
     """
+    n_valid = len(metric_names)
+
     def body(i, carry):
-        out, score = carry
+        out, score, vscores, eval_buf, eval_its, eval_cnt = carry
+        if bag_bits is not None:
+            u8 = bag_bits[i]                       # (ceil(NP/8),) uint8
+            bits = ((u8[:, None] >> jnp.arange(8, dtype=jnp.uint8)) & 1)
+            bag_i = bits.reshape(-1)[:score.shape[0]].astype(bool) & bag
+        else:
+            bag_i = bag
+        fmask_i = fmask if fmask_chunk is None else fmask_chunk[i]
         g_all, h_all = _grads_body(p, N, K, pad, score, y, weight, qoff,
                                    rank_row, rank_col, rank_Q, rank_S)
         roots = None
@@ -154,24 +178,56 @@ def _chunk_jit(p, B, has_cat, mesh, platform, learn_missing, N, K, pad,
             if mesh is not None:
                 from dryad_tpu.engine.distributed import roots_sharded
 
-                roots = roots_sharded(mesh, Xb, g_all, h_all, bag, B,
+                roots = roots_sharded(mesh, Xb, g_all, h_all, bag_i, B,
                                       p.rows_per_chunk, p.hist_precision)
             else:
                 from dryad_tpu.engine.histogram import build_hist_classes
 
                 roots = build_hist_classes(
-                    Xb, g_all, h_all, bag, B,
+                    Xb, g_all, h_all, bag_i, B,
                     rows_per_chunk=p.rows_per_chunk,
                     precision=p.hist_precision)
         for k in range(K):
             t = (it0 + i) * K + k
             out, score = _step_body(
                 p, B, has_cat, mesh, platform, learn_missing, out, score,
-                Xb, g_all, h_all, bag, fmask, is_cat_feat, t, k,
+                Xb, g_all, h_all, bag_i, fmask_i, is_cat_feat, t, k,
                 root_hist=None if roots is None else roots[k], bmask=bmask)
-        return out, score
 
-    return jax.lax.fori_loop(0, n_iters, body, (out, score))
+        if n_valid:
+            new_vs = []
+            for vi in range(n_valid):
+                vs = vscores[vi]
+                for k in range(K):
+                    t = (it0 + i) * K + k
+                    tree = {key: out[key][t] for key in _TREE_KEYS}
+                    lv = tree_leaves(tree, vXbs[vi], out["max_depth"][t])
+                    vs = vs.at[:, k].set(vs[:, k] + tree["value"][lv])
+                new_vs.append(vs)
+            vscores = tuple(new_vs)
+
+            from dryad_tpu.metrics.device import eval_value
+
+            it_now = it0 + i
+            do_eval = (((it_now + 1) % eval_period == 0)
+                       | (it_now + 1 == total_iters))
+
+            def write(args):
+                buf, its, cnt = args
+                vals = jnp.stack([
+                    eval_value(metric_names[vi], ndcg_at, vys[vi],
+                               vscores[vi], vqids[vi])
+                    for vi in range(n_valid)])
+                return (buf.at[cnt].set(vals), its.at[cnt].set(it_now),
+                        cnt + 1)
+
+            eval_buf, eval_its, eval_cnt = jax.lax.cond(
+                do_eval, write, lambda a: a, (eval_buf, eval_its, eval_cnt))
+        return out, score, vscores, eval_buf, eval_its, eval_cnt
+
+    return jax.lax.fori_loop(
+        0, n_iters, body,
+        (out, score, tuple(vscores), eval_buf, eval_its, eval_cnt))
 
 
 def _shared_roots_ok(p, platform) -> bool:
@@ -470,8 +526,20 @@ def train_device(
     # never change the path mid-run — are unaffected; only configs that
     # *straddle* the condition may see ulp-level tree differences, with
     # model quality untouched.
-    chunkable = (not valids and p.boosting == "gbdt"
-                 and p.subsample >= 1.0 and p.colsample >= 1.0)
+    # Round 3: bagged/colsampled runs chunk too (host Philox masks upload
+    # bit-packed per chunk), and validated runs evaluate INSIDE the chunk
+    # program — per-iteration dispatch remains only for GOSS (per-iteration
+    # uniforms would upload GBs at 10M rows), sharded bagging (packed bits
+    # do not split on row boundaries), host-fallback metrics, and
+    # early stopping at eval_period=1 (the value gates the next iteration,
+    # so a fetch per iteration is semantically required).
+    bagging = p.subsample < 1.0 or p.colsample < 1.0
+    host_eval = any(getattr(fn, "host_only", True) for _, _, fn in evaluators)
+    chunkable = (p.boosting == "gbdt"
+                 and not (bagging and mesh is not None)
+                 and not (valids and host_eval)
+                 and not (valids and p.early_stopping_rounds
+                          and p.eval_period < 2))
     if chunkable:
         # the tunnel kills single programs running longer than ~60 s
         # (measured: 45 s OK, 65 s crashes the worker) — budget ~40 s per
@@ -501,31 +569,175 @@ def train_device(
         # F*B directly as well: wide-but-short data must not chunk either
         chunkable = CH >= 2 and F * B <= (1 << 16)
     if chunkable:
+        import time as _time
+
         total_iters = T // K
+        if (valids and p.early_stopping_rounds
+                and stale >= p.early_stopping_rounds):
+            total_iters = start_iter   # resume landed ON the stop boundary
+
+        # eval machinery (device-resident; one (n_sets,) row per eval)
+        n_sets = len(valids)
+        metric_names = tuple(mname for mname, _, _ in evaluators)
+        vXbs_t = tuple(vXbs)
+        vys_t = tuple(fn.y_dev for _, _, fn in evaluators)
+        vqids_t = tuple(fn.qids for _, _, fn in evaluators)
+        eval_buf = jnp.zeros((max(total_iters, 1), n_sets), jnp.float32) \
+            if n_sets else None
+        eval_its = jnp.full((max(total_iters, 1),), -1, jnp.int32) \
+            if n_sets else None
+        eval_cnt = jnp.int32(0) if n_sets else None
+        vscores_t = tuple(vscores)
+        host_cnt = 0        # slots the host knows are written
+        flushed_cnt = 0     # slots already folded into best/history state
+
+        def eval_iters_in(lo, hi):
+            return [j for j in range(lo, hi)
+                    if (j + 1) % p.eval_period == 0 or j + 1 == total_iters]
+
+        def next_eval_end(lo):
+            j = lo
+            while not ((j + 1) % p.eval_period == 0 or j + 1 == total_iters):
+                j += 1
+            return j + 1
+
+        def flush_chunk_evals(upto):
+            """Fold fetched eval rows [flushed_cnt, upto) into
+            best-iteration state + eval_history (the deferred-path replay,
+            exact wherever it is observed)."""
+            nonlocal best_iteration, best_value, stale, eval_history
+            nonlocal flushed_cnt
+            if upto <= flushed_cnt:
+                return
+            vals, its_arr = jax.device_get(
+                (eval_buf[flushed_cnt:upto], eval_its[flushed_cnt:upto]))
+            _, higher0, _ = evaluators[0]
+            if eval_history is None:
+                eval_history = {}
+            for row, it_d in zip(np.asarray(vals), np.asarray(its_arr)):
+                for vi, ((vname, _), (mname, _, _)) in enumerate(
+                        zip(valids, evaluators)):
+                    eval_history.setdefault(f"{vname}_{mname}", []).append(
+                        [int(it_d), float(row[vi])])
+                best_iteration, best_value, stale = update_best(
+                    best_iteration, best_value, stale, int(it_d),
+                    float(row[0]), higher0)
+            flushed_cnt = upto
+
+        # per-chunk Philox mask upload buffers (fixed CH0 rows: a varying
+        # leading dim would recompile the chunk program per tail length)
+        CH0 = CH
+        nbytes = (NP + 7) // 8
+        row_sampled = p.subsample < 1.0
+        col_sampled = p.colsample < 1.0
+
+        # adaptive chunk budget: the 1.6e-7 model above is only the FIRST
+        # guess — the second chunk (the first one free of compile time) is
+        # timed and CH re-derived from measurement, never exceeding half
+        # the ~60 s tunnel watchdog.  Mask uploads pin the array shape, so
+        # CH can only shrink below CH0 once those exist.
+        chunk_idx = 0
+        t_mark = None
+        calibrated = False
+
         it = start_iter
         while it < total_iters:
             n = min(CH, total_iters - it)
             if checkpointer is not None:
                 # land chunk ends exactly on checkpoint boundaries
                 n = min(n, checkpointer.every - (it % checkpointer.every))
-            out, score = _chunk_jit(
+            if valids and p.early_stopping_rounds:
+                # early stopping reads each eval before growing past it:
+                # every chunk must END on an eval boundary
+                n = min(n, next_eval_end(it) - it)
+
+            bag_bits = fmask_chunk = None
+            if bagging:
+                bb = (np.zeros((CH0, nbytes), np.uint8) if row_sampled
+                      else None)
+                fm = (np.ones((CH0, F), bool) if col_sampled else None)
+                for j in range(n):
+                    rm, fmk = sample_masks(p, it + j, N, F)
+                    if bb is not None:
+                        row = np.ones(N, bool) if rm is None else rm
+                        bb[j] = np.packbits(np.pad(row, (0, pad)),
+                                            bitorder="little")
+                    if fm is not None and fmk is not None:
+                        fm[j] = fmk
+                bag_bits = jnp.asarray(bb) if bb is not None else None
+                fmask_chunk = jnp.asarray(fm) if fm is not None else None
+
+            (out, score, vscores_t, eval_buf, eval_its,
+             eval_cnt) = _chunk_jit(
                 p_key, B, has_cat, mesh, plat, learn_missing, N, K, pad,
                 rank_Q, rank_S, out, score, Xb, y, weight, ones_rows,
                 ones_feat, is_cat_feat, qoff_j, rank_row, rank_col,
-                jnp.int32(it), jnp.int32(n), bmask)
-            if callback is not None:
+                jnp.int32(it), jnp.int32(n), bmask, bag_bits, fmask_chunk,
+                metric_names, p.ndcg_at, p.eval_period, total_iters,
+                vXbs_t, vys_t, vqids_t, vscores_t, eval_buf, eval_its,
+                eval_cnt)
+
+            if not calibrated:
+                # drain the pipeline: chunk 0 absorbs compile, chunk 1 is
+                # the measurement
+                jax.block_until_ready(out["max_depth"])
+                now = _time.perf_counter()
+                if chunk_idx == 1 and t_mark is not None:
+                    per_iter = max((now - t_mark) / n, 1e-4)
+                    cap = CH0 if bagging else 64
+                    CH = max(1, min(cap, int(20.0 / per_iter)))
+                    calibrated = True
+                t_mark = now
+            chunk_idx += 1
+
+            evs = eval_iters_in(it, it + n)
+            host_cnt += len(evs)
+            stop = False
+            if valids and sync_eval and evs:
+                # one small fetch per chunk: the values feed early stopping
+                # and live callbacks (the chunk ended ON the eval boundary,
+                # so stopping here is iteration-exact)
+                vals = np.asarray(jax.device_get(
+                    eval_buf[host_cnt - len(evs):host_cnt]))
+                _, higher0, _ = evaluators[0]
+                val_rows = dict(zip(evs, vals))
+                for j in range(it, it + n):
+                    info = {"iteration": j}
+                    if j in val_rows:
+                        for vi, ((vname, _), (mname, higher, _)) in enumerate(
+                                zip(valids, evaluators)):
+                            info[f"{vname}_{mname}"] = float(val_rows[j][vi])
+                        best_iteration, best_value, stale = update_best(
+                            best_iteration, best_value, stale, j,
+                            float(val_rows[j][0]), higher0)
+                        if (p.early_stopping_rounds
+                                and stale >= p.early_stopping_rounds):
+                            stop = True
+                    if callback is not None:
+                        callback(j, info)
+                flushed_cnt = host_cnt  # consumed: keep deferred flush exact
+            elif callback is not None:
                 for j in range(it, it + n):
                     callback(j, {"iteration": j})
             it += n
             if checkpointer is not None and checkpointer.due(it):
+                if valids and not sync_eval:
+                    flush_chunk_evals(host_cnt)
                 ckpt = _materialize(p, data.mapper, out, it * K, init,
                                     max_depth_prev, best_iteration,
                                     best_value, stale)
                 if eval_history is not None:  # carried through from resume
                     ckpt.train_state["eval_history"] = eval_history
                 checkpointer.save(ckpt, it)
-        booster = _materialize(p, data.mapper, out, T, init, max_depth_prev,
-                               best_iteration, best_value, stale)
+            if stop:
+                total_iters = it
+                break
+
+        if valids and not sync_eval:
+            flush_chunk_evals(host_cnt)
+        booster = _materialize(p, data.mapper, out, total_iters * K, init,
+                               max_depth_prev, best_iteration, best_value,
+                               stale)
         if eval_history is not None:
             booster.train_state["eval_history"] = eval_history
         return booster

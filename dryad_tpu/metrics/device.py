@@ -127,8 +127,9 @@ def ndcg_device(y, s, qids, k):
     return jnp.mean(ndcg)
 
 
-@partial(jax.jit, static_argnames=("name", "ndcg_at"))
-def _eval_jit(name, ndcg_at, y, raw_score, qids):
+def eval_value(name, ndcg_at, y, raw_score, qids=None):
+    """Raw (traceable) metric value — shared by the standalone ``_eval_jit``
+    and the chunked trainer, which evaluates INSIDE its device program."""
     s = raw_score
     if s.ndim == 2 and s.shape[1] == 1:
         s = s[:, 0]
@@ -151,6 +152,9 @@ def _eval_jit(name, ndcg_at, y, raw_score, qids):
     if name == "ndcg":
         return ndcg_device(y, s, qids, ndcg_at)
     raise ValueError(f"unknown metric {name!r}")
+
+
+_eval_jit = partial(jax.jit, static_argnames=("name", "ndcg_at"))(eval_value)
 
 
 def make_evaluator(objective: str, metric: str, valid_ds, ndcg_at: int = 10):
@@ -188,6 +192,7 @@ def make_evaluator(objective: str, metric: str, valid_ds, ndcg_at: int = 10):
                     s = s[:, 0]
                 return np.float32(ndcg_at_k(y_np, s, qoff_np, ndcg_at))
 
+            fn_host.host_only = True  # chunked trainer cannot inline this
             return name, HIGHER_BETTER[name], fn_host
         qids = jnp.asarray(_pad_queries(valid_ds.query_offsets)[0])
 
@@ -197,4 +202,10 @@ def make_evaluator(objective: str, metric: str, valid_ds, ndcg_at: int = 10):
     def fn(vscore):
         return _eval_jit(name, ndcg_at, y, vscore, qids)
 
+    # the chunked trainer inlines the metric INSIDE its device program —
+    # expose the pieces eval_value needs
+    fn.host_only = False
+    fn.metric_name = name
+    fn.y_dev = y
+    fn.qids = qids
     return name, HIGHER_BETTER[name], fn

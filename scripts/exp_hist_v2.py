@@ -245,12 +245,16 @@ def main():
         ss + j32(s), N, P, T, rows_bound=bound)[0][0].astype(jnp.float32)
         * 1e-30, sel)
 
+    # perturb SEL in both arms: it feeds the plan sort and every gather,
+    # so no stage is loop-invariant (the kernel weight path in v2 reads g/h
+    # from the records table; perturbing gg there would be dead — CLAUDE.md
+    # methodology requires a true dependency in each trip)
     loop_time("v1 whole (current)", lambda s, X, gg, hh, ss:
               build_hist_segmented_pallas(
-                  X, gg + s, hh, ss, P, B, rows_bound=bound,
+                  X, gg, hh, ss + j32(s), P, B, rows_bound=bound,
                   platform=plat)[0, 0, 0, 0] * 1e-30, Xb, g, h, sel)
     loop_time("v2 whole (records+u8+packed)", lambda s, r, ss:
-              hist_v2(r + j32(s)[None, None] * 0, ss, N, F, P, B,
+              hist_v2(r, ss + j32(s), N, F, P, B,
                       bound)[0, 0, 0, 0] * 1e-30, records, sel)
     loop_time("make_records (per tree, /8 levels)", lambda s, X, gg, hh:
               make_records(X, gg + s, hh)[0, 0].astype(jnp.float32) * 1e-30,

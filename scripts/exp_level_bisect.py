@@ -54,9 +54,11 @@ def make_prog(stage):
 
         def body(d, carry):
             acc, row_slot, hists = carry
-            # synthetic per-level candidate state (data-independent, cheap)
+            # synthetic per-level candidate state; the j32-style opaque
+            # zero keeps a TRUE loop dependency (acc % 1 folds to 0
+            # statically; (acc*1e-30).astype(int32) cannot be folded)
             sj = (jnp.arange(P, dtype=jnp.int32) * 2
-                  + acc.astype(jnp.int32) % 1)
+                  + (acc * 1e-30).astype(jnp.int32))
             do = jnp.ones((P,), bool)
             right_slot = jnp.minimum(sj + 1, L - 1)
 
@@ -67,11 +69,14 @@ def make_prog(stage):
             smallsel = colof[jnp.minimum(row_slot, L)]
 
             if stage == 0:
-                smallsel = jnp.minimum(row_slot % (P + 1), P)
+                smallsel = jnp.minimum(
+                    (row_slot + (acc * 1e-30).astype(jnp.int32)) % (P + 1), P)
 
             # ---- seg hist (always) --------------------------------------
+            # records carries g/h, so perturbing g here would be dead —
+            # smallsel (via sj/acc) carries the loop dependency instead
             hist_small = build_hist_segmented(
-                Xb, g + acc, h, smallsel, P, B,
+                Xb, g, h, smallsel, P, B,
                 rows_per_chunk=p.rows_per_chunk,
                 precision="exact", backend="auto",
                 rows_bound=N // 2 + 1, platform=plat, records=records)
