@@ -63,6 +63,21 @@ def grow_any(params, total_bins, Xb, g, h, bag_mask, feat_mask, is_cat_feat,
             learn_missing=learn_missing, root_hist=root_hist,
             bundled_mask=bundled_mask,
         )
+    if params.growth == "leafwise":
+        from dryad_tpu.engine import leafwise_fast
+
+        if leafwise_fast.supports(params, Xb.shape[1], int(total_bins)):
+            # depth-capped leaf-wise: exact best-first selection over a
+            # level-synchronous full expansion — O(N·depth) instead of the
+            # sequential grower's O(N·leaves) (gains are order-independent,
+            # so the selected tree is the sequential one).  Unbounded depth
+            # (max_depth <= 0) keeps the sequential path below.
+            return leafwise_fast.grow_tree_leafwise_batched(
+                params, total_bins, Xb, g, h, bag_mask, feat_mask,
+                is_cat_feat, has_cat=has_cat, axis_name=axis_name,
+                platform=platform, learn_missing=learn_missing,
+                root_hist=root_hist, bundled_mask=bundled_mask,
+            )
     return grow_tree(
         params, total_bins, Xb, g, h, bag_mask, feat_mask, is_cat_feat,
         has_cat=has_cat, axis_name=axis_name, platform=platform,

@@ -1,0 +1,438 @@
+"""Batched leaf-wise growth: depth-capped full expansion + exact best-first
+selection (SURVEY.md §2 #8 at scale).
+
+The sequential leaf-wise grower (grower.py::grow_tree — the reference's
+one-split-at-a-time control flow) pays one full-N masked histogram pass per
+split: O(N·L) work per tree, ~L/depth times the depthwise cost at 255
+leaves (VERDICT r2 missing #2).  This module removes that asymptotic
+penalty using an exact equivalence:
+
+    Split gains are ORDER-INDEPENDENT.  Splitting leaf A never changes
+    leaf B's rows, histogram, or gain — so the sequential best-first
+    procedure is a deterministic selection over a gain tree whose values
+    do not depend on the order in which it is explored.
+
+Therefore leaf-wise growth with a depth cap D factorizes into:
+
+1. **Expansion** — grow ALL valid splits level-synchronously to depth D
+   (the depthwise machinery: one segmented smaller-children histogram
+   pass per level, subtraction for the larger sibling), recording every
+   node's best split, gain, stats and monotone bounds into a binary-heap
+   table (node 1 = root, children 2n / 2n+1).  Cost: O(N·D) — the same
+   per-level passes the depthwise grower pays.
+2. **Selection** — replay the exact slot-machine sequence of
+   grow_tree on the PRECOMPUTED gains: L-1 trips of argmax over slot
+   gains (first-max tie-break, left child keeps the parent slot, right
+   child takes slot k+1, node ids in execution order).  O(L²) scalar
+   work, microseconds.
+
+The selected tree is identical to the sequential grower's, node ids and
+all, whenever both compute identical gains (they histogram with different
+programs, so near-tie fp flips fall under the documented CPU↔TPU
+tolerance class).  The equivalence needs a finite depth cap: with
+``max_depth`` unset the sequential path remains (an unbounded-depth tree
+cannot be pre-expanded), so ``grow_any`` routes here only for
+``0 < max_depth`` within the expansion memory budget.
+
+Distribution contract matches levelwise.py: call under ``shard_map`` with
+rows sharded; the fused psum inside the histogram builders is the only
+collective; the selection runs replicated-identically on every shard.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from dryad_tpu.config import Params
+from dryad_tpu.engine.grower import (
+    _monotone_array,
+    child_bounds,
+    finalize_leaf_values,
+    pack_cat_bitset,
+    root_stats,
+)
+from dryad_tpu.engine.histogram import build_hist, build_hist_segmented
+from dryad_tpu.engine.split import NEG_INF, find_best_split
+
+_HIST_BYTES_BUDGET = 256 << 20   # pinned expansion hist buffer cap
+_MAX_FAST_DEPTH = 14
+
+
+def supports(p: Params, num_features: int, total_bins: int) -> bool:
+    """Fast leaf-wise needs a finite, memory-feasible expansion depth.
+
+    The budget is checked against the PINNED (Pf, 3, F, B) buffer, but the
+    widest level transiently holds ~5-6x that (hist_small/large/l/r plus
+    the 2P-wide children concat for the vmapped split finder), so the cap
+    is set to keep peak transients under ~1.5 GB.  Configs beyond it keep
+    the sequential grower."""
+    D = p.max_depth
+    if not 0 < D <= _MAX_FAST_DEPTH:
+        return False
+    Pf = 1 << max(D - 1, 0)
+    return Pf * 3 * num_features * total_bins * 4 <= _HIST_BYTES_BUDGET
+
+
+def grow_tree_leafwise_batched(
+    params: Params,
+    total_bins: int,
+    Xb: jnp.ndarray,
+    g: jnp.ndarray,
+    h: jnp.ndarray,
+    bag_mask: jnp.ndarray,
+    feat_mask: jnp.ndarray,
+    is_cat_feat: jnp.ndarray,
+    *,
+    has_cat: bool = False,
+    axis_name: str | None = None,
+    platform: str | None = None,
+    learn_missing: bool = False,
+    root_hist: jnp.ndarray | None = None,
+    bundled_mask: jnp.ndarray | None = None,
+) -> dict[str, Any]:
+    p = params
+    N, F = Xb.shape
+    B = int(total_bins)
+    L = p.effective_num_leaves
+    M = p.max_nodes
+    D = p.max_depth
+    assert 0 < D <= _MAX_FAST_DEPTH
+    HN = 1 << (D + 1)                 # heap slots (1-based; 0 unused)
+    Pf = 1 << max(D - 1, 0)           # widest expansion level
+
+    from dryad_tpu.engine.histogram import resolve_backend
+
+    records = None
+    if resolve_backend(p.hist_backend, segmented=True,
+                       platform=platform) == "pallas":
+        from dryad_tpu.engine import pallas_hist
+
+        if pallas_hist.supports(B):
+            records = pallas_hist.make_records(Xb, g, h)
+
+    mono = _monotone_array(p, F)
+
+    def best(hist, G, H, C, allow, lo, hi):
+        return find_best_split(
+            hist, G, H, C,
+            lambda_l2=p.lambda_l2,
+            min_child_weight=p.min_child_weight,
+            min_data_in_leaf=p.min_data_in_leaf,
+            min_split_gain=p.min_split_gain,
+            feat_mask=feat_mask,
+            is_cat_feat=is_cat_feat,
+            allow=allow,
+            has_cat=has_cat,
+            monotone=mono,
+            lo=lo,
+            hi=hi,
+            learn_missing=learn_missing,
+            bundled_mask=bundled_mask,
+        )
+
+    # ---- root ----------------------------------------------------------------
+    # ALL rows are routed (bag gates histograms only); derived from
+    # bag_mask so the init inherits the shard's varying-manual-axes under
+    # shard_map (a plain constant would make downstream vma types diverge —
+    # same trick as grower.py / levelwise.py)
+    row_node = jnp.where(bag_mask, 1, 1).astype(jnp.int32)
+    hist0 = root_hist if root_hist is not None else build_hist(
+        Xb, g, h, bag_mask, B,
+        rows_per_chunk=p.rows_per_chunk, axis_name=axis_name,
+        precision=p.hist_precision, backend=p.hist_backend,
+        platform=platform)
+    G0, H0, C0 = root_stats(hist0)
+    ninf, pinf = jnp.float32(-jnp.inf), jnp.float32(jnp.inf)
+    root = best(hist0, G0, H0, C0,
+                (jnp.int32(0) < D) & (C0 >= 2 * p.min_data_in_leaf),
+                ninf, pinf)
+    Bc = root.cat_mask.shape[0]
+
+    # heap-node tables (index = heap id; unwritten slots keep the defaults)
+    nd_gain = jnp.full((HN,), NEG_INF, jnp.float32).at[1].set(root.gain)
+    nd_feature = jnp.full((HN,), -1, jnp.int32).at[1].set(root.feature)
+    nd_thresh = jnp.zeros((HN,), jnp.int32).at[1].set(root.threshold)
+    nd_GL = jnp.zeros((HN,), jnp.float32).at[1].set(root.g_left)
+    nd_HL = jnp.zeros((HN,), jnp.float32).at[1].set(root.h_left)
+    nd_CL = jnp.zeros((HN,), jnp.float32).at[1].set(root.c_left)
+    nd_G = jnp.zeros((HN,), jnp.float32).at[1].set(G0)
+    nd_H = jnp.zeros((HN,), jnp.float32).at[1].set(H0)
+    nd_C = jnp.zeros((HN,), jnp.float32).at[1].set(C0)
+    nd_dleft = jnp.ones((HN,), bool).at[1].set(root.default_left)
+    nd_catmask = jnp.zeros((HN, Bc), bool).at[1].set(root.cat_mask)
+    nd_lo = jnp.full((HN,), ninf, jnp.float32)
+    nd_hi = jnp.full((HN,), pinf, jnp.float32)
+
+    hists = jnp.zeros((Pf, 3, F, B), jnp.float32).at[0].set(hist0)
+
+    exp_st = {
+        "row_node": row_node, "hists": hists,
+        "nd_gain": nd_gain, "nd_feature": nd_feature, "nd_thresh": nd_thresh,
+        "nd_GL": nd_GL, "nd_HL": nd_HL, "nd_CL": nd_CL,
+        "nd_G": nd_G, "nd_H": nd_H, "nd_C": nd_C,
+        "nd_dleft": nd_dleft, "nd_catmask": nd_catmask,
+        "nd_lo": nd_lo, "nd_hi": nd_hi,
+    }
+
+    # ---- expansion: every valid split, level-synchronously -------------------
+    def make_level_body(P):
+        def level_body(d, st):
+            base = jnp.left_shift(jnp.int32(1), d)         # level-d heap base
+            W = base                                        # level width
+            jarr = jnp.arange(P, dtype=jnp.int32)
+            idx = jnp.minimum(base + jarr, HN - 1)
+            do = (st["nd_gain"][idx] > NEG_INF) & (jarr < W)
+            sf = st["nd_feature"][idx]
+            thr = st["nd_thresh"][idx]
+            GL, HL, CL = st["nd_GL"][idx], st["nd_HL"][idx], st["nd_CL"][idx]
+            Gp, Hp, Cp = st["nd_G"][idx], st["nd_H"][idx], st["nd_C"][idx]
+            GR, HR, CR = Gp - GL, Hp - HL, Cp - CL
+
+            # ---- partition: a row moves iff its node has a valid split.
+            # Expansion splits EVERY valid-gain node at its level, so a row
+            # can only sit at a valid-gain node when that node is at the
+            # current level — no level check needed.  Same packed-word +
+            # masked-reduce scheme as levelwise.py (measured there).
+            rn = st["row_node"]
+            valid_n = st["nd_gain"] > NEG_INF
+            if B <= (1 << 13):
+                cat_n = (is_cat_feat[jnp.maximum(st["nd_feature"], 0)]
+                         if has_cat else jnp.zeros((HN,), bool))
+                w0_t = ((valid_n.astype(jnp.uint32) << 31)
+                        | (st["nd_dleft"].astype(jnp.uint32) << 30)
+                        | (cat_n.astype(jnp.uint32) << 29)
+                        | (jnp.clip(st["nd_thresh"], 0, B - 1)
+                           .astype(jnp.uint32) << 16))
+                rec_t = jnp.stack(
+                    [w0_t, jnp.maximum(st["nd_feature"], 0).astype(jnp.uint32)],
+                    axis=1)
+                rec_r = rec_t[rn]
+                w0r = rec_r[:, 0]
+                rf = rec_r[:, 1].astype(jnp.int32)
+                row_do = (w0r >> 31) != 0
+                if F <= 256:
+                    iota_f = jnp.arange(F, dtype=jnp.int32)
+                    bins_rf = jnp.max(
+                        jnp.where(rf[:, None] == iota_f[None, :], Xb,
+                                  jnp.zeros((), Xb.dtype)),
+                        axis=1).astype(jnp.int32)
+                else:
+                    bins_rf = jnp.take_along_axis(
+                        Xb, rf[:, None], axis=1)[:, 0].astype(jnp.int32)
+                go_left = bins_rf <= ((w0r >> 16)
+                                      & jnp.uint32(0x1FFF)).astype(jnp.int32)
+                if learn_missing:
+                    go_left &= ((w0r >> 30) & 1).astype(bool) | (bins_rf > 0)
+                if has_cat:
+                    cat_row = st["nd_catmask"][rn, jnp.minimum(bins_rf, Bc - 1)]
+                    go_left = jnp.where(((w0r >> 29) & 1).astype(bool),
+                                        cat_row, go_left)
+            else:
+                row_do = valid_n[rn]
+                rf = jnp.maximum(st["nd_feature"][rn], 0)
+                bins_rf = jnp.take_along_axis(
+                    Xb, rf[:, None].astype(jnp.int32), axis=1)[:, 0]
+                bins_rf = bins_rf.astype(jnp.int32)
+                go_left = bins_rf <= st["nd_thresh"][rn]
+                if learn_missing:
+                    go_left &= st["nd_dleft"][rn] | (bins_rf > 0)
+                if has_cat:
+                    cat_row = st["nd_catmask"][rn, jnp.minimum(bins_rf, Bc - 1)]
+                    go_left = jnp.where(is_cat_feat[rf], cat_row, go_left)
+            row_node = jnp.where(
+                row_do, 2 * rn + jnp.where(go_left, 0, 1), rn)
+
+            # ---- one batched histogram pass for all smaller children -----
+            left_smaller = CL <= CR
+            small_heap = 2 * idx + jnp.where(left_smaller, 0, 1)
+            colof = jnp.full((HN,), P, jnp.int32).at[
+                jnp.where(do, small_heap, HN)].set(jarr, mode="drop")
+            smallsel = jnp.where(bag_mask, colof[row_node], P)
+            bound_ok = axis_name is None and N < (1 << 24)
+            hist_small = build_hist_segmented(
+                Xb, g, h, smallsel, P, B,
+                rows_per_chunk=p.rows_per_chunk, axis_name=axis_name,
+                precision=p.hist_precision, backend=p.hist_backend,
+                rows_bound=(N // 2 + 1) if bound_ok else None,
+                platform=platform, records=records,
+            )
+            hist_large = st["hists"][jnp.minimum(jarr, Pf - 1)] - hist_small
+            ls = left_smaller[:, None, None, None]
+            hist_l = jnp.where(ls, hist_small, hist_large)
+            hist_r = jnp.where(ls, hist_large, hist_small)
+            # children hists land at level-(d+1) offsets 2j / 2j+1; the
+            # final level's children (never split) fall off the buffer and
+            # are dropped
+            hists = st["hists"].at[
+                jnp.where(do, 2 * jarr, Pf)].set(hist_l, mode="drop")
+            hists = hists.at[
+                jnp.where(do, 2 * jarr + 1, Pf)].set(hist_r, mode="drop")
+
+            # ---- children stats + their best splits ----------------------
+            lo_p, hi_p = st["nd_lo"][idx], st["nd_hi"][idx]
+            if mono is not None:
+                lo_l, hi_l, lo_r, hi_r = child_bounds(
+                    mono, sf, GL, HL, GR, HR, jnp.float32(p.lambda_l2),
+                    lo_p, hi_p)
+            else:
+                lo_l = lo_r = lo_p
+                hi_l = hi_r = hi_p
+            ch_heap = jnp.concatenate([2 * idx, 2 * idx + 1])
+            ch_do = jnp.concatenate([do, do])
+            ch_hist = jnp.concatenate([hist_l, hist_r])
+            ch_G = jnp.concatenate([GL, GR])
+            ch_H = jnp.concatenate([HL, HR])
+            ch_C = jnp.concatenate([CL, CR])
+            ch_lo = jnp.concatenate([lo_l, lo_r])
+            ch_hi = jnp.concatenate([hi_l, hi_r])
+            allow = ch_do & (d + 1 < D) & (ch_C >= 2 * p.min_data_in_leaf)
+            res = jax.vmap(best)(ch_hist, ch_G, ch_H, ch_C, allow,
+                                 ch_lo, ch_hi)
+
+            cidx = jnp.where(ch_do, ch_heap, HN)
+            st_new = dict(st)
+            st_new["row_node"] = row_node
+            st_new["hists"] = hists
+            st_new["nd_gain"] = st["nd_gain"].at[cidx].set(res.gain,
+                                                           mode="drop")
+            st_new["nd_feature"] = st["nd_feature"].at[cidx].set(
+                res.feature, mode="drop")
+            st_new["nd_thresh"] = st["nd_thresh"].at[cidx].set(
+                res.threshold, mode="drop")
+            st_new["nd_GL"] = st["nd_GL"].at[cidx].set(res.g_left, mode="drop")
+            st_new["nd_HL"] = st["nd_HL"].at[cidx].set(res.h_left, mode="drop")
+            st_new["nd_CL"] = st["nd_CL"].at[cidx].set(res.c_left, mode="drop")
+            st_new["nd_G"] = st["nd_G"].at[cidx].set(ch_G, mode="drop")
+            st_new["nd_H"] = st["nd_H"].at[cidx].set(ch_H, mode="drop")
+            st_new["nd_C"] = st["nd_C"].at[cidx].set(ch_C, mode="drop")
+            st_new["nd_dleft"] = st["nd_dleft"].at[cidx].set(
+                res.default_left, mode="drop")
+            st_new["nd_catmask"] = st["nd_catmask"].at[cidx].set(
+                res.cat_mask, mode="drop")
+            st_new["nd_lo"] = st["nd_lo"].at[cidx].set(ch_lo, mode="drop")
+            st_new["nd_hi"] = st["nd_hi"].at[cidx].set(ch_hi, mode="drop")
+            return st_new
+        return level_body
+
+    P_narrow = min(8, Pf)
+    d_switch = 4 if (D > 4 and Pf > 8) else D
+    exp_st = jax.lax.fori_loop(0, d_switch, make_level_body(P_narrow), exp_st)
+    if d_switch < D:
+        exp_st = jax.lax.fori_loop(d_switch, D, make_level_body(Pf), exp_st)
+
+    # ---- selection: replay grow_tree's slot machine on the gain tree ---------
+    nd_gain = exp_st["nd_gain"]
+    nd_feature = exp_st["nd_feature"]
+    nd_thresh = exp_st["nd_thresh"]
+    nd_dleft = exp_st["nd_dleft"]
+    nd_catmask = exp_st["nd_catmask"]
+    nd_G, nd_H = exp_st["nd_G"], exp_st["nd_H"]
+    nd_lo, nd_hi = exp_st["nd_lo"], exp_st["nd_hi"]
+
+    sel_st = {
+        "slot_heap": jnp.zeros((L,), jnp.int32).at[0].set(1),
+        "slot_tree": jnp.full((L,), -1, jnp.int32).at[0].set(0),
+        "slot_gain": jnp.full((L,), NEG_INF, jnp.float32).at[0].set(
+            nd_gain[1]),
+        "slot_depth": jnp.zeros((L,), jnp.int32),
+        "feature": jnp.full((M,), -1, jnp.int32),
+        "threshold": jnp.zeros((M,), jnp.int32),
+        "gain": jnp.zeros((M,), jnp.float32),
+        "left": jnp.zeros((M,), jnp.int32),
+        "right": jnp.zeros((M,), jnp.int32),
+        "is_cat": jnp.zeros((M,), bool),
+        "cat_nodes": jnp.zeros((M, Bc), bool),
+        "node_dleft": jnp.ones((M,), bool),
+        "selected": jnp.zeros((HN,), bool),
+        "child_tree": jnp.zeros((HN,), jnp.int32),
+        "num_nodes": jnp.int32(1),
+        "max_depth": jnp.int32(0),
+    }
+
+    def do_split(k, s, st):
+        n = st["slot_heap"][s]
+        parent = st["slot_tree"][s]
+        sf = nd_feature[n]
+        cat_split = is_cat_feat[jnp.maximum(sf, 0)] if has_cat \
+            else jnp.bool_(False)
+        left_id = st["num_nodes"]
+        right_id = left_id + 1
+        depth_c = st["slot_depth"][s] + 1
+        new_r = jnp.int32(k + 1)
+        return {
+            "slot_heap": st["slot_heap"].at[s].set(2 * n)
+                                        .at[new_r].set(2 * n + 1),
+            "slot_tree": st["slot_tree"].at[s].set(left_id)
+                                        .at[new_r].set(right_id),
+            "slot_gain": st["slot_gain"].at[s].set(nd_gain[2 * n])
+                                        .at[new_r].set(nd_gain[2 * n + 1]),
+            "slot_depth": st["slot_depth"].at[s].set(depth_c)
+                                          .at[new_r].set(depth_c),
+            "feature": st["feature"].at[parent].set(sf),
+            "threshold": st["threshold"].at[parent].set(
+                jnp.where(cat_split, 0, nd_thresh[n])),
+            "gain": st["gain"].at[parent].set(st["slot_gain"][s]),
+            "left": st["left"].at[parent].set(left_id),
+            "right": st["right"].at[parent].set(right_id),
+            "is_cat": st["is_cat"].at[parent].set(cat_split),
+            "cat_nodes": st["cat_nodes"].at[parent].set(
+                jnp.where(cat_split, nd_catmask[n],
+                          jnp.zeros((Bc,), bool))),
+            "node_dleft": st["node_dleft"].at[parent].set(
+                nd_dleft[n] | cat_split),
+            "selected": st["selected"].at[n].set(True),
+            "child_tree": st["child_tree"].at[2 * n].set(left_id)
+                                          .at[2 * n + 1].set(right_id),
+            "num_nodes": st["num_nodes"] + 2,
+            "max_depth": jnp.maximum(st["max_depth"], depth_c),
+        }
+
+    def sel_body(k, st):
+        s = jnp.argmax(st["slot_gain"]).astype(jnp.int32)
+        return jax.lax.cond(st["slot_gain"][s] > NEG_INF,
+                            lambda st_: do_split(k, s, st_),
+                            lambda st_: st_, st)
+
+    sel_st = jax.lax.fori_loop(0, L - 1, sel_body, sel_st)
+
+    # ---- finalize -------------------------------------------------------------
+    sh = jnp.clip(sel_st["slot_heap"], 0, HN - 1)
+    value = finalize_leaf_values(
+        p, M, sel_st["slot_tree"], nd_G[sh], nd_H[sh],
+        jnp.zeros((M,), jnp.float32),
+        slot_lo=nd_lo[sh] if mono is not None else None,
+        slot_hi=nd_hi[sh] if mono is not None else None,
+    )
+    cat_bitset = pack_cat_bitset(sel_st["cat_nodes"], M)
+
+    # map every heap node to its leaf in the SELECTED tree: walking down,
+    # a node resolves to its own tree id where its parent was selected,
+    # else inherits the parent's resolution (D static levels)
+    leaf_of = jnp.zeros((HN,), jnp.int32)
+    selected = sel_st["selected"]
+    child_tree = sel_st["child_tree"]
+    idx_all = jnp.arange(HN, dtype=jnp.int32)
+    for d in range(1, D + 1):
+        lvl = (idx_all >> d) == 1
+        par = idx_all >> 1
+        leaf_of = jnp.where(lvl,
+                            jnp.where(selected[par], child_tree[idx_all],
+                                      leaf_of[par]),
+                            leaf_of)
+
+    return {
+        "feature": sel_st["feature"],
+        "threshold": sel_st["threshold"],
+        "left": sel_st["left"],
+        "right": sel_st["right"],
+        "value": value,
+        "gain": sel_st["gain"],
+        "is_cat": sel_st["is_cat"],
+        "cat_bitset": cat_bitset,
+        "default_left": sel_st["node_dleft"],
+        "max_depth": sel_st["max_depth"],
+        "row_leaf": leaf_of[jnp.clip(exp_st["row_node"], 0, HN - 1)],
+    }

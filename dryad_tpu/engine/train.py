@@ -483,26 +483,33 @@ def train_device(
         best_value = init_booster.train_state.get("best_value")
         stale = init_booster.train_state.get("stale", 0)
 
+    def fold_eval_row(it_d, vals):
+        """Fold one eval's values into eval_history + best-iteration state —
+        the ONE bookkeeping used by every deferred replay (per-iteration
+        deferred flush and the chunked path's buffer flush), so the two can
+        never diverge."""
+        nonlocal best_iteration, best_value, stale, eval_history
+        _, higher0, _ = evaluators[0]
+        if eval_history is None:
+            eval_history = {}
+        for vi, ((vname, _), (mname, _, _)) in enumerate(
+                zip(valids, evaluators)):
+            eval_history.setdefault(f"{vname}_{mname}", []).append(
+                [int(it_d), float(vals[vi])])
+        best_iteration, best_value, stale = update_best(
+            best_iteration, best_value, stale, int(it_d), float(vals[0]),
+            higher0)
+
     def flush_deferred():
         """Bulk-fetch pending deferred evals and replay the bookkeeping via
         the shared update_best — called before each due checkpoint and at
         training end, so the deferred path's state is exact wherever it is
         observed while staying fetch-free in between."""
-        nonlocal best_iteration, best_value, stale, eval_history
         if not deferred:
             return
         fetched = jax.device_get([vals for _, vals in deferred])
-        _, higher0, _ = evaluators[0]
-        if eval_history is None:
-            eval_history = {}
         for (it_d, _), vals in zip(deferred, fetched):
-            for vi, ((vname, _), (mname, _, _)) in enumerate(
-                    zip(valids, evaluators)):
-                eval_history.setdefault(f"{vname}_{mname}", []).append(
-                    [it_d, float(vals[vi])])
-            best_iteration, best_value, stale = update_best(
-                best_iteration, best_value, stale, it_d, float(vals[0]),
-                higher0)
+            fold_eval_row(it_d, vals)
         deferred.clear()
 
     # pad rows are bagged out permanently: they must never touch a histogram
@@ -553,7 +560,14 @@ def train_device(
         if p.growth == "depthwise" and p.max_depth > 0:
             passes_est = p.max_depth
         else:
-            passes_est = max(8, p.effective_num_leaves - 1)
+            from dryad_tpu.engine import leafwise_fast
+
+            if (p.growth == "leafwise"
+                    and leafwise_fast.supports(p, F, B)):
+                # batched leaf-wise: one level pass per expansion depth
+                passes_est = p.max_depth
+            else:
+                passes_est = max(8, p.effective_num_leaves - 1)
         est_iter_s = (1.6e-7 * NP * K * passes_est
                       * max(F / 28.0, 1.0) * max(B / 256.0, 1.0))
         # cap-64 validated in the worst regime (est_iter_s ~ 1 s, where the
@@ -603,25 +617,16 @@ def train_device(
 
         def flush_chunk_evals(upto):
             """Fold fetched eval rows [flushed_cnt, upto) into
-            best-iteration state + eval_history (the deferred-path replay,
-            exact wherever it is observed)."""
-            nonlocal best_iteration, best_value, stale, eval_history
+            best-iteration state + eval_history via the shared
+            fold_eval_row (the deferred-path replay, exact wherever it is
+            observed)."""
             nonlocal flushed_cnt
             if upto <= flushed_cnt:
                 return
             vals, its_arr = jax.device_get(
                 (eval_buf[flushed_cnt:upto], eval_its[flushed_cnt:upto]))
-            _, higher0, _ = evaluators[0]
-            if eval_history is None:
-                eval_history = {}
             for row, it_d in zip(np.asarray(vals), np.asarray(its_arr)):
-                for vi, ((vname, _), (mname, _, _)) in enumerate(
-                        zip(valids, evaluators)):
-                    eval_history.setdefault(f"{vname}_{mname}", []).append(
-                        [int(it_d), float(row[vi])])
-                best_iteration, best_value, stale = update_best(
-                    best_iteration, best_value, stale, int(it_d),
-                    float(row[0]), higher0)
+                fold_eval_row(it_d, row)
             flushed_cnt = upto
 
         # per-chunk Philox mask upload buffers (fixed CH0 rows: a varying

@@ -78,7 +78,11 @@ def epsilon():
 def mslr():
     X, y, group = mslr_like(num_queries=_n(3000) // 3, seed=17)
     ds = dryad.Dataset(X, y, group=group)
-    p = dict(objective="lambdarank", num_trees=50, num_leaves=31)
+    # max_depth set -> the batched leaf-wise grower (exact best-first
+    # selection over a depth-capped expansion) replaces the sequential
+    # O(N·leaves) slot machine
+    p = dict(objective="lambdarank", num_trees=50, num_leaves=31,
+             max_depth=10)
     b = dryad.train(p, ds, backend="tpu")
     qoff = np.concatenate([[0], np.cumsum(group)])
     scores = b.predict_binned(ds.X_binned, raw_score=True)

@@ -85,7 +85,34 @@ def smoke_pallas_u16_and_records():
     print("pallas u16 tiles + records path: lower and agree on device")
 
 
+def smoke_pallas_wide_segment_count():
+    """The batched leaf-wise expansion histograms up to P = 2^(D-1)
+    segments (8192 at the depth-14 cap) — lower the widest grid on the
+    real device once."""
+    import jax
+    import jax.numpy as jnp
+
+    from dryad_tpu.engine.histogram import build_hist_segmented
+
+    if jax.devices()[0].platform == "cpu":
+        print("pallas wide-P: skipped (no accelerator attached)")
+        return
+    rng = np.random.default_rng(67)
+    N, F, B, P = 400_000, 8, 64, 8192
+    Xb = jnp.asarray(rng.integers(0, B, size=(N, F), dtype=np.uint8))
+    g = jnp.asarray(rng.normal(size=N).astype(np.float32))
+    h = jnp.asarray(rng.uniform(0.1, 1, N).astype(np.float32))
+    sel = jnp.asarray(rng.integers(0, P + 1, N).astype(np.int32))
+    got = np.asarray(build_hist_segmented(Xb, g, h, sel, P, B,
+                                          backend="pallas"))
+    want = np.asarray(build_hist_segmented(Xb, g, h, sel, P, B,
+                                           backend="xla"))
+    np.testing.assert_allclose(got, want, atol=2e-2, rtol=2e-5)
+    print(f"pallas segmented P={P}: lowers and agrees on device")
+
+
 if __name__ == "__main__":
     smoke_shared_vs_per_class()
     smoke_pallas_vs_xla()
     smoke_pallas_u16_and_records()
+    smoke_pallas_wide_segment_count()

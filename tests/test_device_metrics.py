@@ -195,15 +195,9 @@ def test_chunked_valid_eval_matches_per_iteration_values():
     hist = b.train_state["eval_history"]["valid_auc"]
     assert [it for it, _ in hist] == list(range(8))
 
-    # sync (per-iteration dispatch): a callback forces the fetch path
+    # sync path: a callback forces the per-eval fetch with the same model
     seen = {}
-    params2 = make_params(dict(objective="binary", num_trees=8,
-                               num_leaves=15, max_depth=4,
-                               growth="depthwise", boosting="goss"))
-    # GOSS is never chunkable -> guaranteed per-iteration path, but it
-    # changes the model; instead reuse gbdt and force sync via callback
-    params2 = params2.replace(boosting="gbdt")
-    train_device(params2, tr, valid=va,
+    train_device(params, tr, valid=va,
                  callback=lambda it, info: seen.update(
                      {it: info.get("valid_auc")}))
     for it, v in hist:

@@ -1,0 +1,103 @@
+"""Batched (expansion+selection) leaf-wise grower vs the sequential slot
+machine: identical trees, node numbering included.
+
+Gains are order-independent, so the batched grower must reproduce the
+sequential one EXACTLY whenever both see the same histogram values; these
+fixtures are tie-free so fp noise cannot flip argmaxes.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from dryad_tpu.config import make_params
+from dryad_tpu.engine.grower import grow_any, grow_tree
+from dryad_tpu.engine.leafwise_fast import (
+    grow_tree_leafwise_batched,
+    supports,
+)
+
+
+def _fixture(n=20_000, f=8, b=32, seed=3, cat=False):
+    rng = np.random.default_rng(seed)
+    Xb = jnp.asarray(rng.integers(1, b, size=(n, f), dtype=np.uint8))
+    yv = rng.normal(size=n)
+    g = jnp.asarray((yv + rng.normal(size=n) * 0.1).astype(np.float32))
+    h = jnp.asarray(rng.uniform(0.5, 1.5, size=n).astype(np.float32))
+    bag = jnp.asarray(rng.random(n) < 0.85)
+    fmask = jnp.ones((f,), bool)
+    iscat = jnp.zeros((f,), bool)
+    if cat:
+        iscat = iscat.at[0].set(True).at[3].set(True)
+    return Xb, g, h, bag, fmask, iscat
+
+
+def _assert_same_tree(seq, bat):
+    for key in ("feature", "threshold", "left", "right", "default_left",
+                "is_cat", "cat_bitset"):
+        np.testing.assert_array_equal(np.asarray(seq[key]),
+                                      np.asarray(bat[key]), err_msg=key)
+    # leaf stats ride different histogram programs (masked XLA pass vs
+    # segmented tiles) -> ulp-level value differences; structure is exact
+    np.testing.assert_allclose(np.asarray(seq["value"]),
+                               np.asarray(bat["value"]), rtol=1e-4,
+                               atol=2e-6)
+    np.testing.assert_array_equal(np.asarray(seq["row_leaf"]),
+                                  np.asarray(bat["row_leaf"]))
+    assert int(seq["max_depth"]) == int(bat["max_depth"])
+
+
+@pytest.mark.parametrize("leaves,depth,lm", [(31, 5, False), (15, 8, False),
+                                             (63, 6, True)])
+def test_batched_equals_sequential(leaves, depth, lm):
+    Xb, g, h, bag, fmask, iscat = _fixture()
+    p = make_params(dict(objective="l2", num_leaves=leaves, max_depth=depth,
+                         growth="leafwise", min_data_in_leaf=20))
+    seq = grow_tree(p, 32, Xb, g, h, bag, fmask, iscat, learn_missing=lm)
+    bat = grow_tree_leafwise_batched(p, 32, Xb, g, h, bag, fmask, iscat,
+                                     learn_missing=lm)
+    _assert_same_tree(seq, bat)
+
+
+def test_batched_equals_sequential_categorical():
+    Xb, g, h, bag, fmask, iscat = _fixture(cat=True)
+    p = make_params(dict(objective="l2", num_leaves=31, max_depth=6,
+                         growth="leafwise", min_data_in_leaf=20))
+    seq = grow_tree(p, 32, Xb, g, h, bag, fmask, iscat, has_cat=True)
+    bat = grow_tree_leafwise_batched(p, 32, Xb, g, h, bag, fmask, iscat,
+                                     has_cat=True)
+    _assert_same_tree(seq, bat)
+
+
+def test_batched_equals_sequential_monotone():
+    Xb, g, h, bag, fmask, iscat = _fixture()
+    p = make_params(dict(objective="l2", num_leaves=31, max_depth=6,
+                         growth="leafwise", min_data_in_leaf=20,
+                         monotone_constraints=[1, 0, -1, 0, 0, 0, 0, 0]))
+    seq = grow_tree(p, 32, Xb, g, h, bag, fmask, iscat)
+    bat = grow_tree_leafwise_batched(p, 32, Xb, g, h, bag, fmask, iscat)
+    _assert_same_tree(seq, bat)
+
+
+def test_grow_any_routes_by_depth():
+    """max_depth set -> batched path; unset (-1) -> sequential (an unbounded
+    tree cannot be pre-expanded)."""
+    p_fast = make_params(dict(objective="l2", num_leaves=31, max_depth=6,
+                              growth="leafwise"))
+    p_seq = make_params(dict(objective="l2", num_leaves=31,
+                             growth="leafwise"))
+    assert supports(p_fast, 8, 32)
+    assert not supports(p_seq, 8, 32)
+    # huge expansion exceeds the hist-buffer budget -> sequential
+    p_wide = make_params(dict(objective="l2", num_leaves=31, max_depth=14,
+                              growth="leafwise"))
+    assert not supports(p_wide, 2000, 256)
+    # the routed result matches the sequential grower
+    Xb, g, h, bag, fmask, iscat = _fixture(n=5000)
+    seq = grow_tree(p_fast, 32, Xb, g, h, bag, fmask, iscat)
+    routed = grow_any(p_fast, 32, Xb, g, h, bag, fmask, iscat)
+    routed.pop("row_leaf")
+    for key in ("feature", "threshold", "left", "right"):
+        np.testing.assert_array_equal(np.asarray(seq[key]),
+                                      np.asarray(routed[key]))
