@@ -20,7 +20,9 @@ Bundle encoding (bundle members f_1..f_m with bin counts n_1..n_m):
 
 Missing values (member bin 0) encode at offset_k + 0, so a bundled column's
 bin 0 never means "missing" — bundled columns are excluded from the
-missing-direction machinery (Dataset.has_missing).
+missing-direction machinery (Dataset.has_missing).  Categorical columns
+bundle with other categoricals only; the bundle column is categorical and
+node bitsets address its offset-stacked bins (see plan_bundles).
 
 Bundling runs automatically on the in-memory CSR ingest path
 (``Dataset(csr=..., bundle=True)``, the default).  The out-of-core
@@ -60,8 +62,16 @@ def plan_bundles(
 ) -> list[list[int]]:
     """Greedy strict-exclusive bundling plan -> member-id lists (len >= 2).
 
-    A feature is eligible when it is numerical and its default (zero-value)
-    bin covers >= ``min_default_frac`` of rows.  Exclusivity is planned on
+    A feature is eligible when its default (zero-value) bin covers >=
+    ``min_default_frac`` of rows.  Categorical columns bundle too (criteo-
+    style data is CATEGORICAL-sparse), but only with other categoricals:
+    the bundle column is then itself categorical, and the sorted-subset
+    scan over its offset-stacked bins expresses any union of per-member
+    category subsets (each member keeps its own bin range).  Mixing kinds
+    is never planned — a numeric member inside a categorical bundle would
+    lose its ordering under subset splits.  Categorical bundles are capped
+    at 255 bins so node bitsets (CAT_WORDS = 8 words) always cover them.
+    Exclusivity is planned on
     a deterministic row prefix of up to ``sample_rows`` rows using sorted
     nonzero-row-index intersection (O(nnz log nnz) per attempt — dense
     (N,) bool masks would make wide-sparse ingest quadratic in bytes),
@@ -79,14 +89,17 @@ def plan_bundles(
 
     bundles: list[dict] = []
     for f in range(F):
-        if is_cat[f]:
-            continue
         nz_idx = np.flatnonzero(Xb[:S, f] != zb[f]).astype(np.int64)
         if nz_idx.size > (1.0 - min_default_frac) * S:
             continue
+        kind_cat = bool(is_cat[f])
+        # categorical bundles must fit the (CAT_WORDS * 32)-bit node bitset
+        cap = min(max_bins - 1, 255) if kind_cat else max_bins - 1
         placed = False
         for bd in bundles[:max_scan]:
-            if bd["bins"] + int(n_bins[f]) > max_bins - 1:
+            if bd["cat"] != kind_cat:
+                continue
+            if bd["bins"] + int(n_bins[f]) > cap:
                 continue
             if _conflicts(bd["idx"], nz_idx):
                 continue
@@ -97,7 +110,7 @@ def plan_bundles(
             break
         if not placed:
             bundles.append({"members": [f], "idx": nz_idx,
-                            "bins": int(n_bins[f])})
+                            "bins": int(n_bins[f]), "cat": kind_cat})
 
     plan = [bd["members"] for bd in bundles if len(bd["members"]) >= 2]
     if S == N:
@@ -214,9 +227,13 @@ class BundledMapper:
 
     @property
     def is_categorical(self) -> np.ndarray:
+        # a bundle of categorical members is itself categorical (members
+        # are never mixed-kind — plan_bundles); its subset splits address
+        # the offset-stacked bin space
         base_cat = self.base.is_categorical
-        return np.array([False] * len(self.bundles)
-                        + [bool(base_cat[f]) for f in self.rest], bool)
+        return np.array(
+            [bool(base_cat[m[0]]) for m in self.bundles]
+            + [bool(base_cat[f]) for f in self.rest], bool)
 
     def transform(self, X: np.ndarray) -> np.ndarray:
         from dryad_tpu.data.binning import bin_matrix

@@ -105,3 +105,150 @@ def dataset_from_chunks(
         Xb, mapper, y, weight=weight, group=group,
         categorical_features=categorical_features,
     )
+
+
+def sketch_stream_csr(
+    chunks: Callable[[], Iterable[tuple]],
+    total_rows: int,
+    num_features: int,
+    *,
+    max_bins: int = 256,
+    categorical_features: Sequence[int] = (),
+    sample_rows: int = 1 << 20,
+    seed: int = 0,
+) -> BinMapper:
+    """Frozen BinMapper from one pass over CSR chunks ``(indptr, indices,
+    values)`` (indptr chunk-local).  Only the keyed row subsample is ever
+    densified, so the float table never materializes."""
+    rate = min(1.0, sample_rows / max(total_rows, 1))
+    parts: list[np.ndarray] = []
+    offset = 0
+    for indptr, indices, values in chunks():
+        n = len(indptr) - 1
+        keep = np.flatnonzero(_keyed_uniform(offset, n, seed) < rate)
+        dense = np.zeros((keep.size, num_features), np.float32)
+        for j, r in enumerate(keep):
+            a, b = int(indptr[r]), int(indptr[r + 1])
+            dense[j, indices[a:b]] = values[a:b]
+        parts.append(dense)
+        offset += n
+    if offset != total_rows:
+        raise ValueError(f"stream yielded {offset} rows, expected {total_rows}")
+    sample = np.concatenate(parts, axis=0)
+    return sketch_features(sample, max_bins=max_bins,
+                           categorical_features=categorical_features)
+
+
+def dataset_from_csr_chunks(
+    chunks: Callable[[], Iterable[tuple]],
+    y: np.ndarray,
+    total_rows: int,
+    num_features: int,
+    *,
+    weight: Optional[np.ndarray] = None,
+    group: Optional[np.ndarray] = None,
+    categorical_features: Sequence[int] = (),
+    max_bins: int = 256,
+    mapper: Optional[BinMapper] = None,
+    sample_rows: int = 1 << 20,
+    seed: int = 0,
+    bundle: bool = True,
+    plan_rows: int = 1 << 20,
+):
+    """Out-of-core sparse ingest WITH exclusive feature bundling — the
+    Criteo-1TB composition (SURVEY.md §7 hard part e; BASELINE.json:11):
+    CSR chunk stream -> streamed sketch -> EFB plan on a row prefix ->
+    streaming exclusivity verification -> chunkwise fold into the final
+    bundled matrix.  Nothing bigger than (total_rows, F_bundled) bins plus
+    one chunk's temporaries is ever resident.
+
+    ``chunks`` is a restartable factory yielding chunk-local CSR triples
+    ``(indptr, indices, values)``; it is iterated up to four times (sketch
+    — skipped when ``mapper`` is given, e.g. from
+    ``distributed.sketch_distributed`` —, prefix plan, verification, fold).
+
+    The bundling plan is greedy on the first ``plan_rows`` rows, then
+    verified EXACTLY over the full stream: one pass accumulates each
+    bundle's pairwise member-conflict matrix (two members non-default in
+    the same row, any chunk), and the same greedy eviction
+    ``plan_bundles`` runs in memory replays on the accumulated matrix —
+    so every emitted bundle is strictly exclusive end to end and the fold
+    drops nothing (bit-identical to in-memory ingest of the same rows).
+    """
+    from dryad_tpu.data.binning import bin_csr, zero_bins
+    from dryad_tpu.data.bundling import BundledMapper, plan_bundles
+    from dryad_tpu.dataset import Dataset
+
+    if mapper is None:
+        mapper = sketch_stream_csr(
+            chunks, total_rows, num_features, max_bins=max_bins,
+            categorical_features=categorical_features,
+            sample_rows=sample_rows, seed=seed,
+        )
+
+    def bin_chunk(indptr, indices, values):
+        return bin_csr(np.asarray(indptr, np.int64),
+                       np.asarray(indices, np.int64),
+                       np.asarray(values, np.float32),
+                       num_features, mapper)
+
+    plan: list[list[int]] = []
+    if bundle:
+        # ---- plan on a prefix ------------------------------------------
+        prefix: list[np.ndarray] = []
+        got = 0
+        for triple in chunks():
+            prefix.append(bin_chunk(*triple))
+            got += prefix[-1].shape[0]
+            if got >= min(plan_rows, total_rows):
+                break
+        Xb_prefix = np.concatenate(prefix, axis=0)[:plan_rows]
+        del prefix
+        plan = plan_bundles(Xb_prefix, mapper, max_bins,
+                            sample_rows=plan_rows)
+        del Xb_prefix
+
+    if plan:
+        # ---- streaming exclusivity verification ------------------------
+        zb = zero_bins(mapper)
+        mats = [np.zeros((len(m), len(m)), np.int64) for m in plan]
+        for triple in chunks():
+            Xb0 = bin_chunk(*triple)
+            for bi, members in enumerate(plan):
+                nz = (Xb0[:, members] != zb[members][None, :])
+                mats[bi] += nz.T.astype(np.int64) @ nz.astype(np.int64)
+        verified: list[list[int]] = []
+        for members, mat in zip(plan, mats):
+            kept_pos: list[int] = []
+            for i in range(len(members)):
+                if any(mat[i, j] for j in kept_pos):
+                    continue  # conflicts with an earlier kept member
+                kept_pos.append(i)
+            if len(kept_pos) >= 2:
+                verified.append([members[i] for i in kept_pos])
+        plan = verified
+
+    if plan:
+        bm = BundledMapper(mapper, plan)
+        Xb = np.empty((total_rows, bm.num_features), bm.bin_dtype)
+        offset = 0
+        for triple in chunks():
+            folded = bm.fold(bin_chunk(*triple))
+            Xb[offset:offset + folded.shape[0]] = folded
+            offset += folded.shape[0]
+        out_mapper = bm
+    else:
+        Xb = np.empty((total_rows, num_features), mapper.bin_dtype)
+        offset = 0
+        for triple in chunks():
+            binned = bin_chunk(*triple)
+            Xb[offset:offset + binned.shape[0]] = binned
+            offset += binned.shape[0]
+        out_mapper = mapper
+    if offset != total_rows:
+        raise ValueError(f"stream yielded {offset} rows, expected {total_rows}")
+
+    return Dataset.from_binned(
+        Xb, out_mapper, y, weight=weight, group=group,
+        categorical_features=categorical_features,
+    )

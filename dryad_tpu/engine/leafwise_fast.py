@@ -72,6 +72,12 @@ def supports(p: Params, num_features: int, total_bins: int) -> bool:
     D = p.max_depth
     if not 0 < D <= _MAX_FAST_DEPTH:
         return False
+    if not p.hist_subtraction:
+        # the expansion derives every larger sibling by subtraction; a
+        # config that disables subtraction (fp-exactness knob honored by
+        # grower.py / levelwise.py / cpu/trainer.py) must keep the
+        # sequential program or near-tie gains could flip vs the CPU oracle
+        return False
     Pf = 1 << max(D - 1, 0)
     return Pf * 3 * num_features * total_bins * 4 <= _HIST_BYTES_BUDGET
 

@@ -267,3 +267,71 @@ def test_split_finders_mask_bundled_from_missing_right_unit():
     assert not bool(free_d.default_left)
     assert bool(masked_d.default_left)
     assert int(masked_d.threshold) == int(masked.threshold)
+
+
+def _sparse_cat_csr(n=8000, groups=4, per_group=6, levels=6, num_dense=3,
+                    seed=77):
+    """Mutually-exclusive sparse CATEGORICAL columns (one active column per
+    group per row, multi-level category values) + dense numeric, CSR."""
+    rng = np.random.default_rng(seed)
+    num_cat = groups * per_group
+    F = num_cat + num_dense
+    present = np.zeros((n, F), bool)
+    for gi in range(groups):
+        choice = rng.integers(0, per_group, size=n)
+        present[np.arange(n), gi * per_group + choice] = True
+    present[:, num_cat:] = True
+    vals = np.zeros((n, F), np.float32)
+    vals[:, :num_cat] = rng.integers(1, levels, size=(n, num_cat))
+    vals[:, num_cat:] = rng.normal(size=(n, num_dense))
+    w = rng.normal(size=num_cat)
+    logit = (vals[:, :num_cat] * present[:, :num_cat]) @ w * 0.3 \
+        + vals[:, num_cat] * 0.5
+    y = (rng.uniform(size=n) < 1 / (1 + np.exp(-logit))).astype(np.float32)
+    rows, cols = np.nonzero(present)
+    values = vals[rows, cols]
+    counts = np.bincount(rows, minlength=n)
+    indptr = np.concatenate([[0], np.cumsum(counts)]).astype(np.int64)
+    return (indptr, cols.astype(np.int64), values.astype(np.float32), F), y, \
+        tuple(range(num_cat))
+
+
+def test_categorical_bundling_end_to_end():
+    """Sparse categorical columns bundle (with other categoricals only),
+    the bundle column is itself categorical, subset splits address the
+    offset-stacked bins, and CPU/TPU grow identical trees on it."""
+    csr, y, cat_ids = _sparse_cat_csr()
+    ds = dryad.Dataset(None, y, csr=csr, max_bins=64,
+                       categorical_features=cat_ids)
+    bm = ds.mapper
+    assert isinstance(bm, BundledMapper)
+    base_cat = bm.base.is_categorical
+    cat_bundles = [m for m in bm.bundles if base_cat[m[0]]]
+    assert cat_bundles, "sparse categorical columns must bundle"
+    for m in bm.bundles:  # never mixed-kind
+        kinds = {bool(base_cat[f]) for f in m}
+        assert len(kinds) == 1
+    # bundle columns inherit their members' kind
+    for bi, m in enumerate(bm.bundles):
+        assert bool(bm.is_categorical[bi]) == bool(base_cat[m[0]])
+
+    params = dict(objective="binary", num_trees=10, num_leaves=15,
+                  max_bins=64,
+                  categorical_features=list(range(ds.num_features)))
+    # categorical_features param is mapper-driven here; train from binned
+    p2 = dict(objective="binary", num_trees=10, num_leaves=15, max_bins=64)
+    bc = dryad.train(p2, ds, backend="cpu")
+    bt = dryad.train(p2, ds, backend="tpu")
+    np.testing.assert_array_equal(bc.feature, bt.feature)
+    np.testing.assert_array_equal(bc.cat_bitset, bt.cat_bitset)
+    # subset splits actually used the bundled categorical columns
+    used = set(bc.feature[bc.is_cat].tolist())
+    assert any(f < len(bm.bundles) and bm.is_categorical[f] for f in used), \
+        "no subset split landed on a categorical bundle"
+    a = auc(y, bc.predict_binned(ds.X_binned))
+    assert a > 0.62, a
+
+    # serialization keeps the plan and the categorical marking
+    bm2 = BundledMapper.from_bytes(bm.to_bytes())
+    np.testing.assert_array_equal(bm.is_categorical, bm2.is_categorical)
+    assert bm2.bundles == bm.bundles
