@@ -108,9 +108,17 @@ def main() -> None:
 
         t2 = warm_wall(2)
         t8 = warm_wall(8)
+        if t8 - t2 < 0.5:
+            # a tunnel stall in either timed run poisons the delta —
+            # re-measure the pair once (programs are compiled by now);
+            # stalls only ADD time, so keep the min of each
+            t2 = min(t2, warm_wall(2))
+            t8 = min(t8, warm_wall(8))
         marginal = max((t8 - t2) / 6.0, 1e-9)
         out["iters_per_sec_10m"] = round(1.0 / marginal, 4)
         out["marginal_s_per_iter_10m"] = round(marginal, 3)
+        out["wall_2tree_10m"] = round(t2, 2)
+        out["wall_8tree_10m"] = round(t8, 2)
         out["rows_10m"] = 10_000_000
 
     print(json.dumps(out))

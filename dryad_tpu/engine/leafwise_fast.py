@@ -112,12 +112,21 @@ def grow_tree_leafwise_batched(
     from dryad_tpu.engine.histogram import resolve_backend
 
     records = None
+    nat_tiles = None
     if resolve_backend(p.hist_backend, segmented=True,
                        platform=platform) == "pallas":
         from dryad_tpu.engine import pallas_hist
 
         if pallas_hist.supports(B):
             records = pallas_hist.make_records(Xb, g, h)
+            # shallow-level natural-order pass, gated on the GLOBAL
+            # matrix size (pallas_hist.maybe_natural_tiles documents why)
+            nat_tiles = pallas_hist.maybe_natural_tiles(Xb, B, axis_name)
+
+    def _nat_slots():
+        from dryad_tpu.engine import pallas_hist
+
+        return pallas_hist._NAT_SLOTS
 
     mono = _monotone_array(p, F)
 
@@ -184,7 +193,7 @@ def grow_tree_leafwise_batched(
     }
 
     # ---- expansion: every valid split, level-synchronously -------------------
-    def make_level_body(P):
+    def make_level_body(P, use_nat=False):
         def level_body(d, st):
             base = jnp.left_shift(jnp.int32(1), d)         # level-d heap base
             W = base                                        # level width
@@ -258,13 +267,20 @@ def grow_tree_leafwise_batched(
                 jnp.where(do, small_heap, HN)].set(jarr, mode="drop")
             smallsel = jnp.where(bag_mask, colof[row_node], P)
             bound_ok = axis_name is None and N < (1 << 24)
-            hist_small = build_hist_segmented(
-                Xb, g, h, smallsel, P, B,
-                rows_per_chunk=p.rows_per_chunk, axis_name=axis_name,
-                precision=p.hist_precision, backend=p.hist_backend,
-                rows_bound=(N // 2 + 1) if bound_ok else None,
-                platform=platform, records=records,
-            )
+            if use_nat:
+                from dryad_tpu.engine import pallas_hist
+
+                hist_small = pallas_hist.build_hist_small(
+                    nat_tiles, g, h, smallsel, P, B, F,
+                    axis_name=axis_name, platform=platform)
+            else:
+                hist_small = build_hist_segmented(
+                    Xb, g, h, smallsel, P, B,
+                    rows_per_chunk=p.rows_per_chunk, axis_name=axis_name,
+                    precision=p.hist_precision, backend=p.hist_backend,
+                    rows_bound=(N // 2 + 1) if bound_ok else None,
+                    platform=platform, records=records,
+                )
             hist_large = st["hists"][jnp.minimum(jarr, Pf - 1)] - hist_small
             ls = left_smaller[:, None, None, None]
             hist_l = jnp.where(ls, hist_small, hist_large)
@@ -325,9 +341,18 @@ def grow_tree_leafwise_batched(
 
     P_narrow = min(8, Pf)
     d_switch = 4 if (D > 4 and Pf > 8) else D
-    exp_st = jax.lax.fori_loop(0, d_switch, make_level_body(P_narrow), exp_st)
+    exp_st = jax.lax.fori_loop(
+        0, d_switch,
+        make_level_body(P_narrow,
+                        use_nat=nat_tiles is not None
+                        and P_narrow <= _nat_slots()),
+        exp_st)
     if d_switch < D:
-        exp_st = jax.lax.fori_loop(d_switch, D, make_level_body(Pf), exp_st)
+        exp_st = jax.lax.fori_loop(
+            d_switch, D,
+            make_level_body(Pf, use_nat=nat_tiles is not None
+                            and Pf <= _nat_slots()),
+            exp_st)
 
     # ---- selection: replay grow_tree's slot machine on the gain tree ---------
     nd_gain = exp_st["nd_gain"]

@@ -74,12 +74,21 @@ def grow_tree_levelwise(
     from dryad_tpu.engine.histogram import resolve_backend
 
     records = None
+    nat_tiles = None
     if resolve_backend(p.hist_backend, segmented=True,
                        platform=platform) == "pallas":
         from dryad_tpu.engine import pallas_hist
 
         if pallas_hist.supports(B):
             records = pallas_hist.make_records(Xb, g, h)
+            # shallow-level natural-order pass, gated on the GLOBAL
+            # matrix size (pallas_hist.maybe_natural_tiles documents why)
+            nat_tiles = pallas_hist.maybe_natural_tiles(Xb, B, axis_name)
+
+    def pallas_hist_NAT_SLOTS():
+        from dryad_tpu.engine import pallas_hist
+
+        return pallas_hist._NAT_SLOTS
 
     from dryad_tpu.engine.grower import _monotone_array
 
@@ -175,7 +184,7 @@ def grow_tree_levelwise(
         "num_nodes": num_nodes,
         "splits_done": splits_done, "max_depth": max_depth,
     }
-    def make_level_body(P):
+    def make_level_body(P, use_nat=False):
         def level_body(d, st):
             (row_slot, slot_node, slot_gain, slot_G, slot_H, slot_C, slot_depth,
              slot_lo, slot_hi,
@@ -327,13 +336,20 @@ def grow_tree_levelwise(
             # no bound applies there; ditto above 2^24 rows, where the fp32
             # histogram counts backing the smaller-child choice stop being exact.
             bound_ok = axis_name is None and N < (1 << 24)
-            hist_small = build_hist_segmented(
-                Xb, g, h, smallsel, P, B,
-                rows_per_chunk=p.rows_per_chunk, axis_name=axis_name,
-                precision=p.hist_precision, backend=p.hist_backend,
-                rows_bound=(N // 2 + 1) if bound_ok else None,
-                platform=platform, records=records,
-            )
+            if use_nat:
+                from dryad_tpu.engine import pallas_hist
+
+                hist_small = pallas_hist.build_hist_small(
+                    nat_tiles, g, h, smallsel, P, B, F,
+                    axis_name=axis_name, platform=platform)
+            else:
+                hist_small = build_hist_segmented(
+                    Xb, g, h, smallsel, P, B,
+                    rows_per_chunk=p.rows_per_chunk, axis_name=axis_name,
+                    precision=p.hist_precision, backend=p.hist_backend,
+                    rows_bound=(N // 2 + 1) if bound_ok else None,
+                    platform=platform, records=records,
+                )
             if p.hist_subtraction:
                 hist_large = hists[sj] - hist_small
             else:
@@ -412,9 +428,19 @@ def grow_tree_levelwise(
             }
         return level_body
 
-    st = jax.lax.fori_loop(0, d_switch, make_level_body(P_narrow), st)
+    st = jax.lax.fori_loop(
+        0, d_switch,
+        make_level_body(P_narrow,
+                        use_nat=nat_tiles is not None
+                        and P_narrow <= pallas_hist_NAT_SLOTS()),
+        st)
     if d_switch < depth_cap:
-        st = jax.lax.fori_loop(d_switch, depth_cap, make_level_body(P_full), st)
+        st = jax.lax.fori_loop(
+            d_switch, depth_cap,
+            make_level_body(P_full,
+                            use_nat=nat_tiles is not None
+                            and P_full <= pallas_hist_NAT_SLOTS()),
+            st)
 
     # ---- finalize leaf values + node bitsets (shared helpers) ----------------
     value = finalize_leaf_values(

@@ -492,3 +492,147 @@ def build_hist_segmented_pallas(
         Xb, g, h, buf, tile_leaf, tile_first, num_cols, total_bins,
         axis_name=axis_name, platform=platform, records=records,
     )
+
+# ---------------------------------------------------------------------------
+# natural-order multi-slot pass (shallow levels: <= 16 slots)
+# ---------------------------------------------------------------------------
+_NAT_SLOTS = 16
+_NAT_DROP = 31        # sel sentinel (any value >= _NAT_SLOTS drops the row)
+
+
+def maybe_natural_tiles(Xb: jnp.ndarray, total_bins: int,
+                        axis_name: str | None = None):
+    """natural_tiles when the GLOBAL matrix is small enough, else None.
+
+    The gate must see the global size: under shard_map Xb is the local
+    shard, and gating per-shard would let 1-shard and N-shard runs of the
+    same data take different histogram programs (near-tie argmaxes could
+    flip — the CLAUDE.md same-program rule) and would re-admit the 10M
+    configuration measured to regress the chunked train marginal 2x
+    (buffer pressure in the big program; see levelwise.py).  psum of a
+    constant folds to axis_size at trace time, so the check stays static.
+    """
+    n_shards = int(jax.lax.psum(1, axis_name)) if axis_name else 1
+    N, F = Xb.shape
+    if N * n_shards * F * Xb.dtype.itemsize > (128 << 20):
+        return None
+    return natural_tiles(Xb, total_bins)
+
+
+def build_hist_small(nat_tiles, g, h, sel, num_cols: int, total_bins: int,
+                     num_features: int, *, axis_name: str | None = None,
+                     platform: str | None = None) -> jnp.ndarray:
+    """(P, 3, F, B) via the natural-order pass: owns the drop-sentinel
+    mapping (callers use sel == P for "drop") and the slot-budget check."""
+    P = int(num_cols)
+    assert P <= _NAT_SLOTS, "natural-order pass holds at most 16 slots"
+    sel_nat = jnp.where(sel >= P, _NAT_DROP, sel)
+    return build_hist_nat(nat_tiles, g, h, sel_nat,
+                          total_bins=int(total_bins),
+                          num_features=int(num_features),
+                          axis_name=axis_name, platform=platform)[:P]
+
+
+def natural_tiles(Xb: jnp.ndarray, total_bins: int) -> jnp.ndarray:
+    """Feature-chunked tiles of the WHOLE matrix in natural row order — a
+    pure function of (Xb, bins), so the level-synchronous growers build it
+    once per tree and every shallow level reuses it (no sort, no gather)."""
+    N = Xb.shape[0]
+    T = _TILE_ROWS
+    pad = (-N) % T
+    Xp = jnp.pad(Xb, ((0, pad), (0, 0)))
+    return _tiles_from_rows(Xp, (N + pad) // T, T, total_bins)
+
+
+def _nat_kernel(x_ref, w_ref, o_ref, *, padded_bins: int):
+    """All (<=16) slots' histograms in ONE natural-order pass: slot s owns
+    weight rows 8s..8s+6 of the 128-row MXU tile (16 x 8 = 128 exactly);
+    row 8s+7 is dead (it carries the slot-id lane used for the row mask).
+    No tile plan: the per-row slot id rides as ROW 7 of the 8-row weight
+    block (slot values <= 31 are exact in bf16), and a shifted row-iota
+    mask zeroes every weight row whose slot does not match the lane's."""
+    i = pl.program_id(1)
+    x = x_ref[0, 0].astype(jnp.int32)              # (Fc, T)
+    Fc, T = x.shape
+    Bp = padded_bins
+    shift = Fc.bit_length() - 1
+    x_rep = pltpu.repeat(x, Bp, axis=0)
+    iota_b = jax.lax.broadcasted_iota(jnp.int32, (Fc * Bp, T), 0) >> shift
+    onehot = (x_rep == iota_b).astype(jnp.bfloat16)
+
+    limbs = w_ref[0]                               # (8, T): 7 limbs + sel row
+    sel = limbs[7:8, :].astype(jnp.int32)
+    w = pltpu.repeat(limbs, _NAT_SLOTS, axis=0)    # (128, T), row r = limbs[r%8]
+    row_iota = jax.lax.broadcasted_iota(jnp.int32, (_NAT_SLOTS * 8, T), 0)
+    keep = ((row_iota >> 3) == sel) & ((row_iota & 7) != 7)
+    w = jnp.where(keep, w, jnp.bfloat16(0))
+    part = jax.lax.dot_general(
+        w, onehot, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)        # (128, Fc*Bp)
+
+    @pl.when(i == 0)
+    def _():
+        o_ref[0] = part
+
+    @pl.when(i != 0)
+    def _():
+        o_ref[0] = o_ref[0] + part
+
+
+@functools.partial(jax.jit, static_argnames=("total_bins", "num_features",
+                                             "axis_name", "platform"))
+def build_hist_nat(Xt_nat, g, h, sel, *, total_bins: int, num_features: int,
+                   axis_name: str | None = None,
+                   platform: str | None = None) -> jnp.ndarray:
+    """(16, 3, F, B) histograms from natural-order tiles; ``sel`` (N,) in
+    [0, 16); values >= 16 drop the row.  Replaces the plan+gather pipeline
+    for levels with few candidates — measured 154 vs 281 ms at 10M, P=8
+    (the tile plan's full-N sort and the row gather dominate there)."""
+    B = int(total_bins)
+    F = int(num_features)
+    Bp = _pow2_bins(B)
+    n_fb, n_tiles, Fc, T = Xt_nat.shape
+    N = g.shape[0]
+    pad = n_tiles * T - N
+    gp = jnp.pad(g.astype(jnp.float32), (0, pad))
+    hp = jnp.pad(h.astype(jnp.float32), (0, pad))
+    sp = jnp.pad(sel.astype(jnp.int32), (0, pad),
+                 constant_values=_NAT_DROP)
+    sp = jnp.minimum(sp, _NAT_DROP)
+    valid = (sp < _NAT_SLOTS).astype(jnp.float32)
+    gv = (gp * valid).reshape(n_tiles, T)
+    hv = (hp * valid).reshape(n_tiles, T)
+    cnt = valid.astype(jnp.bfloat16).reshape(n_tiles, T)
+    selr = sp.astype(jnp.bfloat16).reshape(n_tiles, T)
+    W = jnp.stack([*_split3(gv), *_split3(hv), cnt, selr], axis=-2)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=0,
+        grid=(n_fb, n_tiles),
+        in_specs=[
+            pl.BlockSpec((1, 1, Fc, T), lambda j, i: (j, i, 0, 0)),
+            pl.BlockSpec((1, 8, T), lambda j, i: (i, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, _NAT_SLOTS * 8, Fc * Bp),
+                               lambda j, i: (j, 0, 0)),
+    )
+    out_shape = jax.ShapeDtypeStruct(
+        (n_fb, _NAT_SLOTS * 8, Fc * Bp), jnp.float32,
+        **({"vma": frozenset({axis_name})} if axis_name else {}),
+    )
+    out = pl.pallas_call(
+        functools.partial(_nat_kernel, padded_bins=Bp),
+        grid_spec=grid_spec,
+        out_shape=out_shape,
+        interpret=_interpret(platform),
+    )(Xt_nat, W)
+    out = (out.reshape(n_fb, _NAT_SLOTS, 8, Bp, Fc)
+              .transpose(1, 2, 0, 4, 3)
+              .reshape(_NAT_SLOTS, 8, n_fb * Fc, Bp))[:, :, :F, :B]
+    hg = out[:, 0] + out[:, 1] + out[:, 2]
+    hh = out[:, 3] + out[:, 4] + out[:, 5]
+    hc = out[:, 6]
+    hist = jnp.stack([hg, hh, hc], axis=1)         # (16, 3, F, B)
+    if axis_name is not None:
+        hist = jax.lax.psum(hist, axis_name)
+    return hist

@@ -111,8 +111,43 @@ def smoke_pallas_wide_segment_count():
     print(f"pallas segmented P={P}: lowers and agrees on device")
 
 
+def smoke_pallas_natural_order():
+    """The natural-order multi-slot kernel (shallow levels, <= 16 slots)
+    — new Mosaic shapes (8-row weight block with the slot-id lane row,
+    128-row in-VMEM expansion, i==0 output init)."""
+    import jax
+    import jax.numpy as jnp
+
+    from dryad_tpu.engine.histogram import build_hist_segmented
+    from dryad_tpu.engine.pallas_hist import (
+        _NAT_DROP, build_hist_nat, natural_tiles,
+    )
+
+    if jax.devices()[0].platform == "cpu":
+        print("pallas natural-order: skipped (no accelerator attached)")
+        return
+    rng = np.random.default_rng(71)
+    # B=256 exercises the FULL lane budget (Fc*Bp = 8192 -> a (128, 8192)
+    # fp32 output block in VMEM), the shape gated production data uses
+    N, F, B, P = 150_000, 32, 256, 8
+    Xb = jnp.asarray(rng.integers(0, B, size=(N, F), dtype=np.uint8))
+    g = jnp.asarray(rng.normal(size=N).astype(np.float32))
+    h = jnp.asarray(rng.uniform(0.1, 1, N).astype(np.float32))
+    sel = jnp.asarray(np.where(rng.integers(0, 2 * P, N) < P,
+                               rng.integers(0, P, N), _NAT_DROP)
+                      .astype(np.int32))
+    got = np.asarray(build_hist_nat(natural_tiles(Xb, B), g, h, sel,
+                                    total_bins=B, num_features=F))[:P]
+    want = np.asarray(build_hist_segmented(
+        Xb, g, h, jnp.minimum(sel, P), P, B, backend="xla"))
+    np.testing.assert_array_equal(got[:, 2], want[:, 2])
+    np.testing.assert_allclose(got, want, atol=2e-2, rtol=2e-5)
+    print("pallas natural-order multi-slot: lowers and agrees on device")
+
+
 if __name__ == "__main__":
     smoke_shared_vs_per_class()
     smoke_pallas_vs_xla()
     smoke_pallas_u16_and_records()
     smoke_pallas_wide_segment_count()
+    smoke_pallas_natural_order()

@@ -152,3 +152,28 @@ def test_leafwise_pallas_matches_xla_trees():
     np.testing.assert_array_equal(b_xla.feature, b_pl.feature)
     np.testing.assert_array_equal(b_xla.threshold, b_pl.threshold)
     np.testing.assert_allclose(b_xla.value, b_pl.value, atol=1e-4)
+
+
+def test_natural_order_multislot_matches_oracle():
+    """build_hist_nat (no sort/no gather shallow-level pass) vs the XLA
+    segmented oracle: counts exact, sums to fp tolerance; drop sentinel
+    and padded tail rows contribute nothing."""
+    from dryad_tpu.engine.pallas_hist import (
+        _NAT_DROP, build_hist_nat, natural_tiles,
+    )
+
+    rng = np.random.default_rng(9)
+    N, F, B, P = 3000, 7, 32, 6
+    Xb = jnp.asarray(rng.integers(0, B, size=(N, F)).astype(np.uint8))
+    g = jnp.asarray(rng.normal(size=N).astype(np.float32))
+    h = jnp.asarray(rng.uniform(0.1, 1.0, size=N).astype(np.float32))
+    sel_np = rng.integers(0, P + 3, size=N).astype(np.int32)
+    sel_np = np.where(sel_np < P, sel_np, _NAT_DROP)
+    sel = jnp.asarray(sel_np)
+    got = np.asarray(build_hist_nat(natural_tiles(Xb, B), g, h, sel,
+                                    total_bins=B, num_features=F))
+    want = np.asarray(build_hist_segmented(
+        Xb, g, h, jnp.minimum(sel, P), P, B, backend="xla"))
+    np.testing.assert_array_equal(got[:P, 2], want[:, 2])
+    np.testing.assert_allclose(got[:P], want, rtol=1e-5, atol=1e-4)
+    assert np.all(got[P:] == 0)   # unused slots stay empty
