@@ -99,26 +99,39 @@ def main() -> None:
         ds10 = dryad.Dataset(X10, y10, max_bins=256)
         del X10
 
-        def warm_wall(n_trees: int) -> float:
-            p10 = params.replace(num_trees=n_trees)
-            train_device(p10, ds10)            # compile + warm (own T shape)
+        # Stall-robust pair methodology (VERDICT r3 weak #1): a tunnel
+        # stall anywhere in a timed run ADDS seconds and poisons the
+        # (8 - 2)-tree delta, and the old "< 0.5 s" guard only caught the
+        # opposite failure.  Stalls are one-sided (they only ever ADD
+        # time), so each arm is measured TWICE unconditionally and the
+        # per-arm MINIMUM is the estimator; a third round is added only
+        # when the two rounds of an arm disagree badly (> 15%), i.e. when
+        # a stall visibly hit both attempts or the first was poisoned.
+        p2 = params.replace(num_trees=2)
+        p8 = params.replace(num_trees=8)
+        train_device(p2, ds10)                 # compile + warm (own T shape)
+        train_device(p8, ds10)
+
+        def wall(p10) -> float:
             t0 = time.perf_counter()
             train_device(p10, ds10)
             return time.perf_counter() - t0
 
-        t2 = warm_wall(2)
-        t8 = warm_wall(8)
-        if t8 - t2 < 0.5:
-            # a tunnel stall in either timed run poisons the delta —
-            # re-measure the pair once (programs are compiled by now);
-            # stalls only ADD time, so keep the min of each
-            t2 = min(t2, warm_wall(2))
-            t8 = min(t8, warm_wall(8))
+        walls2 = [wall(p2), wall(p2)]
+        walls8 = [wall(p8), wall(p8)]
+        for ws, p10 in ((walls2, p2), (walls8, p8)):
+            if max(ws) > 1.15 * min(ws):
+                ws.append(wall(p10))
+        t2, t8 = min(walls2), min(walls8)
         marginal = max((t8 - t2) / 6.0, 1e-9)
         out["iters_per_sec_10m"] = round(1.0 / marginal, 4)
         out["marginal_s_per_iter_10m"] = round(marginal, 3)
         out["wall_2tree_10m"] = round(t2, 2)
         out["wall_8tree_10m"] = round(t8, 2)
+        # observability: per-arm spread (max/min - 1) so a noisy capture is
+        # visible in the artifact instead of silently shifting the headline
+        out["spread_2tree_10m"] = round(max(walls2) / min(walls2) - 1, 3)
+        out["spread_8tree_10m"] = round(max(walls8) / min(walls8) - 1, 3)
         out["rows_10m"] = 10_000_000
 
     print(json.dumps(out))

@@ -21,7 +21,7 @@ from typing import Callable, Optional
 import numpy as np
 
 from dryad_tpu.booster import CAT_WORDS, Booster, empty_tree_arrays
-from dryad_tpu.config import Params
+from dryad_tpu.config import Params, effective_depth_params
 from dryad_tpu.cpu.histogram import (
     build_hist,
     cat_members_to_bitset,
@@ -34,11 +34,34 @@ from dryad_tpu.objectives import get_objective
 
 
 def goss_uniform(params: Params, iteration: int, num_rows: int) -> np.ndarray:
-    """Per-iteration uniform draws for the GOSS Bernoulli pick — host Philox,
-    shared verbatim by both backends (like the bagging masks)."""
-    rng = np.random.Generator(
-        np.random.Philox(key=params.seed ^ 0x5A17ED, counter=iteration))
-    return rng.random(num_rows).astype(np.float32)
+    """Per-iteration uniforms for the GOSS Bernoulli pick: a counter-based
+    murmur3-finalizer hash of (seed, iteration, row id).
+
+    A pure u32 function (no PRNG state, no block structure) so the DEVICE
+    can generate the very same draws inside the chunked boosting program
+    (``engine/train._goss_uniform_dev`` — bit-identity pinned by
+    ``test_goss_monotone.test_goss_uniform_device_parity``); the old host
+    Philox draw forced GOSS onto per-iteration dispatch because uploading
+    (N,) uniforms per iteration costs GBs at 10M rows (VERDICT r3 #4).
+    The 24-bit mantissa uniform is exact in f32, so boundary rows classify
+    identically on every backend.
+    """
+    M1, M2 = 0x85EBCA6B, 0xC2B2AE35
+    key = (params.seed * 0x9E3779B9 + iteration * 0x7FEB352D + 0x165667B1) \
+        % (1 << 32)
+    key ^= key >> 16
+    key = (key * M1) % (1 << 32)
+    key ^= key >> 13
+    key = (key * M2) % (1 << 32)
+    key ^= key >> 16
+    x = np.arange(num_rows, dtype=np.uint32) * np.uint32(0x9E3779B9)
+    x ^= np.uint32(key)
+    x ^= x >> np.uint32(16)
+    x = x * np.uint32(M1)
+    x ^= x >> np.uint32(13)
+    x = x * np.uint32(M2)
+    x ^= x >> np.uint32(16)
+    return (x >> np.uint32(8)).astype(np.float32) * np.float32(1.0 / (1 << 24))
 
 
 def goss_select_np(params: Params, g_all: np.ndarray, u: np.ndarray):
@@ -308,12 +331,16 @@ def train_cpu(
 ) -> Booster:
     """Reference trainer: ``dryad.train`` semantics on the CPU backend."""
     p = params.validate()
-    obj = get_objective(p)
     Xb = data.X_binned
     y = data.y
     N, F = Xb.shape
-    K = p.num_outputs
     B = data.mapper.total_bins
+    # documented max_depth=-1 policy — the EXACT (jax-free) mapping the
+    # device trainer applies (config.effective_depth_params), so the two
+    # backends keep growing identical trees on the default config
+    p = effective_depth_params(p, F, B)
+    obj = get_objective(p)
+    K = p.num_outputs
     is_cat = data.mapper.is_categorical
     T = (num_trees if num_trees is not None else p.num_trees) * K
 

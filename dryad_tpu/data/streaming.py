@@ -127,9 +127,22 @@ def sketch_stream_csr(
         n = len(indptr) - 1
         keep = np.flatnonzero(_keyed_uniform(offset, n, seed) < rate)
         dense = np.zeros((keep.size, num_features), np.float32)
-        for j, r in enumerate(keep):
-            a, b = int(indptr[r]), int(indptr[r + 1])
-            dense[j, indices[a:b]] = values[a:b]
+        # vectorized densify (ADVICE r3 #3): one fancy-index scatter for
+        # the whole chunk's kept rows — the per-row Python loop cost
+        # minutes of interpreter time at the default 1M-row sample on
+        # Criteo-scale streams.  np.repeat maps each kept nonzero back to
+        # its (compacted) row; column ids and values are sliced per row
+        # via a ragged take.
+        indptr = np.asarray(indptr)
+        counts = (indptr[keep + 1] - indptr[keep]).astype(np.int64)
+        rows_rep = np.repeat(np.arange(keep.size, dtype=np.int64), counts)
+        starts = indptr[keep].astype(np.int64)
+        # positions of the kept rows' nonzeros inside indices/values:
+        # contiguous runs [starts[j], starts[j]+counts[j]) concatenated
+        runs = np.arange(counts.sum(), dtype=np.int64)
+        run_base = np.repeat(np.cumsum(counts) - counts, counts)
+        src = np.repeat(starts, counts) + (runs - run_base)
+        dense[rows_rep, np.asarray(indices)[src]] = np.asarray(values)[src]
         parts.append(dense)
         offset += n
     if offset != total_rows:

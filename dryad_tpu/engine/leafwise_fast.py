@@ -57,8 +57,12 @@ from dryad_tpu.engine.grower import (
 from dryad_tpu.engine.histogram import build_hist, build_hist_segmented
 from dryad_tpu.engine.split import NEG_INF, find_best_split
 
-_HIST_BYTES_BUDGET = 256 << 20   # pinned expansion hist buffer cap
-_MAX_FAST_DEPTH = 14
+from dryad_tpu.config import (  # noqa: F401  (re-exported API)
+    LEAFWISE_HIST_BYTES_BUDGET as _HIST_BYTES_BUDGET,
+    MAX_FAST_DEPTH as _MAX_FAST_DEPTH,
+    effective_depth_params,
+    leafwise_fast_supported,
+)
 
 
 def supports(p: Params, num_features: int, total_bins: int) -> bool:
@@ -68,18 +72,13 @@ def supports(p: Params, num_features: int, total_bins: int) -> bool:
     widest level transiently holds ~5-6x that (hist_small/large/l/r plus
     the 2P-wide children concat for the vmapped split finder), so the cap
     is set to keep peak transients under ~1.5 GB.  Configs beyond it keep
-    the sequential grower."""
-    D = p.max_depth
-    if not 0 < D <= _MAX_FAST_DEPTH:
-        return False
-    if not p.hist_subtraction:
-        # the expansion derives every larger sibling by subtraction; a
-        # config that disables subtraction (fp-exactness knob honored by
-        # grower.py / levelwise.py / cpu/trainer.py) must keep the
-        # sequential program or near-tie gains could flip vs the CPU oracle
-        return False
-    Pf = 1 << max(D - 1, 0)
-    return Pf * 3 * num_features * total_bins * 4 <= _HIST_BYTES_BUDGET
+    the sequential grower.  (The shape logic lives jax-free in
+    ``config.leafwise_fast_supported`` so the CPU backend's max_depth=-1
+    policy — config.effective_depth_params — can consult it without
+    touching jax; a config that disables hist_subtraction is rejected
+    there too, because the expansion derives every larger sibling by
+    subtraction.)"""
+    return leafwise_fast_supported(p, num_features, total_bins)
 
 
 def grow_tree_leafwise_batched(

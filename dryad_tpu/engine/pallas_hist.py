@@ -523,14 +523,21 @@ def build_hist_small(nat_tiles, g, h, sel, num_cols: int, total_bins: int,
                      num_features: int, *, axis_name: str | None = None,
                      platform: str | None = None) -> jnp.ndarray:
     """(P, 3, F, B) via the natural-order pass: owns the drop-sentinel
-    mapping (callers use sel == P for "drop") and the slot-budget check."""
+    mapping (callers use sel == P for "drop") and the slot-budget check.
+
+    ``num_cols`` is forwarded so the allreduce inside covers only the P live
+    slots — psumming the full 16-slot kernel output shipped 2x the needed
+    bytes at P=8 (ADVICE r3 #2); with the slice before the psum, the nat
+    pass's collective payload equals the plan path's (P, 3, F, B), keeping
+    ``train._comm_stats`` exact for both."""
     P = int(num_cols)
     assert P <= _NAT_SLOTS, "natural-order pass holds at most 16 slots"
     sel_nat = jnp.where(sel >= P, _NAT_DROP, sel)
     return build_hist_nat(nat_tiles, g, h, sel_nat,
                           total_bins=int(total_bins),
                           num_features=int(num_features),
-                          axis_name=axis_name, platform=platform)[:P]
+                          num_cols=P,
+                          axis_name=axis_name, platform=platform)
 
 
 def natural_tiles(Xb: jnp.ndarray, total_bins: int) -> jnp.ndarray:
@@ -580,14 +587,19 @@ def _nat_kernel(x_ref, w_ref, o_ref, *, padded_bins: int):
 
 
 @functools.partial(jax.jit, static_argnames=("total_bins", "num_features",
-                                             "axis_name", "platform"))
+                                             "num_cols", "axis_name",
+                                             "platform"))
 def build_hist_nat(Xt_nat, g, h, sel, *, total_bins: int, num_features: int,
+                   num_cols: int = _NAT_SLOTS,
                    axis_name: str | None = None,
                    platform: str | None = None) -> jnp.ndarray:
-    """(16, 3, F, B) histograms from natural-order tiles; ``sel`` (N,) in
-    [0, 16); values >= 16 drop the row.  Replaces the plan+gather pipeline
-    for levels with few candidates — measured 154 vs 281 ms at 10M, P=8
-    (the tile plan's full-N sort and the row gather dominate there)."""
+    """(num_cols, 3, F, B) histograms from natural-order tiles; ``sel`` (N,)
+    in [0, 16); values >= 16 drop the row.  Replaces the plan+gather
+    pipeline for levels with few candidates — measured 154 vs 281 ms at
+    10M, P=8 (the tile plan's full-N sort and the row gather dominate
+    there).  The kernel always produces all 16 slots (its 128-row MXU tile
+    is fixed); ``num_cols`` slices BEFORE the psum so sharded callers
+    allreduce only live slots (ADVICE r3 #2)."""
     B = int(total_bins)
     F = int(num_features)
     Bp = _pow2_bins(B)
@@ -629,10 +641,11 @@ def build_hist_nat(Xt_nat, g, h, sel, *, total_bins: int, num_features: int,
     out = (out.reshape(n_fb, _NAT_SLOTS, 8, Bp, Fc)
               .transpose(1, 2, 0, 4, 3)
               .reshape(_NAT_SLOTS, 8, n_fb * Fc, Bp))[:, :, :F, :B]
+    out = out[:num_cols]
     hg = out[:, 0] + out[:, 1] + out[:, 2]
     hh = out[:, 3] + out[:, 4] + out[:, 5]
     hc = out[:, 6]
-    hist = jnp.stack([hg, hh, hc], axis=1)         # (16, 3, F, B)
+    hist = jnp.stack([hg, hh, hc], axis=1)         # (num_cols, 3, F, B)
     if axis_name is not None:
         hist = jax.lax.psum(hist, axis_name)
     return hist

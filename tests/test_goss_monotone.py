@@ -31,6 +31,48 @@ def test_goss_backend_quality(backend):
     assert auc(y, b.predict_binned(ds.X_binned)) > 0.68
 
 
+def test_goss_uniform_device_parity():
+    """The device-drawn chunk-path uniforms must be BIT-identical to the
+    host generator (cpu/trainer.goss_uniform) — the anchor that lets GOSS
+    chunk without breaking CPU↔TPU selection parity (VERDICT r3 #4)."""
+    import jax
+    import jax.numpy as jnp
+
+    from dryad_tpu.config import make_params
+    from dryad_tpu.cpu.trainer import goss_uniform
+    from dryad_tpu.engine.train import _goss_uniform_dev
+
+    for seed in (0, 7, 123456789):
+        p = make_params(dict(objective="binary", seed=seed))
+        for it in (0, 1, 57, 4999):
+            host = goss_uniform(p, it, 3001)
+            dev = jax.jit(
+                lambda i: _goss_uniform_dev(seed, i, 3001)
+            )(jnp.int32(it))
+            np.testing.assert_array_equal(host, np.asarray(dev))
+            assert host.min() >= 0.0 and host.max() < 1.0
+
+
+def test_goss_cpu_tpu_tree_parity():
+    """GOSS trees must agree across backends with the shared counter-based
+    uniforms (the TPU run rides the chunked path, generating them on
+    device)."""
+    X, y = higgs_like(4000, seed=79)
+    ds = dryad.Dataset(X, y, max_bins=32)
+    p = dict(objective="binary", num_trees=8, num_leaves=15, max_bins=32,
+             boosting="goss", goss_top_rate=0.25, goss_other_rate=0.15,
+             seed=5)
+    b_cpu = dryad.train(p, ds, backend="cpu")
+    b_dev = dryad.train(p, ds, backend="tpu")
+    np.testing.assert_array_equal(b_cpu.feature, b_dev.feature)
+    np.testing.assert_array_equal(b_cpu.threshold, b_dev.threshold)
+    # leaf values accumulate on different fp pipelines -> ulp-level noise;
+    # structure above is the exact-parity assertion (CLAUDE.md invariant)
+    np.testing.assert_allclose(
+        b_cpu.predict_binned(ds.X_binned), b_dev.predict_binned(ds.X_binned),
+        rtol=2e-6, atol=2e-6)
+
+
 def test_goss_validation():
     X, y = higgs_like(500, seed=75)
     ds = dryad.Dataset(X, y, max_bins=16)

@@ -14,6 +14,7 @@ import jax.numpy as jnp
 from dryad_tpu.config import make_params
 from dryad_tpu.engine.grower import grow_any, grow_tree
 from dryad_tpu.engine.leafwise_fast import (
+    effective_depth_params,
     grow_tree_leafwise_batched,
     supports,
 )
@@ -78,6 +79,90 @@ def test_batched_equals_sequential_monotone():
     seq = grow_tree(p, 32, Xb, g, h, bag, fmask, iscat)
     bat = grow_tree_leafwise_batched(p, 32, Xb, g, h, bag, fmask, iscat)
     _assert_same_tree(seq, bat)
+
+
+def test_batched_equals_sequential_cat_and_missing():
+    """Combined categorical + learn_missing routing (ADVICE r3 #4): the
+    packed-word partition applies the missing-direction AND before the
+    categorical override — the interaction most likely to regress silently.
+    Bin 0 plays 'missing' on the numeric features; categorical subset
+    splits must override the missing plane entirely."""
+    rng = np.random.default_rng(11)
+    n, f, b = 20_000, 8, 32
+    Xb_np = rng.integers(1, b, size=(n, f), dtype=np.uint8)
+    # missing-heavy numeric columns + two categorical columns
+    miss = rng.random((n, f)) < 0.25
+    miss[:, 0] = False
+    miss[:, 3] = False
+    Xb_np[miss] = 0
+    Xb = jnp.asarray(Xb_np)
+    yv = rng.normal(size=n)
+    g = jnp.asarray((yv + rng.normal(size=n) * 0.1).astype(np.float32))
+    h = jnp.asarray(rng.uniform(0.5, 1.5, size=n).astype(np.float32))
+    bag = jnp.asarray(rng.random(n) < 0.9)
+    fmask = jnp.ones((f,), bool)
+    iscat = jnp.zeros((f,), bool).at[0].set(True).at[3].set(True)
+    p = make_params(dict(objective="l2", num_leaves=31, max_depth=6,
+                         growth="leafwise", min_data_in_leaf=20))
+    seq = grow_tree(p, b, Xb, g, h, bag, fmask, iscat, has_cat=True,
+                    learn_missing=True)
+    bat = grow_tree_leafwise_batched(p, b, Xb, g, h, bag, fmask, iscat,
+                                     has_cat=True, learn_missing=True)
+    _assert_same_tree(seq, bat)
+
+
+def test_effective_depth_policy():
+    """max_depth=-1 maps to min(ceil(log2(L))+4, 14) under 'auto' whenever
+    the batched grower can take the config; 'exact' and infeasible shapes
+    keep true-unbounded (VERDICT r3 #3)."""
+    p = make_params(dict(objective="l2", num_leaves=255, growth="leafwise"))
+    assert effective_depth_params(p, 28, 256).max_depth == 12
+    p31 = make_params(dict(objective="l2", num_leaves=31, growth="leafwise"))
+    assert effective_depth_params(p31, 8, 32).max_depth == 9
+    # explicit cap: untouched
+    p_cap = p.replace(max_depth=7)
+    assert effective_depth_params(p_cap, 28, 256) is p_cap
+    # opt-out: untouched
+    p_exact = p.replace(unbounded_depth="exact")
+    assert effective_depth_params(p_exact, 28, 256) is p_exact
+    # depthwise: untouched (policy is leaf-wise only)
+    p_dw = make_params(dict(objective="l2", num_leaves=255,
+                            growth="depthwise"))
+    assert effective_depth_params(p_dw, 28, 256) is p_dw
+    # expansion budget exceeded at the capped depth -> sequential unbounded
+    assert effective_depth_params(p, 2000, 256) is p
+    # subtraction disabled -> batched grower unavailable -> untouched
+    p_nosub = p.replace(hist_subtraction=False)
+    assert effective_depth_params(p_nosub, 28, 256) is p_nosub
+
+
+def test_default_config_rides_batched_grower():
+    """End-to-end: the out-of-the-box leaf-wise config (max_depth=-1) must
+    train identically to the explicit effective-depth config on BOTH
+    backends (the policy is applied identically in cpu/trainer.py and
+    engine/train.py)."""
+    import dryad_tpu as dryad
+
+    rng = np.random.default_rng(5)
+    X = rng.normal(size=(4000, 6))
+    y = (X[:, 0] + 0.5 * X[:, 1] ** 2 + rng.normal(size=4000) * 0.1 > 0.3)
+    ds = dryad.Dataset(X, y.astype(np.float64), max_bins=32)
+    p_auto = make_params(dict(objective="binary", num_trees=4,
+                              num_leaves=31, growth="leafwise"))
+    p_expl = p_auto.replace(max_depth=9)
+    for backend in ("cpu", "tpu"):
+        b_auto = dryad.train(p_auto, ds, backend=backend)
+        b_expl = dryad.train(p_expl, ds, backend=backend)
+        np.testing.assert_array_equal(b_auto.feature, b_expl.feature)
+        np.testing.assert_array_equal(b_auto.threshold, b_expl.threshold)
+        np.testing.assert_array_equal(
+            b_auto.predict(X, raw_score=True),
+            b_expl.predict(X, raw_score=True))
+    # and CPU == device on the default config itself
+    b_cpu = dryad.train(p_auto, ds, backend="cpu")
+    b_dev = dryad.train(p_auto, ds, backend="tpu")
+    np.testing.assert_array_equal(b_cpu.feature, b_dev.feature)
+    np.testing.assert_array_equal(b_cpu.threshold, b_dev.threshold)
 
 
 def test_grow_any_routes_by_depth():
