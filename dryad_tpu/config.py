@@ -229,23 +229,49 @@ class Params:
 # trainer entries, so the max_depth=-1 mapping can never diverge by backend.
 LEAFWISE_HIST_BYTES_BUDGET = 256 << 20   # pinned expansion hist buffer cap
 MAX_FAST_DEPTH = 14
+# Peak-residency envelope for the batched grower (VERDICT r3 #7): the
+# pinned (Pf, 3, F, B) expansion buffer transiently fans out ~6x at the
+# widest level (small/large/l/r + the 2P children concat feeding the
+# vmapped split finder), CO-RESIDENT with the N-scaled working set (binned
+# matrix, per-tree record table, grad/hess/score columns).  12 GiB leaves
+# headroom on a 16 GiB v5e HBM for the boosting loop's own buffers.  A
+# pure function of params + data shape — NEVER of backend — so the CPU
+# mirror routes identically and parity holds.
+LEAFWISE_TOTAL_BYTES_BUDGET = 12 << 30
 
 
 def leafwise_fast_supported(p: Params, num_features: int,
-                            total_bins: int) -> bool:
+                            total_bins: int,
+                            num_rows: int | None = None) -> bool:
     """Whether the batched leaf-wise grower can take this config (see
-    engine/leafwise_fast.supports for the budget rationale)."""
+    engine/leafwise_fast.supports for the budget rationale).  ``num_rows``
+    (GLOBAL rows — shard-count independent, or the 1-shard/N-shard
+    invariant would break) adds the peak-residency check; None skips it
+    (shape-only callers)."""
     D = p.max_depth
     if not 0 < D <= MAX_FAST_DEPTH:
         return False
     if not p.hist_subtraction:
         return False
     Pf = 1 << max(D - 1, 0)
-    return Pf * 3 * num_features * total_bins * 4 <= LEAFWISE_HIST_BYTES_BUDGET
+    pinned = Pf * 3 * num_features * total_bins * 4
+    if pinned > LEAFWISE_HIST_BYTES_BUDGET:
+        return False
+    if num_rows is not None:
+        bin_bytes = 1 if total_bins <= 256 else 2
+        rec_words = 2 + -(-num_features * bin_bytes // 4)
+        K = p.num_outputs
+        per_row = (num_features * bin_bytes      # binned matrix
+                   + 4 * rec_words               # per-tree record table
+                   + 16 * K + 8)                 # (N,K) g/h/score + slots
+        if 6 * pinned + num_rows * per_row > LEAFWISE_TOTAL_BYTES_BUDGET:
+            return False
+    return True
 
 
 def effective_depth_params(p: Params, num_features: int,
-                           total_bins: int) -> Params:
+                           total_bins: int,
+                           num_rows: int | None = None) -> Params:
     """The documented ``max_depth=-1`` policy for leaf-wise growth at scale.
 
     Unbounded-depth leaf-wise growth cannot be pre-expanded, so it takes the
@@ -271,7 +297,9 @@ def effective_depth_params(p: Params, num_features: int,
     if L > (1 << eff):
         return p                      # cap cannot express the leaf budget
     cand = p.replace(max_depth=eff)
-    return cand if leafwise_fast_supported(cand, num_features, total_bins) else p
+    if leafwise_fast_supported(cand, num_features, total_bins, num_rows):
+        return cand
+    return p
 
 
 def make_params(params: "Params | Mapping[str, Any] | None" = None, **kw: Any) -> Params:

@@ -338,7 +338,7 @@ def train_cpu(
     # documented max_depth=-1 policy — the EXACT (jax-free) mapping the
     # device trainer applies (config.effective_depth_params), so the two
     # backends keep growing identical trees on the default config
-    p = effective_depth_params(p, F, B)
+    p = effective_depth_params(p, F, B, N)
     obj = get_objective(p)
     K = p.num_outputs
     is_cat = data.mapper.is_categorical

@@ -110,6 +110,25 @@ class Dataset:
         self.group = None if group is None else np.ascontiguousarray(group, np.int64)
         if self.group is not None and int(self.group.sum()) != self.num_rows:
             raise ValueError("group sizes must sum to num_rows")
+        self._device_cache = None
+
+    def device_arrays(self):
+        """Memoized device copies of (X_binned, y, weight).
+
+        Repeated ``train`` calls on one Dataset skip the host->device
+        upload — 280 MB of binned matrix at Higgs-10M scale, tens of
+        seconds through a remote device tunnel.  The arrays are treated as
+        immutable once uploaded; mutate ``X_binned``/``y`` in place and the
+        cache goes stale (construct a new Dataset instead)."""
+        if self._device_cache is None:
+            import jax.numpy as jnp
+
+            self._device_cache = (
+                jnp.asarray(self.X_binned),
+                None if self.y is None else jnp.asarray(self.y),
+                None if self.weight is None else jnp.asarray(self.weight),
+            )
+        return self._device_cache
 
     @classmethod
     def from_binned(

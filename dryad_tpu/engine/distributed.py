@@ -57,7 +57,7 @@ def shard_rows(mesh: Mesh, *arrays):
 def grow_sharded(params: Params, total_bins: int, has_cat: bool,
                  mesh: Mesh, Xb, g, h, bag_mask, feat_mask, is_cat_feat,
                  platform=None, learn_missing=False, root_hist=None,
-                 bundled_mask=None):
+                 bundled_mask=None, global_rows=None):
     """One sharded tree grow; returns (replicated tree, row-sharded leaves).
 
     Called inside the device train step's jit: the tree arrays come back
@@ -74,7 +74,7 @@ def grow_sharded(params: Params, total_bins: int, has_cat: bool,
             has_cat=has_cat, axis_name=AXIS, platform=platform,
             learn_missing=learn_missing,
             root_hist=extras[0] if extras else None,
-            bundled_mask=bmask_l,
+            bundled_mask=bmask_l, global_rows=global_rows,
         )
         # per-shard leaf ids straight from the grower's partition state
         leaves = tree.pop("row_leaf")

@@ -45,7 +45,8 @@ _BIG_DEPTH = jnp.int32(2**30)
 
 def grow_any(params, total_bins, Xb, g, h, bag_mask, feat_mask, is_cat_feat,
              *, has_cat=False, axis_name=None, platform=None,
-             learn_missing=False, root_hist=None, bundled_mask=None):
+             learn_missing=False, root_hist=None, bundled_mask=None,
+             global_rows=None):
     """Route to the fastest grower for the growth policy.
 
     Depth-wise growth takes the level-synchronous path (one batched
@@ -66,7 +67,17 @@ def grow_any(params, total_bins, Xb, g, h, bag_mask, feat_mask, is_cat_feat,
     if params.growth == "leafwise":
         from dryad_tpu.engine import leafwise_fast
 
-        if leafwise_fast.supports(params, Xb.shape[1], int(total_bins)):
+        # GLOBAL rows (static at trace time): the batched-vs-sequential
+        # choice must not depend on the shard count, or N-shard ≡ 1-shard
+        # breaks — under shard_map Xb is the local shard.  Sharded callers
+        # pass the UNPADDED global N (local*n_shards counts the mesh pad,
+        # which varies with shard count and could flip the envelope at the
+        # boundary); single-device direct callers carry no pad.
+        if global_rows is None:
+            n_shards = int(jax.lax.psum(1, axis_name)) if axis_name else 1
+            global_rows = Xb.shape[0] * n_shards
+        if leafwise_fast.supports(params, Xb.shape[1], int(total_bins),
+                                  global_rows):
             # depth-capped leaf-wise: exact best-first selection over a
             # level-synchronous full expansion — O(N·depth) instead of the
             # sequential grower's O(N·leaves) (gains are order-independent,
@@ -78,6 +89,19 @@ def grow_any(params, total_bins, Xb, g, h, bag_mask, feat_mask, is_cat_feat,
                 platform=platform, learn_missing=learn_missing,
                 root_hist=root_hist, bundled_mask=bundled_mask,
             )
+        if params.max_depth > 0:
+            # deterministic fallback with a visible reason (VERDICT r3 #7):
+            # the config asked for depth-capped leaf-wise but the batched
+            # grower's envelope (depth cap, hist_subtraction, or the
+            # peak-memory model in config.leafwise_fast_supported) rejects
+            # it — the sequential grower is exact, just O(N·leaves)
+            import warnings
+
+            warnings.warn(
+                "batched leaf-wise grower unavailable for this config "
+                "(depth/memory envelope; config.leafwise_fast_supported) — "
+                "falling back to the sequential grower",
+                stacklevel=2)
     return grow_tree(
         params, total_bins, Xb, g, h, bag_mask, feat_mask, is_cat_feat,
         has_cat=has_cat, axis_name=axis_name, platform=platform,

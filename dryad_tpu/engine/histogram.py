@@ -327,6 +327,7 @@ def build_hist_segmented(
     rows_bound: int | None = None,
     platform: str | None = None,
     records: jnp.ndarray | None = None,
+    sel_counts: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
     """Histograms for ``num_cols`` leaves -> (P, 3, F, B) fp32, O(N·F·B) work.
 
@@ -348,6 +349,7 @@ def build_hist_segmented(
             return pallas_hist.build_hist_segmented_pallas(
                 Xb, g, h, sel, num_cols, total_bins, axis_name=axis_name,
                 rows_bound=rows_bound, platform=platform, records=records,
+                sel_counts=sel_counts,
             )
     N, F = Xb.shape
     B = int(total_bins)

@@ -65,7 +65,8 @@ from dryad_tpu.config import (  # noqa: F401  (re-exported API)
 )
 
 
-def supports(p: Params, num_features: int, total_bins: int) -> bool:
+def supports(p: Params, num_features: int, total_bins: int,
+             num_rows: int | None = None) -> bool:
     """Fast leaf-wise needs a finite, memory-feasible expansion depth.
 
     The budget is checked against the PINNED (Pf, 3, F, B) buffer, but the
@@ -77,8 +78,9 @@ def supports(p: Params, num_features: int, total_bins: int) -> bool:
     policy — config.effective_depth_params — can consult it without
     touching jax; a config that disables hist_subtraction is rejected
     there too, because the expansion derives every larger sibling by
-    subtraction.)"""
-    return leafwise_fast_supported(p, num_features, total_bins)
+    subtraction.)  ``num_rows`` must be the GLOBAL row count (see
+    config.leafwise_fast_supported)."""
+    return leafwise_fast_supported(p, num_features, total_bins, num_rows)
 
 
 def grow_tree_leafwise_batched(
@@ -273,12 +275,19 @@ def grow_tree_leafwise_batched(
                     nat_tiles, g, h, smallsel, P, B, F,
                     axis_name=axis_name, platform=platform)
             else:
+                # exact per-column counts (smaller-child C off the parent
+                # histogram) admit the pad-injected aligned sort — see
+                # levelwise.py / pallas_hist.tile_plan_aligned
+                small_cnt = (jnp.where(do, jnp.where(left_smaller, CL, CR),
+                                       0.0).astype(jnp.int32)
+                             if bound_ok else None)
                 hist_small = build_hist_segmented(
                     Xb, g, h, smallsel, P, B,
                     rows_per_chunk=p.rows_per_chunk, axis_name=axis_name,
                     precision=p.hist_precision, backend=p.hist_backend,
                     rows_bound=(N // 2 + 1) if bound_ok else None,
                     platform=platform, records=records,
+                    sel_counts=small_cnt,
                 )
             hist_large = st["hists"][jnp.minimum(jarr, Pf - 1)] - hist_small
             ls = left_smaller[:, None, None, None]

@@ -343,12 +343,21 @@ def grow_tree_levelwise(
                     nat_tiles, g, h, smallsel, P, B, F,
                     axis_name=axis_name, platform=platform)
             else:
+                # exact per-column counts (smaller-child C off the parent
+                # histogram, integer-exact in f32 below 2**24) admit the
+                # pad-injected aligned sort — the plan's alignment gather
+                # drops out (tile_plan_aligned); single-device only, where
+                # the counts describe the whole selection
+                small_cnt = (jnp.where(do, jnp.where(left_smaller, CL, CR),
+                                       0.0).astype(jnp.int32)
+                             if bound_ok else None)
                 hist_small = build_hist_segmented(
                     Xb, g, h, smallsel, P, B,
                     rows_per_chunk=p.rows_per_chunk, axis_name=axis_name,
                     precision=p.hist_precision, backend=p.hist_backend,
                     rows_bound=(N // 2 + 1) if bound_ok else None,
                     platform=platform, records=records,
+                    sel_counts=small_cnt,
                 )
             if p.hist_subtraction:
                 hist_large = hists[sj] - hist_small

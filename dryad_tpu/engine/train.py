@@ -21,6 +21,7 @@ break cross-backend parity.
 
 from __future__ import annotations
 
+import os
 from functools import partial
 from typing import Callable, Optional
 
@@ -50,7 +51,7 @@ _CHUNK_FB_LIMIT = 1 << 19
 
 def _step_body(p, B, has_cat, mesh, platform, learn_missing, out, score, Xb,
                g_all, h_all, bag, fmask, is_cat_feat, t, k, root_hist=None,
-               bmask=None):
+               bmask=None, n_rows=None):
     """One (iteration, class) tree: grow, record into slot t, update scores.
 
     Shared by the per-iteration ``_step_jit`` dispatch and the chunked
@@ -68,6 +69,9 @@ def _step_body(p, B, has_cat, mesh, platform, learn_missing, out, score, Xb,
             p, B, has_cat, mesh, Xb, g, h, bag, fmask, is_cat_feat,
             platform=platform, learn_missing=learn_missing,
             root_hist=root_hist, bundled_mask=bmask,
+            # UNPADDED global N: the envelope policy must see the same
+            # rows at every shard count (and as the CPU mirror)
+            global_rows=n_rows,
         )
     else:
         tree = grow_any(p, B, Xb, g, h, bag, fmask, is_cat_feat,
@@ -87,7 +91,7 @@ def _step_body(p, B, has_cat, mesh, platform, learn_missing, out, score, Xb,
 
 _step_jit = partial(jax.jit,
                     static_argnames=("p", "B", "has_cat", "mesh", "platform",
-                                     "learn_missing"))(_step_body)
+                                     "learn_missing", "n_rows"))(_step_body)
 # Module-level jit keyed on the static (params, bins, mesh) triple — the
 # compiled program is reused across ``train_device`` calls (a closure-local
 # jit would recompile per call and dwarf the training itself).  out/score
@@ -205,7 +209,8 @@ def _chunk_jit(p, B, has_cat, mesh, platform, learn_missing, N, K, pad,
             out, score = _step_body(
                 p, B, has_cat, mesh, platform, learn_missing, out, score,
                 Xb, g_all, h_all, bag_i, fmask_i, is_cat_feat, t, k,
-                root_hist=None if roots is None else roots[k], bmask=bmask)
+                root_hist=None if roots is None else roots[k], bmask=bmask,
+                n_rows=N)
 
         if n_valid:
             new_vs = []
@@ -244,7 +249,8 @@ def _chunk_jit(p, B, has_cat, mesh, platform, learn_missing, N, K, pad,
 
 
 def _comm_stats(p, F: int, B: int, K: int, n_shards: int,
-                shared_roots: bool = False) -> dict:
+                shared_roots: bool = False,
+                num_rows: int | None = None) -> dict:
     """Static per-iteration histogram-allreduce payload (SURVEY.md §5
     observability).  Every histogram builder issues ONE fused
     grad/hess/count psum of its (..., 3, F, B) f32 output per call, so the
@@ -267,7 +273,8 @@ def _comm_stats(p, F: int, B: int, K: int, n_shards: int,
     else:
         from dryad_tpu.engine import leafwise_fast
 
-        if p.growth == "leafwise" and leafwise_fast.supports(p, F, B):
+        if (p.growth == "leafwise"
+                and leafwise_fast.supports(p, F, B, num_rows)):
             D = p.max_depth
             Pf = 1 << max(D - 1, 0)
             P_narrow = min(8, Pf)
@@ -419,7 +426,7 @@ def train_device(
     B = data.mapper.total_bins
     # documented max_depth=-1 policy (identical mapping on the CPU backend,
     # so cross-backend parity is untouched)
-    p = effective_depth_params(p, F, B)
+    p = effective_depth_params(p, F, B, N)
     obj = get_objective(p)
     K = p.num_outputs
     is_cat_np = data.mapper.is_categorical
@@ -443,9 +450,9 @@ def train_device(
         Xb, y = shard_rows(mesh, jnp.asarray(Xb_np), jnp.asarray(y_np))
         weight = shard_rows(mesh, jnp.asarray(w_np))[0] if w_np is not None else None
     else:
-        Xb = jnp.asarray(Xb_np)
-        y = jnp.asarray(y_np)
-        weight = jnp.asarray(w_np) if w_np is not None else None
+        # memoized on the Dataset: repeated train calls (bench arms, warm
+        # restarts, parameter sweeps) skip the X upload entirely
+        Xb, y, weight = data.device_arrays()
     NP = N + pad
     is_cat_feat = jnp.asarray(is_cat_np)
     qoff = data.query_offsets
@@ -492,7 +499,8 @@ def train_device(
             multihost_utils.process_allgather(np.int32(learn_missing)).max())
 
     comm = (_comm_stats(p_key, F, B, K, mesh.devices.size,
-                        shared_roots=K > 1 and _shared_roots_ok(p, plat))
+                        shared_roots=K > 1 and _shared_roots_ok(p, plat),
+                        num_rows=NP)
             if mesh is not None else None)
 
     # EFB bundle columns are masked out of the missing-right split plane
@@ -506,7 +514,7 @@ def train_device(
     def step(out, score, g_all, h_all, bag, fmask, t, k, root_hist=None):
         return _step_jit(p_key, B, has_cat, mesh, plat, learn_missing, out,
                          score, Xb, g_all, h_all, bag, fmask, is_cat_feat, t, k,
-                         root_hist, bmask)
+                         root_hist, bmask, n_rows=N)
 
     # ---- resume / warm start -------------------------------------------------
     out = _empty_out_device(T, p.max_nodes, CAT_WORDS)
@@ -657,19 +665,28 @@ def train_device(
             from dryad_tpu.engine import leafwise_fast
 
             if (p.growth == "leafwise"
-                    and leafwise_fast.supports(p, F, B)):
+                    and leafwise_fast.supports(p, F, B, NP)):
                 # batched leaf-wise: one level pass per expansion depth
                 passes_est = p.max_depth
             else:
                 passes_est = max(8, p.effective_num_leaves - 1)
         est_iter_s = (1.6e-7 * NP * K * passes_est
                       * max(F / 28.0, 1.0) * max(B / 256.0, 1.0))
-        # cap-64 validated in the worst regime (est_iter_s ~ 1 s, where the
-        # full 40 s budget is actually spent): at 800k rows depthwise d8
-        # the model OVER-estimates 1.7x (est 1.02 vs 0.61 s/iter actual —
-        # fixed overheads amortize sublinearly), so a CH=39 chunk ran 24 s,
-        # comfortably under the ~60 s watchdog
-        CH = max(1, min(64, int(40.0 / max(est_iter_s, 1e-3))))
+        # per-MAC model (round 4): histogram work is N·K·passes·F·B MACs and
+        # 5e-15 s/MAC sits mid-range of the measured configs (10M Higgs
+        # 2.9 est vs 3.0 actual; Epsilon 8.2 vs 10.2; Covertype 2.3 vs
+        # 1.15) — far tighter than the per-row model above, which
+        # over-estimates up to 8x off its calibration point.  LambdaMART
+        # keeps the over-estimating per-row model for chunk sizing: its λ
+        # pass scales with query sizes the MAC model cannot see.
+        est_iter_mac = 0.05 + 5e-15 * NP * K * passes_est * F * B
+        est_for_ch = (est_iter_s if p.objective == "lambdarank"
+                      else est_iter_mac)
+        # 25 s budget on the tighter model (was 40 s on the loose one):
+        # the ~60 s tunnel watchdog keeps 2.4x headroom even where the MAC
+        # model under-estimates (Epsilon 1.25x); the second-chunk
+        # calibration still re-derives CH from measurement either way
+        CH = max(1, min(64, int(25.0 / max(est_for_ch, 1e-3))))
         # The cost model overestimates (measured 1.7-4x — fixed overheads
         # amortize sublinearly), so a model-derived CH of 1 may really
         # afford 2-4 iterations: admit single-iteration chunks when the
@@ -680,6 +697,33 @@ def train_device(
         # the ~60 s watchdog means a real 1-iteration program is safe)
         chunkable = ((CH >= 2 or est_iter_s <= 40.0)
                      and F * B <= _CHUNK_FB_LIMIT)
+    if chunkable:
+        # VERDICT r3 #5: the chunk program's ONE-TIME remote compile scales
+        # with program width (~K·F·B) and can dominate a short run (Epsilon
+        # 20-tree acceptance: +204 s of compile for 204 s of training).
+        # Skip chunking when the estimated total work is small next to the
+        # estimated compile SURPLUS over the per-iteration path's own
+        # compile.  The per-MAC work model here is separate from the
+        # watchdog's est_iter_s above, which deliberately over-estimates
+        # (safety); this one aims at the middle of the measured range so
+        # the comparison is fair.  DRYAD_CHUNK=1/0 forces/disables the
+        # chunk path (bench.py pins =1 so the 2-/8-tree marginal arms
+        # measure the long-run chunked steady state); unset keeps the
+        # deterministic (params, shapes) heuristic.
+        _force = os.environ.get("DRYAD_CHUNK", "")
+        if _force in ("0", "1"):
+            chunkable = _force == "1"
+        elif plat != "cpu":
+            # remote/accelerator compile only — on the CPU backend (tests,
+            # local runs) compile is cheap and chunking always pays
+            compile_surplus = 15.0 + 4.5e-4 * K * F * B
+            # FULL-run work, not the remaining segment: path choice must be
+            # a pure function of (params, shapes) or a resumed run could
+            # take a different program than the uninterrupted one and break
+            # the resume bit-identity invariant (fusion-shape tolerance).
+            # est_for_ch, not est_iter_mac: lambdarank's λ pass is
+            # invisible to the MAC model (see chunk sizing above)
+            chunkable = (T // K) * est_for_ch > compile_surplus
     if chunkable:
         import time as _time
 

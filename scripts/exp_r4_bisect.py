@@ -124,13 +124,16 @@ def main():
     loop_time("plan+...+pack_weights", pfx_pack, sel, rec)
     loop_time("FULL hist_from_plan (records)", pfx_full, sel, rec)
 
-    # non-records variant for reference (what profile_plan measured)
-    def pfx_full_norec(s, ss):
+    # non-records variant for reference (what profile_plan measured);
+    # Xb/g/h ride as ARGUMENTS — as closure constants the 280 MB matrix
+    # blows the remote-compile request limit (HTTP 413)
+    def pfx_full_norec(s, ss, X, gg, hh):
         buf, tl, tf = tile_plan(psel(s, ss), N, P, T, rows_bound=bound)
-        hist = hist_from_plan(Xb, g, h, buf, tl, tf, P, B, platform=plat,
+        hist = hist_from_plan(X, gg, hh, buf, tl, tf, P, B, platform=plat,
                               records=None)
         return hist[0, 0, 0, 0] * 1e-30
-    loop_time("FULL hist_from_plan (no records)", pfx_full_norec, sel)
+    loop_time("FULL hist_from_plan (no records)", pfx_full_norec, sel, Xb,
+              g, h)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,68 @@
+"""Multiclass root-path policy measurement (VERDICT r3 #8): shared-plan
+XLA classes-builder (ONE (2K+1)-row pass) vs K separate masked Pallas
+passes, at Covertype shape for K in {3, 7}.  Stall-robust: fori-loop
+methodology + 3 repeats per arm, min taken (stalls only add).
+
+Usage: PYTHONPATH=... python scripts/exp_r4_roots.py [rows] [reps]
+"""
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dryad_tpu.engine.histogram import build_hist_classes
+from dryad_tpu.engine.pallas_hist import build_hist_pallas
+
+
+def main():
+    N = int(sys.argv[1]) if len(sys.argv) > 1 else 581_000
+    K_REP = int(sys.argv[2]) if len(sys.argv) > 2 else 5
+    F, B = 54, 256
+    rng = np.random.default_rng(0)
+    plat = jax.devices()[0].platform
+    print(f"rows={N} F={F} B={B} reps={K_REP} device={jax.devices()[0]}",
+          flush=True)
+
+    Xb = jnp.asarray(rng.integers(1, B, size=(N, F), dtype=np.uint8))
+    bag = jnp.ones((N,), bool)
+
+    def loop_time(tag, step, *arrays):
+        f = jax.jit(lambda s0, *a: jax.lax.fori_loop(
+            0, K_REP, lambda i, s: step(s, *a), s0))
+        _ = float(f(jnp.float32(0.0), *arrays))
+        best = None
+        for _ in range(3):
+            t0 = time.perf_counter()
+            _ = float(f(jnp.float32(0.0), *arrays))
+            dt = (time.perf_counter() - t0) / K_REP
+            best = dt if best is None else min(best, dt)
+        print(f"{tag:44s} {best*1e3:9.1f} ms (min of 3)", flush=True)
+        return best
+
+    for K in (3, 7):
+        g = jnp.asarray(rng.normal(size=(N, K)).astype(np.float32))
+        h = jnp.asarray(rng.uniform(0.1, 1.0, size=(N, K)).astype(np.float32))
+
+        def xla_shared(s, gg, hh):
+            roots = build_hist_classes(Xb, gg + s, hh, bag, B,
+                                       rows_per_chunk=65536,
+                                       precision="exact")
+            return roots[0, 0, 0, 0] * 1e-30
+
+        def pallas_k(s, gg, hh):
+            acc = jnp.float32(0.0)
+            for k in range(K):
+                hist = build_hist_pallas(Xb, gg[:, k] + s, hh[:, k], bag, B,
+                                         platform=plat)
+                acc = acc + hist[0, 0, 0] * 1e-30
+            return acc
+
+        loop_time(f"K={K} shared-plan XLA classes root", xla_shared, g, h)
+        loop_time(f"K={K} {K}x masked Pallas roots", pallas_k, g, h)
+
+
+if __name__ == "__main__":
+    main()

@@ -135,13 +135,15 @@ def main():
                                                 n_tiles, T, B))
     Wt = jax.block_until_ready(_pack_weights(ght[:, :, 0], ght[:, :, 1], valid))
 
-    def kern(s, xt, wt, tl, tf):
+    tile_skip = jnp.zeros_like(tile_leaf)
+
+    def kern(s, xt, wt, tl, tf, sk):
         hist = _hist_tiles(xt, wt + s.astype(jnp.bfloat16), tl,
-                           tf, num_cols=P, total_bins=B,
+                           tf, sk, num_cols=P, total_bins=B,
                            num_features=F, platform=plat)
         return hist[0, 0, 0, 0] * 1e-30
     loop_time("_hist_tiles kernel alone (i32 tiles)", kern, Xt, Wt,
-              tile_leaf, tile_first)
+              tile_leaf, tile_first, tile_skip)
 
     # ---- whole current pipeline for reference ------------------------------
     from dryad_tpu.engine.histogram import build_hist_segmented

@@ -177,3 +177,58 @@ def test_natural_order_multislot_matches_oracle():
     np.testing.assert_array_equal(got[:P, 2], want[:, 2])
     np.testing.assert_allclose(got[:P], want, rtol=1e-5, atol=1e-4)
     assert np.all(got[P:] == 0)   # unused slots stay empty
+
+
+def test_tile_plan_aligned_matches_tile_plan():
+    """The pad-injected aligned sort must reproduce the generic plan
+    VALUE-IDENTICALLY (buf, tile_leaf, tile_first) — empty slots, dropped
+    rows, a full-coverage slot, and a rows_bound all exercised — so every
+    downstream histogram program is unchanged."""
+    from dryad_tpu.engine.pallas_hist import (
+        _TILE_ROWS, tile_plan, tile_plan_aligned,
+    )
+
+    rng = np.random.default_rng(21)
+    T = _TILE_ROWS
+    for N, P, bound in ((3000, 6, None), (5000, 4, 2501), (T + 3, 3, None)):
+        sel_np = rng.integers(0, P + 2, size=N).astype(np.int32)
+        sel_np = np.where(sel_np <= P, sel_np, P)   # P = dropped
+        sel_np[sel_np == 1] = 0                     # slot 1 empty
+        if bound is not None:
+            # keep the selection under the claimed bound
+            keep = np.cumsum(sel_np < P) <= bound
+            sel_np = np.where(keep, sel_np, P)
+            total = (sel_np < P).sum()
+            assert total <= bound
+        counts = np.bincount(sel_np[sel_np < P], minlength=P)[:P]
+        sel = jnp.asarray(sel_np)
+        cnt = jnp.asarray(counts.astype(np.int32))
+        b0, l0, f0 = tile_plan(sel, N, P, T, rows_bound=bound)
+        b1, l1, f1 = tile_plan_aligned(sel, cnt, N, P, T, rows_bound=bound)
+        np.testing.assert_array_equal(np.asarray(b0), np.asarray(b1))
+        np.testing.assert_array_equal(np.asarray(l0), np.asarray(l1))
+        np.testing.assert_array_equal(np.asarray(f0), np.asarray(f1))
+
+
+def test_segmented_sel_counts_bitwise():
+    """sel_counts= (the aligned-plan fast path) must reproduce the generic
+    plan path BITWISE, with and without a records table."""
+    from dryad_tpu.engine.pallas_hist import make_records
+
+    rng = np.random.default_rng(22)
+    N, F, B, P = 4000, 6, 32, 5
+    Xb = jnp.asarray(rng.integers(0, B, size=(N, F)).astype(np.uint8))
+    g = jnp.asarray(rng.normal(size=N).astype(np.float32))
+    h = jnp.asarray(rng.uniform(0.1, 1.0, size=N).astype(np.float32))
+    sel_np = rng.integers(0, P + 1, size=N).astype(np.int32)
+    sel = jnp.asarray(sel_np)
+    cnt = jnp.asarray(np.bincount(sel_np[sel_np < P],
+                                  minlength=P)[:P].astype(np.int32))
+    plain = build_hist_segmented_pallas(Xb, g, h, sel, P, B)
+    fast = build_hist_segmented_pallas(Xb, g, h, sel, P, B, sel_counts=cnt)
+    np.testing.assert_array_equal(np.asarray(plain), np.asarray(fast))
+    rec = make_records(Xb, g, h)
+    plain_r = build_hist_segmented_pallas(Xb, g, h, sel, P, B, records=rec)
+    fast_r = build_hist_segmented_pallas(Xb, g, h, sel, P, B, records=rec,
+                                         sel_counts=cnt)
+    np.testing.assert_array_equal(np.asarray(plain_r), np.asarray(fast_r))
