@@ -328,6 +328,7 @@ def build_hist_segmented(
     platform: str | None = None,
     records: jnp.ndarray | None = None,
     sel_counts: jnp.ndarray | None = None,
+    stage_gather: bool = True,
 ) -> jnp.ndarray:
     """Histograms for ``num_cols`` leaves -> (P, 3, F, B) fp32, O(N·F·B) work.
 
@@ -349,7 +350,7 @@ def build_hist_segmented(
             return pallas_hist.build_hist_segmented_pallas(
                 Xb, g, h, sel, num_cols, total_bins, axis_name=axis_name,
                 rows_bound=rows_bound, platform=platform, records=records,
-                sel_counts=sel_counts,
+                sel_counts=sel_counts, stage_gather=stage_gather,
             )
     N, F = Xb.shape
     B = int(total_bins)

@@ -288,6 +288,9 @@ def grow_tree_leafwise_batched(
                     rows_bound=(N // 2 + 1) if bound_ok else None,
                     platform=platform, records=records,
                     sel_counts=small_cnt,
+                    # deep caps leave most expansion slots empty — exactly
+                    # where staged gather prefixes pay (see levelwise.py)
+                    stage_gather=L < Pf,
                 )
             hist_large = st["hists"][jnp.minimum(jarr, Pf - 1)] - hist_small
             ls = left_smaller[:, None, None, None]

@@ -163,11 +163,16 @@ def grow_tree_levelwise(
     # (pathological remote compile times); a single fori_loop must run EVERY
     # level at the deepest level's width P (the per-level cost of the
     # candidate machinery, tile plan and vmapped split scan all scale with
-    # P).  Two phases split the difference: shallow levels (<= 2^3 leaves)
-    # run at width 8, deep levels at the full width — one extra traced body,
-    # most of the narrow-level savings.
+    # P).  Two phases split the difference: shallow levels run narrow, deep
+    # levels at the full width — one extra traced body, most of the
+    # narrow-level savings.  The switch sits at depth 5 (<= 16 candidates)
+    # when the natural-order pass is live so level 4 rides it too
+    # (_NAT_SLOTS = 16; sort+gather-free beats the plan path ~70 ms/level
+    # at 10M), else at the measured depth-4 boundary.
     P_full = min(1 << (depth_cap - 1), L - 1)
-    d_switch = 4 if (depth_cap > 4 and P_full > 8) else depth_cap
+    d_cut = 5 if nat_tiles is not None else 4
+    d_switch = d_cut if (depth_cap > d_cut and P_full > (1 << (d_cut - 1))) \
+        else depth_cap
     P_narrow = min(1 << (d_switch - 1), L - 1)
 
     st = {
@@ -358,6 +363,11 @@ def grow_tree_levelwise(
                     rows_bound=(N // 2 + 1) if bound_ok else None,
                     platform=platform, records=records,
                     sel_counts=small_cnt,
+                    # staged prefixes only pay when the leaf budget caps
+                    # deep levels (fills provably collapse); a full tree
+                    # keeps every prefix ~100% and the extra gather
+                    # branches only bloat (remote) compile
+                    stage_gather=(L - 1) < (1 << (depth_cap - 1)),
                 )
             if p.hist_subtraction:
                 hist_large = hists[sj] - hist_small

@@ -416,8 +416,10 @@ def tile_plan_aligned(sel: jnp.ndarray, counts: jnp.ndarray, N: int, P: int,
     placement, same static shapes), so every downstream program is
     unchanged — tests pin the equality.
 
-    Admissibility (callers gate): N + P*T <= 2**24 (row field), P <= 254
-    (slot 0xFF marks inert injected keys), and ``counts`` must be exact —
+    Admissibility (callers gate): N <= 2**24 - 1 (the row field stores
+    row ids < N plus the sentinel N itself — pad keys reuse the sentinel,
+    never values past it), P <= 254 (slot 0xFF marks inert injected
+    keys), and ``counts`` must be exact —
     a wrong count silently misaligns the plan (the generic path's safety
     squeeze has nothing to squeeze here), which is why only growers that
     read counts off their own histograms may pass them.
@@ -496,6 +498,7 @@ def hist_from_plan(
     axis_name: str | None = None,
     platform: str | None = None,
     records: jnp.ndarray | None = None,
+    stage_gather: bool = True,
 ) -> jnp.ndarray:
     """Histogram leaf-grouped rows given a precomputed tile plan.
 
@@ -529,8 +532,12 @@ def hist_from_plan(
         # covering the live tiles at runtime; zero rows carry zero weights
         # and bin 0, contributing nothing (same sentinel algebra as pads).
         # Single-device only: under shard_map the predicate would vary by
-        # shard (vma) and every shard must run one program.
-        if axis_name is None and n_tiles >= 8:
+        # shard (vma) and every shard must run one program.  Callers pass
+        # stage_gather=False when the leaf budget fills every level (a
+        # full tree keeps the prefix at ~100% and the cond's three gather
+        # kernels only bloat compile — Epsilon-width programs measured
+        # minutes of extra remote compile for zero runtime win).
+        if stage_gather and axis_name is None and n_tiles >= 8:
             n_pref = jnp.max(jnp.where(
                 live, jnp.arange(1, n_tiles + 1, dtype=jnp.int32), 0))
 
@@ -595,6 +602,7 @@ def build_hist_segmented_pallas(
     platform: str | None = None,
     records: jnp.ndarray | None = None,
     sel_counts: jnp.ndarray | None = None,
+    stage_gather: bool = True,
 ) -> jnp.ndarray:
     """Per-leaf histograms for a whole tree level -> (P, 3, F, B) f32.
 
@@ -617,6 +625,7 @@ def build_hist_segmented_pallas(
     return hist_from_plan(
         Xb, g, h, buf, tile_leaf, tile_first, num_cols, total_bins,
         axis_name=axis_name, platform=platform, records=records,
+        stage_gather=stage_gather,
     )
 
 # ---------------------------------------------------------------------------
