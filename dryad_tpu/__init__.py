@@ -117,10 +117,13 @@ def predict(
     raw_score: bool = False,
     backend: str = "cpu",
     num_iteration: Optional[int] = None,
+    pred_leaf: bool = False,
+    pred_contrib: bool = False,
 ) -> np.ndarray:
     """Predict on raw features through the booster's frozen bin mapper."""
     return booster.predict(
-        X, raw_score=raw_score, backend=backend, num_iteration=num_iteration
+        X, raw_score=raw_score, backend=backend, num_iteration=num_iteration,
+        pred_leaf=pred_leaf, pred_contrib=pred_contrib
     )
 
 

@@ -53,6 +53,7 @@ class Booster:
         gain: Optional[np.ndarray] = None,
         train_state: Optional[dict] = None,
         default_left: Optional[np.ndarray] = None,
+        cover: Optional[np.ndarray] = None,
     ):
         self.params = params
         self.mapper = mapper
@@ -69,6 +70,10 @@ class Booster:
         # per-node split gain (0 at leaves); optional for old checkpoints
         self.gain = (np.zeros_like(value) if gain is None
                      else np.asarray(gain, np.float32))
+        # per-node training row count ("cover") — feeds exact TreeSHAP
+        # (pred_contrib); optional for models saved before round 4
+        self.cover = (np.zeros_like(value) if cover is None
+                      else np.asarray(cover, np.float32))
         # per-node learned missing direction (numerical splits; True = bin 0
         # goes left).  Old models default to all-True — the historic rule.
         self.default_left = (np.ones(feature.shape, bool) if default_left is None
@@ -100,6 +105,7 @@ class Booster:
             "cat_bitset": self.cat_bitset,
             "gain": self.gain,
             "default_left": self.default_left,
+            "cover": self.cover,
         }
 
     # ---- predict -----------------------------------------------------------
@@ -110,11 +116,15 @@ class Booster:
         raw_score: bool = False,
         backend: str = "cpu",
         num_iteration: Optional[int] = None,
+        pred_leaf: bool = False,
+        pred_contrib: bool = False,
     ) -> np.ndarray:
         """Predict on raw features: bin through the frozen mapper, traverse."""
         X_binned = self.mapper.transform(np.asarray(X, np.float32))
         return self.predict_binned(
-            X_binned, raw_score=raw_score, backend=backend, num_iteration=num_iteration
+            X_binned, raw_score=raw_score, backend=backend,
+            num_iteration=num_iteration, pred_leaf=pred_leaf,
+            pred_contrib=pred_contrib,
         )
 
     def predict_binned(
@@ -125,7 +135,16 @@ class Booster:
         backend: str = "cpu",
         num_iteration: Optional[int] = None,
         pred_leaf: bool = False,
+        pred_contrib: bool = False,
     ) -> np.ndarray:
+        if pred_contrib:
+            # exact TreeSHAP on the recorded per-node covers -> (N, F+1)
+            # per output (last column = bias); contributions sum to the raw
+            # prediction exactly (cpu/shap.py)
+            from dryad_tpu.cpu.shap import predict_contrib
+
+            return predict_contrib(self, X_binned,
+                                   num_iteration=num_iteration)
         if pred_leaf:
             from dryad_tpu.cpu.predict import predict_tree_leaves
 
@@ -176,6 +195,7 @@ class Booster:
             is_cat=self.is_cat,
             cat_bitset=self.cat_bitset,
             gain=self.gain,
+            cover=self.cover,
             default_left=self.default_left,
             init_score=self.init_score,
             meta=np.frombuffer(
@@ -219,6 +239,7 @@ class Booster:
                 meta["max_depth_seen"],
                 meta.get("best_iteration", -1),
                 gain=z["gain"] if "gain" in z.files else None,
+                cover=z["cover"] if "cover" in z.files else None,
                 train_state=meta.get("train_state"),
                 default_left=z["default_left"] if "default_left" in z.files else None,
             )
@@ -287,4 +308,5 @@ def empty_tree_arrays(num_total_trees: int, max_nodes: int) -> dict[str, np.ndar
         "cat_bitset": np.zeros((num_total_trees, max_nodes, CAT_WORDS), np.uint32),
         "gain": np.zeros((num_total_trees, max_nodes), np.float32),
         "default_left": np.ones((num_total_trees, max_nodes), bool),
+        "cover": np.zeros((num_total_trees, max_nodes), np.float32),
     }

@@ -14,7 +14,8 @@ import dataclasses
 import json
 from typing import Any, Mapping, Sequence
 
-OBJECTIVES = ("binary", "multiclass", "regression", "lambdarank")
+OBJECTIVES = ("binary", "multiclass", "regression", "lambdarank",
+              "l1", "huber", "fair", "quantile", "poisson")
 GROWTH_POLICIES = ("leafwise", "depthwise")
 
 # Alias table so configs written against common GBDT engines keep working.
@@ -54,6 +55,11 @@ _OBJECTIVE_ALIASES = {
     "l2": "regression",
     "mse": "regression",
     "reg:squarederror": "regression",
+    "mae": "l1",
+    "regression_l1": "l1",
+    "reg:absoluteerror": "l1",
+    "reg:quantileerror": "quantile",
+    "count:poisson": "poisson",
     "lambdamart": "lambdarank",
     "rank:ndcg": "lambdarank",
 }
@@ -119,6 +125,12 @@ class Params:
     eval_period: int = 1
     # binary: multiply the positive class's grad/hess (imbalanced data)
     scale_pos_weight: float = 1.0
+    # Robust / count regression family (LightGBM conventions): ``alpha``
+    # is the Huber delta AND the quantile level; ``fair_c`` the Fair-loss
+    # scale; ``poisson_max_delta_step`` the Poisson hessian stabilizer
+    alpha: float = 0.9
+    fair_c: float = 1.0
+    poisson_max_delta_step: float = 0.7
     # LambdaMART
     sigmoid: float = 1.0
     ndcg_at: int = 10
@@ -184,6 +196,15 @@ class Params:
             raise ValueError("subsample/colsample must be in (0, 1]")
         if not (self.scale_pos_weight > 0.0):
             raise ValueError("scale_pos_weight must be > 0")
+        if self.objective == "quantile" and not (0.0 < self.alpha < 1.0):
+            raise ValueError("quantile objective needs alpha in (0, 1)")
+        if self.objective == "huber" and not (self.alpha > 0.0):
+            raise ValueError("huber objective needs alpha (delta) > 0")
+        if self.objective == "fair" and not (self.fair_c > 0.0):
+            raise ValueError("fair objective needs fair_c > 0")
+        if (self.objective == "poisson"
+                and not (self.poisson_max_delta_step >= 0.0)):
+            raise ValueError("poisson_max_delta_step must be >= 0")
         if self.eval_period < 1:
             raise ValueError("eval_period must be >= 1")
         if self.unbounded_depth not in ("auto", "exact"):

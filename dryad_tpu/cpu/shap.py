@@ -1,0 +1,185 @@
+"""Exact TreeSHAP feature contributions (``predict(pred_contrib=True)``).
+
+Implements the polynomial-time exact SHAP algorithm for tree ensembles
+(Lundberg et al., "Consistent Individualized Feature Attribution for Tree
+Ensembles": the EXTEND/UNWIND path-weight recursion), using the per-node
+training row counts ("cover") the round-4 tree format records.  For every
+row, the returned (F + 1) vector satisfies the SHAP efficiency property
+EXACTLY: contributions + bias column == raw prediction (pinned by test).
+
+Complexity O(rows · trees · leaves · depth²) in Python — intended for
+explanation-sized batches (hundreds to a few thousand rows), not bulk
+scoring.  Row routing decisions (numeric thresholds, learned missing
+directions, categorical bitsets) are precomputed VECTORIZED per node with
+the same rules as ``cpu/predict.py``, so the recursion itself never
+re-derives routing.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def _node_decisions(trees: dict, t: int, Xb: np.ndarray) -> np.ndarray:
+    """(N, M) bool: would row n go LEFT at node m (same rules as predict)."""
+    feature = trees["feature"][t]
+    threshold = trees["threshold"][t]
+    is_cat = trees["is_cat"][t]
+    cat_bs = trees["cat_bitset"][t]
+    dleft = (trees["default_left"][t] if "default_left" in trees
+             else np.ones_like(feature, bool))
+    N = Xb.shape[0]
+    M = feature.shape[0]
+    f = np.maximum(feature, 0)
+    bins = Xb[:, f].astype(np.int64)                    # (N, M)
+    go_left = bins <= threshold[None, :]
+    go_left &= dleft[None, :] | (bins != 0)
+    word = cat_bs[np.arange(M)[None, :],
+                  np.minimum(bins >> 5, cat_bs.shape[1] - 1)]
+    cat_left = (word >> (bins & 31).astype(np.uint32)) & 1 > 0
+    return np.where(is_cat[None, :], cat_left, go_left)
+
+
+def _tree_shap_one(feature, left, right, value, cover, go_left_row, phi):
+    """Accumulate one tree's exact SHAP values for one row into ``phi``.
+
+    Path state arrays are preallocated to depth+2 and passed down by
+    copy-on-extend (the textbook algorithm); ``d`` indexes the path depth.
+    """
+    def extend(pd, pz, po, pw, z, o, i):
+        d = pd.shape[0]
+        pd2 = np.empty((d + 1,), np.int32)
+        pz2 = np.empty((d + 1,), np.float64)
+        po2 = np.empty((d + 1,), np.float64)
+        pw2 = np.empty((d + 1,), np.float64)
+        pd2[:d], pz2[:d], po2[:d], pw2[:d] = pd, pz, po, pw
+        pd2[d], pz2[d], po2[d] = i, z, o
+        pw2[d] = 1.0 if d == 0 else 0.0
+        for j in range(d - 1, -1, -1):
+            pw2[j + 1] += o * pw2[j] * (j + 1) / (d + 1)
+            pw2[j] = z * pw2[j] * (d - j) / (d + 1)
+        return pd2, pz2, po2, pw2
+
+    def unwound_sum(pd, pz, po, pw, i):
+        d = pd.shape[0] - 1
+        o, z = po[i], pz[i]
+        total = 0.0
+        nxt = pw[d]
+        for j in range(d - 1, -1, -1):
+            if o != 0.0:
+                tmp = nxt * (d + 1) / ((j + 1) * o)
+                total += tmp
+                nxt = pw[j] - tmp * z * (d - j) / (d + 1)
+            else:
+                total += pw[j] / (z * (d - j) / (d + 1))
+        return total
+
+    def unwind(pd, pz, po, pw, i):
+        d = pd.shape[0] - 1
+        o, z = po[i], pz[i]
+        pd2 = np.delete(pd, i)
+        pz2 = np.delete(pz, i)
+        po2 = np.delete(po, i)
+        nxt = pw[d]
+        w = pw.copy()
+        for j in range(d - 1, -1, -1):
+            if o != 0.0:
+                tmp = nxt * (d + 1) / ((j + 1) * o)
+                nxt = w[j] - tmp * z * (d - j) / (d + 1)
+                w[j] = tmp
+            else:
+                w[j] = w[j] * (d + 1) / (z * (d - j))
+        # weights are positional on the SHORTENED path — no index shift
+        # (reference tree_shap implementation)
+        return pd2, pz2, po2, w[:d]
+
+    def recurse(node, pd, pz, po, pw, z, o, i):
+        pd, pz, po, pw = extend(pd, pz, po, pw, z, o, i)
+        if feature[node] < 0:                            # leaf
+            v = float(value[node])
+            for k in range(1, pd.shape[0]):
+                s = unwound_sum(pd, pz, po, pw, k)
+                phi[pd[k]] += s * (po[k] - pz[k]) * v
+            return
+        hot = left[node] if go_left_row[node] else right[node]
+        cold = right[node] if go_left_row[node] else left[node]
+        cn = max(float(cover[node]), 1e-12)
+        iz, io = 1.0, 1.0
+        # if this feature already appears on the path, unwind it first
+        pathf = np.nonzero(pd[1:] == feature[node])[0]
+        if pathf.size:
+            k = int(pathf[0]) + 1
+            iz, io = float(pz[k]), float(po[k])
+            pd, pz, po, pw = unwind(pd, pz, po, pw, k)
+        recurse(hot, pd, pz, po, pw,
+                iz * float(cover[hot]) / cn, io, int(feature[node]))
+        recurse(cold, pd, pz, po, pw,
+                iz * float(cover[cold]) / cn, 0.0, int(feature[node]))
+
+    recurse(0,
+            np.empty((0,), np.int32), np.empty((0,), np.float64),
+            np.empty((0,), np.float64), np.empty((0,), np.float64),
+            1.0, 1.0, -1)
+
+
+def predict_contrib(booster, Xb: np.ndarray,
+                    num_iteration: int | None = None) -> np.ndarray:
+    """Exact SHAP values -> (N, K, F+1) (squeezed to (N, F+1) for K=1).
+
+    Column F is the bias (expected value): init_score + Σ_t cover-weighted
+    mean leaf value; contributions + bias == raw prediction exactly (up to
+    f64 summation of f32 leaf values).
+    """
+    K = booster.num_outputs
+    N = Xb.shape[0]
+    F = booster.mapper.num_features
+    if num_iteration is None:
+        n_iter = (booster.best_iteration if booster.best_iteration > 0
+                  else booster.num_iterations)
+    else:
+        n_iter = min(num_iteration, booster.num_iterations)
+    trees = booster.tree_arrays()
+    # EVERY used tree needs a positive root cover — a booster resumed from
+    # a pre-cover checkpoint has real covers only on its newer trees, and
+    # zero covers would silently divide to NaN in the recursion
+    root_covers = np.asarray(trees["cover"])[: n_iter * K, 0]
+    if root_covers.size and float(root_covers.min()) <= 0:
+        raise ValueError(
+            "pred_contrib needs per-node covers on every tree; this model "
+            "(or the checkpoint it resumed from) was saved by a version "
+            "that did not record them — retrain to enable SHAP")
+    out = np.zeros((N, K, F + 1), np.float64)
+    out[:, :, F] += np.asarray(booster.init_score, np.float64)[None, :]
+    depth_bound = max(booster.max_depth_seen, 1)
+
+    for t in range(n_iter * K):
+        k = t % K
+        feature = trees["feature"][t]
+        left, right = trees["left"][t], trees["right"][t]
+        value = trees["value"][t]
+        cover = trees["cover"][t].astype(np.float64)
+        # expected value of this tree under the training distribution:
+        # cover-weighted mean over leaves (computed once, iteratively)
+        ev = _expected_value(feature, left, right, value, cover, depth_bound)
+        out[:, k, F] += ev
+        decisions = _node_decisions(trees, t, Xb)
+        for n in range(N):
+            _tree_shap_one(feature, left, right, value, cover,
+                           decisions[n], out[n, k])
+    return out[:, 0] if K == 1 else out
+
+
+def _expected_value(feature, left, right, value, cover, depth_bound):
+    """Cover-weighted expectation of the tree's output at the root."""
+    M = feature.shape[0]
+    ev = value.astype(np.float64).copy()
+    # propagate bottom-up: depth_bound passes of child mixing
+    for _ in range(depth_bound):
+        internal = feature >= 0
+        cl = cover[np.maximum(left, 0)]
+        cr = cover[np.maximum(right, 0)]
+        tot = np.maximum(cl + cr, 1e-12)
+        mixed = (cl * ev[np.maximum(left, 0)]
+                 + cr * ev[np.maximum(right, 0)]) / tot
+        ev = np.where(internal, mixed, ev)
+    return float(ev[0])

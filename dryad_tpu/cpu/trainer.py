@@ -187,6 +187,7 @@ class _TreeGrower:
         # same way, keeping parent/child stat bookkeeping backend-identical)
         G0, H0, C0 = float(hist0[0, 0].sum()), float(hist0[1, 0].sum()), float(rows.size)
         leaf_node[0], leaf_rows[0], leaf_hist[0] = 0, rows, hist0
+        out["cover"][t, 0] = C0
         leaf_G[0], leaf_H[0] = G0, H0
         leaf_split[0] = self._best(hist0, G0, H0, C0, 0, max_depth, feat_mask,
                                    leaf_lo[0], leaf_hi[0])
@@ -239,6 +240,8 @@ class _TreeGrower:
             # child stats from the parent-histogram prefix (canonical contract)
             GL, HL, CL = split.g_left, split.h_left, split.c_left
             GR, HR, CR = pG - GL, pH - HL, float(prows.size) - CL
+            out["cover"][t, left_id] = CL
+            out["cover"][t, right_id] = CR
 
             # monotone bounds for the children: on a ±1 split feature the
             # midpoint of the clamped child outputs separates the subtrees
@@ -484,4 +487,5 @@ def _make_booster(p, mapper, out, T, init, max_depth_seen, best_iteration,
         gain=out["gain"][:T],
         train_state={"best_value": best_value, "stale": int(stale)},
         default_left=out["default_left"][:T],
+        cover=out["cover"][:T],
     )

@@ -86,7 +86,7 @@ def grow_sharded(params: Params, total_bins: int, has_cat: bool,
     tree_specs = {
         "feature": rep, "threshold": rep, "left": rep, "right": rep,
         "value": rep, "gain": rep, "is_cat": rep, "cat_bitset": rep,
-        "default_left": rep, "max_depth": rep,
+        "default_left": rep, "cover": rep, "max_depth": rep,
     }
     extra = () if bundled_mask is None else (bundled_mask,)
     extra += () if root_hist is None else (root_hist,)

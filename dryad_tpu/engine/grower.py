@@ -277,6 +277,7 @@ def grow_tree(
         "right": jnp.zeros((M,), jnp.int32),
         "value": jnp.zeros((M,), jnp.float32),
         "gain": jnp.zeros((M,), jnp.float32),
+        "cover": jnp.zeros((M,), jnp.float32).at[0].set(C0),
         "is_cat": jnp.zeros((M,), bool),
         "cat_mask_nodes": jnp.zeros((M, root.cat_mask.shape[0]), bool),
         "node_dleft": jnp.ones((M,), bool),
@@ -322,6 +323,7 @@ def grow_tree(
         new_r = jnp.int32(k + 1)
 
         gain_arr = st["gain"].at[parent].set(st["slot_gain"][s])
+        cover_arr = st["cover"].at[left_id].set(CL).at[right_id].set(CR)
         feature = st["feature"].at[parent].set(sf)
         threshold = st["threshold"].at[parent].set(jnp.where(cat_split, 0, thr))
         left = st["left"].at[parent].set(left_id)
@@ -386,6 +388,7 @@ def grow_tree(
             "right": right,
             "value": st["value"],
             "gain": gain_arr,
+            "cover": cover_arr,
             "is_cat": is_cat_arr,
             "cat_mask_nodes": cat_nodes,
             "node_dleft": node_dleft,
@@ -419,6 +422,7 @@ def grow_tree(
         "right": st["right"],
         "value": value,
         "gain": st["gain"],
+        "cover": st["cover"],
         "is_cat": st["is_cat"],
         "cat_bitset": cat_bitset,
         "default_left": st["node_dleft"],

@@ -372,6 +372,7 @@ def grow_tree_leafwise_batched(
     nd_dleft = exp_st["nd_dleft"]
     nd_catmask = exp_st["nd_catmask"]
     nd_G, nd_H = exp_st["nd_G"], exp_st["nd_H"]
+    nd_C_sel = exp_st["nd_C"]
     nd_lo, nd_hi = exp_st["nd_lo"], exp_st["nd_hi"]
 
     sel_st = {
@@ -383,6 +384,7 @@ def grow_tree_leafwise_batched(
         "feature": jnp.full((M,), -1, jnp.int32),
         "threshold": jnp.zeros((M,), jnp.int32),
         "gain": jnp.zeros((M,), jnp.float32),
+        "cover": jnp.zeros((M,), jnp.float32).at[0].set(nd_C_sel[1]),
         "left": jnp.zeros((M,), jnp.int32),
         "right": jnp.zeros((M,), jnp.int32),
         "is_cat": jnp.zeros((M,), bool),
@@ -417,6 +419,8 @@ def grow_tree_leafwise_batched(
             "threshold": st["threshold"].at[parent].set(
                 jnp.where(cat_split, 0, nd_thresh[n])),
             "gain": st["gain"].at[parent].set(st["slot_gain"][s]),
+            "cover": st["cover"].at[left_id].set(nd_C_sel[2 * n])
+                                .at[right_id].set(nd_C_sel[2 * n + 1]),
             "left": st["left"].at[parent].set(left_id),
             "right": st["right"].at[parent].set(right_id),
             "is_cat": st["is_cat"].at[parent].set(cat_split),
@@ -475,6 +479,7 @@ def grow_tree_leafwise_batched(
         "is_cat": sel_st["is_cat"],
         "cat_bitset": cat_bitset,
         "default_left": sel_st["node_dleft"],
+        "cover": sel_st["cover"],
         "max_depth": sel_st["max_depth"],
         "row_leaf": leaf_of[jnp.clip(exp_st["row_node"], 0, HN - 1)],
     }

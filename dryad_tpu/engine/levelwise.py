@@ -146,6 +146,7 @@ def grow_tree_levelwise(
     sp_dleft = jnp.ones((L,), bool).at[0].set(root.default_left)
     hists = jnp.zeros((L, 3, F, B), jnp.float32).at[0].set(hist0)
 
+    cover_arr = jnp.zeros((M,), jnp.float32).at[0].set(C0)
     feature = jnp.full((M,), -1, jnp.int32)
     threshold = jnp.zeros((M,), jnp.int32)
     gain_arr = jnp.zeros((M,), jnp.float32)
@@ -185,7 +186,7 @@ def grow_tree_levelwise(
         "hists": hists,
         "feature": feature, "threshold": threshold, "gain": gain_arr,
         "left": left, "right": right, "is_cat": is_cat_arr,
-        "cat_nodes": cat_nodes, "node_dleft": node_dleft,
+        "cat_nodes": cat_nodes, "node_dleft": node_dleft, "cover": cover_arr,
         "num_nodes": num_nodes,
         "splits_done": splits_done, "max_depth": max_depth,
     }
@@ -243,6 +244,12 @@ def grow_tree_levelwise(
             )
             node_dleft = node_dleft.at[pidx].set(sp_dleft[sj] | cat_split,
                                                  mode="drop")
+            # per-node cover (training row count) for pred_contrib: the
+            # children's counts come off the parent-histogram prefix
+            cover_arr = st["cover"].at[
+                jnp.where(do, left_id, M)].set(CL, mode="drop")
+            cover_arr = cover_arr.at[
+                jnp.where(do, right_id, M)].set(CR, mode="drop")
 
             # ---- row partition: every splitting leaf in one vectorized pass -----
             # Two measured rules shape this block (exp_level_bisect.py, 10M):
@@ -441,7 +448,7 @@ def grow_tree_levelwise(
                 "hists": hists, "feature": feature, "threshold": threshold,
                 "gain": gain_arr, "left": left, "right": right,
                 "is_cat": is_cat_arr, "cat_nodes": cat_nodes,
-                "node_dleft": node_dleft,
+                "node_dleft": node_dleft, "cover": cover_arr,
                 "num_nodes": num_nodes, "splits_done": splits_done,
                 "max_depth": max_depth,
             }
@@ -480,6 +487,7 @@ def grow_tree_levelwise(
         "is_cat": st["is_cat"],
         "cat_bitset": cat_bitset,
         "default_left": st["node_dleft"],
+        "cover": st["cover"],
         "max_depth": st["max_depth"],
         # per-row leaf node id from the partition state (no re-traversal)
         "row_leaf": jnp.maximum(st["slot_node"], 0)[

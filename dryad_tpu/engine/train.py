@@ -38,7 +38,7 @@ from dryad_tpu.engine.predict import _accumulate, tree_leaves
 from dryad_tpu.objectives import get_objective
 
 _TREE_KEYS = ("feature", "threshold", "left", "right", "value", "is_cat",
-              "cat_bitset", "gain", "default_left")
+              "cat_bitset", "gain", "default_left", "cover")
 
 # widest (features * bins) program the chunked fori wrapper may compile.
 # Round 2 measured Epsilon-shaped (2000 x 256) chunk programs failing
@@ -409,6 +409,7 @@ def _empty_out_device(T: int, M: int, cat_words: int) -> dict:
         "cat_bitset": jnp.zeros((T, M, cat_words), jnp.uint32),
         "gain": jnp.zeros((T, M), jnp.float32),
         "default_left": jnp.ones((T, M), bool),
+        "cover": jnp.zeros((T, M), jnp.float32),
         "max_depth": jnp.zeros((T,), jnp.int32),
     }
 
@@ -428,6 +429,7 @@ def _materialize(p, mapper, out, T, init, max_depth_prev, best_iteration,
         gain=host["gain"],
         train_state={"best_value": best_value, "stale": int(stale)},
         default_left=host["default_left"],
+        cover=host["cover"],
     )
 
 

@@ -75,6 +75,15 @@ def mae(y_true: np.ndarray, y_pred: np.ndarray) -> float:
     return float(np.mean(np.abs(d)))
 
 
+def poisson_deviance(y_true: np.ndarray, raw_score: np.ndarray) -> float:
+    """Mean Poisson deviance from RAW (log-rate) scores: the y*log(y/mu)
+    term drops for y == 0 (its limit), mu = exp(raw)."""
+    y = np.asarray(y_true, np.float64)
+    mu = np.exp(np.asarray(raw_score, np.float64))
+    ylog = np.where(y > 0, y * np.log(np.maximum(y, 1e-300) / mu), 0.0)
+    return float(np.mean(2.0 * (ylog - (y - mu))))
+
+
 def dcg_at_k(rels: np.ndarray, k: int) -> float:
     rels = np.asarray(rels, np.float64)[:k]
     if rels.size == 0:
@@ -123,11 +132,17 @@ DEFAULT_METRIC = {
     "multiclass": "multi_logloss",
     "regression": "rmse",
     "lambdarank": "ndcg",
+    "l1": "mae",
+    "huber": "rmse",
+    "fair": "rmse",
+    "quantile": "mae",
+    "poisson": "poisson_deviance",
 }
 
 HIGHER_BETTER = {"auc": True, "ndcg": True, "accuracy": True, "error": False,
                  "binary_logloss": False, "multi_logloss": False,
-                 "rmse": False, "mse": False, "mae": False}
+                 "rmse": False, "mse": False, "mae": False,
+                 "poisson_deviance": False}
 
 
 def evaluate_raw(
@@ -162,6 +177,8 @@ def evaluate_raw(
         value = mse(y, s)
     elif name == "mae":
         value = mae(y, s)
+    elif name == "poisson_deviance":
+        value = poisson_deviance(y, s)
     elif name == "ndcg":
         if query_offsets is None:
             raise ValueError("ndcg requires query groups on the validation set")

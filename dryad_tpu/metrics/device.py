@@ -89,6 +89,13 @@ def mae_device(y, s):
     return jnp.mean(jnp.abs(y - s))
 
 
+def poisson_deviance_device(y, s):
+    """Mirror of metrics.poisson_deviance (raw log-rate scores)."""
+    mu = jnp.exp(s)
+    ylog = jnp.where(y > 0, y * jnp.log(jnp.maximum(y, 1e-30) / mu), 0.0)
+    return jnp.mean(2.0 * (ylog - (y - mu)))
+
+
 def _pad_queries(query_offsets: np.ndarray) -> tuple[np.ndarray, int]:
     """(Q, S) row-id scatter plan for per-query padded views; pad slots get
     row id N (out of range, gathered via mode='fill')."""
@@ -149,6 +156,8 @@ def eval_value(name, ndcg_at, y, raw_score, qids=None):
         return mse_device(y, s)
     if name == "mae":
         return mae_device(y, s)
+    if name == "poisson_deviance":
+        return poisson_deviance_device(y, s)
     if name == "ndcg":
         return ndcg_device(y, s, qids, ndcg_at)
     raise ValueError(f"unknown metric {name!r}")

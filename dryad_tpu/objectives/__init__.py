@@ -109,6 +109,219 @@ class Regression:
         return score
 
 
+def _weighted_percentile(y: np.ndarray, weight, q: float) -> float:
+    """Percentile of y at level q in [0, 1], weight-aware (sorted cumsum
+    convention — reduces to the lower-interpolation percentile unweighted).
+    Shared init-score helper for the robust-regression family."""
+    y = np.asarray(y, np.float64)
+    order = np.argsort(y, kind="mergesort")
+    ys = y[order]
+    w = (np.ones_like(ys) if weight is None
+         else np.asarray(weight, np.float64)[order])
+    cw = np.cumsum(w)
+    target = q * cw[-1]
+    idx = int(np.searchsorted(cw, target, side="left"))
+    return float(ys[min(idx, ys.size - 1)])
+
+
+class L1:
+    """Absolute error on raw scores.  Gradient sign(s - y), hessian 1
+    (LightGBM's formulation); leaf values are the regularized mean of
+    signs scaled by the learning rate — the per-leaf median renewal some
+    engines add is NOT performed (documented divergence; quantile/huber
+    cover the common robust cases with the same caveat)."""
+
+    name = "l1"
+    num_outputs = 1
+
+    @staticmethod
+    def init_score(y: np.ndarray, weight=None) -> float:
+        return _weighted_percentile(y, weight, 0.5)
+
+    @staticmethod
+    def grad_hess_np(score, y, weight=None):
+        g = np.sign(score - y).astype(np.float32)
+        h = np.ones_like(g)
+        if weight is not None:
+            g, h = g * weight, h * weight
+        return g, h
+
+    @staticmethod
+    def grad_hess_jax(score, y, weight=None):
+        import jax.numpy as jnp
+
+        g = jnp.sign(score - y)
+        h = jnp.ones_like(g)
+        if weight is not None:
+            g, h = g * weight, h * weight
+        return g, h
+
+    @staticmethod
+    def transform_np(score):
+        return score
+
+
+class Huber:
+    """Huber loss: squared near zero, linear past ``delta`` (params.alpha,
+    the LightGBM convention).  Gradient clips the residual at ±delta,
+    hessian stays 1 (the piecewise-zero true hessian would stall leaves)."""
+
+    name = "huber"
+    num_outputs = 1
+
+    def __init__(self, delta: float = 0.9):
+        self.delta = float(delta)
+
+    @staticmethod
+    def init_score(y: np.ndarray, weight=None) -> float:
+        return _weighted_percentile(y, weight, 0.5)
+
+    def grad_hess_np(self, score, y, weight=None):
+        r = (score - y).astype(np.float32)
+        d = np.float32(self.delta)
+        g = np.clip(r, -d, d)
+        h = np.ones_like(g)
+        if weight is not None:
+            g, h = g * weight, h * weight
+        return g, h
+
+    def grad_hess_jax(self, score, y, weight=None):
+        import jax.numpy as jnp
+
+        d = jnp.float32(self.delta)
+        g = jnp.clip(score - y, -d, d)
+        h = jnp.ones_like(g)
+        if weight is not None:
+            g, h = g * weight, h * weight
+        return g, h
+
+    @staticmethod
+    def transform_np(score):
+        return score
+
+
+class Fair:
+    """Fair loss c^2 * (|r|/c - log(1 + |r|/c)): a smooth robust loss with
+    everywhere-positive hessian c^2/(|r| + c)^2 (params.fair_c)."""
+
+    name = "fair"
+    num_outputs = 1
+
+    def __init__(self, c: float = 1.0):
+        self.c = float(c)
+
+    @staticmethod
+    def init_score(y: np.ndarray, weight=None) -> float:
+        return _weighted_percentile(y, weight, 0.5)
+
+    def grad_hess_np(self, score, y, weight=None):
+        r = (score - y).astype(np.float32)
+        c = np.float32(self.c)
+        denom = np.abs(r) + c
+        g = c * r / denom
+        h = c * c / (denom * denom)
+        if weight is not None:
+            g, h = g * weight, h * weight
+        return g.astype(np.float32), h.astype(np.float32)
+
+    def grad_hess_jax(self, score, y, weight=None):
+        import jax.numpy as jnp
+
+        c = jnp.float32(self.c)
+        r = score - y
+        denom = jnp.abs(r) + c
+        g = c * r / denom
+        h = c * c / (denom * denom)
+        if weight is not None:
+            g, h = g * weight, h * weight
+        return g, h
+
+    @staticmethod
+    def transform_np(score):
+        return score
+
+
+class Quantile:
+    """Pinball loss at level ``alpha``: the booster estimates the alpha-
+    quantile of y | x.  Gradient is -alpha below the data, (1 - alpha)
+    above; hessian 1 (LightGBM's formulation, same no-leaf-renewal caveat
+    as L1)."""
+
+    name = "quantile"
+    num_outputs = 1
+
+    def __init__(self, alpha: float = 0.9):
+        self.alpha = float(alpha)
+
+    def init_score(self, y: np.ndarray, weight=None) -> float:
+        return _weighted_percentile(y, weight, self.alpha)
+
+    def grad_hess_np(self, score, y, weight=None):
+        a = np.float32(self.alpha)
+        g = np.where(score < y, -a, np.float32(1.0) - a).astype(np.float32)
+        h = np.ones_like(g)
+        if weight is not None:
+            g, h = g * weight, h * weight
+        return g, h
+
+    def grad_hess_jax(self, score, y, weight=None):
+        import jax.numpy as jnp
+
+        a = jnp.float32(self.alpha)
+        g = jnp.where(score < y, -a, jnp.float32(1.0) - a)
+        h = jnp.ones_like(g)
+        if weight is not None:
+            g, h = g * weight, h * weight
+        return g, h
+
+    @staticmethod
+    def transform_np(score):
+        return score
+
+
+class Poisson:
+    """Poisson regression on a log link: raw score is log(rate); predict
+    applies exp.  Gradient exp(s) - y; hessian exp(s + max_delta_step)
+    (the LightGBM stabilizer — pure exp(s) underestimates curvature for
+    small rates and overshoots leaves)."""
+
+    name = "poisson"
+    num_outputs = 1
+
+    def __init__(self, max_delta_step: float = 0.7):
+        self.mds = float(max_delta_step)
+
+    @staticmethod
+    def init_score(y: np.ndarray, weight=None) -> float:
+        ya = np.asarray(y, np.float64)
+        if (ya < 0).any():
+            raise ValueError("poisson objective requires non-negative labels")
+        w = np.ones_like(ya) if weight is None else weight
+        mean = float(np.average(ya, weights=w))
+        return float(np.log(max(mean, 1e-12)))
+
+    def grad_hess_np(self, score, y, weight=None):
+        s = score.astype(np.float32)
+        g = (np.exp(s) - y).astype(np.float32)
+        h = np.exp(s + np.float32(self.mds)).astype(np.float32)
+        if weight is not None:
+            g, h = g * weight, h * weight
+        return g, h
+
+    def grad_hess_jax(self, score, y, weight=None):
+        import jax.numpy as jnp
+
+        g = jnp.exp(score) - y
+        h = jnp.exp(score + jnp.float32(self.mds))
+        if weight is not None:
+            g, h = g * weight, h * weight
+        return g, h
+
+    @staticmethod
+    def transform_np(score):
+        return np.exp(score)
+
+
 class Multiclass:
     """Softmax cross-entropy; K parallel trees per iteration (Covertype,
     BASELINE.json:8).  score shape (N, K); y holds class ids."""
@@ -234,6 +447,16 @@ def get_objective(params) -> object:
         return Binary(params.scale_pos_weight)
     if params.objective == "regression":
         return Regression()
+    if params.objective == "l1":
+        return L1()
+    if params.objective == "huber":
+        return Huber(params.alpha)
+    if params.objective == "fair":
+        return Fair(params.fair_c)
+    if params.objective == "quantile":
+        return Quantile(params.alpha)
+    if params.objective == "poisson":
+        return Poisson(params.poisson_max_delta_step)
     if params.objective == "multiclass":
         return Multiclass(params.num_class)
     if params.objective == "lambdarank":

@@ -1,0 +1,115 @@
+"""Exact TreeSHAP (pred_contrib) — efficiency property + brute-force
+Shapley oracle on small trees (path-dependent cover weighting)."""
+
+import itertools
+import math
+
+import numpy as np
+import pytest
+
+import dryad_tpu as dryad
+from dryad_tpu.datasets import higgs_like
+
+
+def _brute_force_shap(trees, t, cover, xbins, F):
+    """Shapley values by subset enumeration with the path-dependent
+    conditional expectation TreeSHAP defines: features outside the
+    coalition average children by training covers."""
+    feature = trees["feature"][t]
+    threshold = trees["threshold"][t]
+    left, right = trees["left"][t], trees["right"][t]
+    value = trees["value"][t]
+    dleft = trees["default_left"][t]
+
+    def f_S(S, node=0):
+        f = feature[node]
+        if f < 0:
+            return float(value[node])
+        if f in S:
+            b = int(xbins[f])
+            go_left = b <= threshold[node] and (dleft[node] or b != 0)
+            return f_S(S, left[node] if go_left else right[node])
+        cl, cr = float(cover[left[node]]), float(cover[right[node]])
+        return (cl * f_S(S, left[node]) + cr * f_S(S, right[node])) / (cl + cr)
+
+    phi = np.zeros(F + 1)
+    feats = list(range(F))
+    for i in feats:
+        for r in range(F):
+            for S in itertools.combinations([f for f in feats if f != i], r):
+                w = math.factorial(r) * math.factorial(F - r - 1) / math.factorial(F)
+                phi[i] += w * (f_S(set(S) | {i}) - f_S(set(S)))
+    phi[F] = f_S(set())
+    return phi
+
+
+def test_contrib_matches_bruteforce_small_tree():
+    rng = np.random.default_rng(3)
+    X = rng.normal(size=(600, 4)).astype(np.float32)
+    y = (X[:, 0] + 0.5 * X[:, 1] * X[:, 2] + 0.1 * rng.normal(size=600)
+         ).astype(np.float32)
+    ds = dryad.Dataset(X, y, max_bins=16)
+    b = dryad.train(dict(objective="regression", num_trees=3, num_leaves=7,
+                         max_depth=3, max_bins=16, learning_rate=0.5),
+                    ds, backend="cpu")
+    Xb = ds.X_binned[:5]
+    got = b.predict_binned(ds.X_binned[:5], pred_contrib=True)
+    trees = b.tree_arrays()
+    for n in range(5):
+        want = np.zeros(5)
+        want[4] = float(b.init_score[0])
+        for t in range(b.num_total_trees):
+            want += _brute_force_shap(trees, t, trees["cover"][t], Xb[n], 4)
+        np.testing.assert_allclose(got[n], want, rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("params,objective", [
+    (dict(objective="binary", num_trees=10, num_leaves=15, max_depth=4), "binary"),
+    (dict(objective="regression", num_trees=8, num_leaves=31, subsample=0.8,
+          seed=3, max_depth=5), "regression"),
+    (dict(objective="multiclass", num_class=3, num_trees=5, num_leaves=7,
+          max_depth=3), "multiclass"),
+])
+def test_contrib_efficiency_property(params, objective):
+    """Contributions + bias column == raw prediction (SHAP efficiency),
+    for binary, bagged regression, and multiclass."""
+    rng = np.random.default_rng(9)
+    X, y = higgs_like(2000, seed=17)
+    if objective == "multiclass":
+        y = rng.integers(0, 3, size=2000).astype(np.float32)
+    ds = dryad.Dataset(X, y, max_bins=32)
+    p = dict(params, max_bins=32)
+    b = dryad.train(p, ds, backend="cpu")
+    contrib = b.predict_binned(ds.X_binned[:50], pred_contrib=True)
+    raw = b.predict_binned(ds.X_binned[:50], raw_score=True)
+    if objective == "multiclass":
+        total = contrib.sum(axis=2)
+        np.testing.assert_allclose(total, raw, rtol=1e-4, atol=1e-5)
+    else:
+        total = contrib.sum(axis=1)
+        np.testing.assert_allclose(total, raw, rtol=1e-4, atol=1e-5)
+
+
+def test_contrib_device_trained_booster():
+    """Device-trained boosters record the same covers (histogram counts),
+    so pred_contrib works on them identically."""
+    X, y = higgs_like(3000, seed=19)
+    ds = dryad.Dataset(X, y, max_bins=32)
+    p = dict(objective="binary", num_trees=5, num_leaves=15, max_depth=4,
+             max_bins=32)
+    b_dev = dryad.train(p, ds, backend="tpu")
+    b_cpu = dryad.train(p, ds, backend="cpu")
+    np.testing.assert_array_equal(b_dev.cover, b_cpu.cover)
+    c_dev = b_dev.predict_binned(ds.X_binned[:20], pred_contrib=True)
+    raw = b_dev.predict_binned(ds.X_binned[:20], raw_score=True)
+    np.testing.assert_allclose(c_dev.sum(axis=1), raw, rtol=1e-4, atol=1e-5)
+
+
+def test_contrib_old_model_without_covers_raises():
+    X, y = higgs_like(1000, seed=21)
+    ds = dryad.Dataset(X, y, max_bins=32)
+    b = dryad.train(dict(objective="binary", num_trees=2, num_leaves=7,
+                         max_bins=32), ds, backend="cpu")
+    b.cover = np.zeros_like(b.cover)   # simulate a pre-round-4 model
+    with pytest.raises(ValueError, match="cover"):
+        b.predict_binned(ds.X_binned[:2], pred_contrib=True)
