@@ -43,6 +43,7 @@ _PARAM_ALIASES = {
     "boosting_type": "boosting",
     "top_rate": "goss_top_rate",
     "other_rate": "goss_other_rate",
+    "rate_drop": "drop_rate",
 }
 
 _OBJECTIVE_ALIASES = {
@@ -102,10 +103,18 @@ class Params:
     # gbdt: plain boosting (+ optional bagging). goss: gradient-based
     # one-side sampling — keep the goss_top_rate fraction with the largest
     # |grad|, Bernoulli-sample goss_other_rate of the rest and amplify their
-    # grad/hess by (1-top)/other to stay unbiased.
+    # grad/hess by (1-top)/other to stay unbiased.  dart: dropout boosting
+    # (DART paper semantics): each iteration drops every previous
+    # iteration's trees independently with prob drop_rate (whole
+    # iterations for multiclass; skipped entirely with prob skip_drop),
+    # fits the new tree against the pruned ensemble, then scales the new
+    # tree by 1/(k+1) and the k dropped iterations by k/(k+1).
     boosting: str = "gbdt"
     goss_top_rate: float = 0.2
     goss_other_rate: float = 0.1
+    drop_rate: float = 0.1
+    skip_drop: float = 0.5
+    max_drop: int = 50
     subsample: float = 1.0
     colsample: float = 1.0
     seed: int = 0
@@ -177,8 +186,24 @@ class Params:
             raise ValueError("min_data_in_leaf must be >= 1")
         if any(m not in (-1, 0, 1) for m in self.monotone_constraints):
             raise ValueError("monotone_constraints entries must be -1, 0 or +1")
-        if self.boosting not in ("gbdt", "goss"):
-            raise ValueError("boosting must be 'gbdt' or 'goss'")
+        if self.boosting not in ("gbdt", "goss", "dart"):
+            raise ValueError("boosting must be 'gbdt', 'goss' or 'dart'")
+        if self.boosting == "dart":
+            if not (0.0 <= self.drop_rate <= 1.0):
+                raise ValueError("drop_rate must be in [0, 1]")
+            if not (0.0 <= self.skip_drop <= 1.0):
+                raise ValueError("skip_drop must be in [0, 1]")
+            if self.max_drop < 1:
+                raise ValueError("max_drop must be >= 1")
+            if self.early_stopping_rounds:
+                # best_iteration truncation is unsound under DART: drops
+                # AFTER the best iteration rescale earlier trees in place,
+                # so the truncated model no longer matches the metric that
+                # selected it (LightGBM disables early stopping here too)
+                raise ValueError(
+                    "early_stopping_rounds is incompatible with "
+                    "boosting='dart' (later drop iterations rescale the "
+                    "trees the best iteration was scored with)")
         if self.boosting == "goss":
             if not (0 < self.goss_top_rate < 1) or not (0 < self.goss_other_rate < 1):
                 raise ValueError("goss rates must be in (0, 1)")

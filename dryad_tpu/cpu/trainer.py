@@ -136,6 +136,25 @@ def sample_masks(params: Params, iteration: int, num_rows: int, num_features: in
     return row_mask, feat_mask
 
 
+def dart_drop_set(params: Params, iteration: int, n_prev: int) -> np.ndarray:
+    """Deterministic DART drop set (prev-iteration ids), shared by both
+    backends (Philox keyed like sample_masks, distinct counter stream).
+
+    With prob ``skip_drop`` nothing drops; else each previous iteration
+    drops independently with prob ``drop_rate``, capped at ``max_drop``
+    (uniform subsample of the selection when over)."""
+    if n_prev == 0 or params.drop_rate <= 0.0:
+        return np.empty(0, np.int64)
+    rng = np.random.Generator(np.random.Philox(
+        key=params.seed, counter=(1 << 32) + iteration))
+    if rng.uniform() < params.skip_drop:
+        return np.empty(0, np.int64)
+    sel = np.nonzero(rng.uniform(size=n_prev) < params.drop_rate)[0]
+    if sel.size > params.max_drop:
+        sel = np.sort(rng.permutation(sel)[: params.max_drop])
+    return sel.astype(np.int64)
+
+
 class _TreeGrower:
     """Grows one tree; mirrors engine/grower.py step-for-step."""
 
@@ -410,6 +429,31 @@ def train_cpu(
                 and stale >= p.early_stopping_rounds):
             T = it * K
             break
+        # ---- DART: drop previous iterations before computing gradients ----
+        # paper semantics (see config); arithmetic order mirrors the device
+        # trainer exactly (score - drop; grads; score - drop/(k+1);
+        # new tree pre-scaled by 1/(k+1); dropped values *= k/(k+1))
+        drop = (dart_drop_set(p, it, it) if p.boosting == "dart"
+                else np.empty(0, np.int64))
+        value_scale = np.float32(1.0)
+        if drop.size:
+            kd = drop.size
+            value_scale = np.float32(1.0 / (kd + 1))
+            factor_drop = np.float32(kd / (kd + 1.0))
+            dcontrib = np.zeros_like(score)
+            for d_it in drop:
+                for c in range(K):
+                    td = int(d_it) * K + c
+                    lv = predict_tree_leaves(out, Xb, td, max(max_depth_seen, 1))
+                    dcontrib[:, c] += out["value"][td, lv]
+            # gradients see the pruned ensemble; the CARRIED scores are
+            # rebuilt below by the exact replay-sum a resumed run computes,
+            # so resume bit-identity holds through drop iterations
+            score = score - dcontrib
+            for d_it in drop:
+                for c in range(K):
+                    out["value"][int(d_it) * K + c] *= factor_drop
+
         if p.objective == "lambdarank":
             grads, hess = obj.grad_hess_np(score[:, 0], y, data.weight, query_offsets=qoff)
             grads, hess = grads[:, None], hess[:, None]
@@ -418,6 +462,7 @@ def train_cpu(
         else:
             grads, hess = obj.grad_hess_np(score[:, 0], y, data.weight)
             grads, hess = grads[:, None], hess[:, None]
+
 
         row_mask, feat_mask = sample_masks(p, it, N, F)
         rows = all_rows if row_mask is None else all_rows[row_mask]
@@ -430,11 +475,28 @@ def train_cpu(
             t = it * K + k
             d = grower.grow(grads[:, k], hess[:, k], rows, feat_mask, out, t)
             max_depth_seen = max(max_depth_seen, d)
-            leaves = predict_tree_leaves(out, Xb, t, max(max_depth_seen, 1))
-            score[:, k] += out["value"][t, leaves]
-            for vXb, vscore in zip(vXbs, vscores):
-                vleaves = predict_tree_leaves(out, vXb, t, max(max_depth_seen, 1))
-                vscore[:, k] += out["value"][t, vleaves]
+            if value_scale != 1.0:
+                out["value"][t] *= value_scale
+            if not drop.size:
+                leaves = predict_tree_leaves(out, Xb, t, max(max_depth_seen, 1))
+                score[:, k] += out["value"][t, leaves]
+                for vXb, vscore in zip(vXbs, vscores):
+                    vleaves = predict_tree_leaves(out, vXb, t, max(max_depth_seen, 1))
+                    vscore[:, k] += out["value"][t, vleaves]
+        if drop.size:
+            # full replay-sum (ascending t, the resume construction): the
+            # live score after a drop iteration is bitwise what a resumed
+            # run would rebuild from the checkpointed value table
+            score = np.broadcast_to(init, (N, K)).astype(np.float32).copy()
+            for t2 in range((it + 1) * K):
+                lv = predict_tree_leaves(out, Xb, t2, max(max_depth_seen, 1))
+                score[:, t2 % K] += out["value"][t2, lv]
+            for vi, vXb in enumerate(vXbs):
+                vs = np.broadcast_to(init, (vXb.shape[0], K)).astype(np.float32).copy()
+                for t2 in range((it + 1) * K):
+                    vlv = predict_tree_leaves(out, vXb, t2, max(max_depth_seen, 1))
+                    vs[:, t2 % K] += out["value"][t2, vlv]
+                vscores[vi] = vs
 
         info: dict = {"iteration": it}
         # eval every eval_period-th iteration, always including the last so

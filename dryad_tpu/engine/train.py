@@ -31,7 +31,12 @@ import numpy as np
 
 from dryad_tpu.booster import CAT_WORDS, Booster
 from dryad_tpu.config import Params, effective_depth_params
-from dryad_tpu.cpu.trainer import goss_uniform, sample_masks, update_best
+from dryad_tpu.cpu.trainer import (
+    dart_drop_set,
+    goss_uniform,
+    sample_masks,
+    update_best,
+)
 from dryad_tpu.dataset import Dataset
 from dryad_tpu.engine.grower import grow_any
 from dryad_tpu.engine.predict import _accumulate, tree_leaves
@@ -51,7 +56,7 @@ _CHUNK_FB_LIMIT = 1 << 19
 
 def _step_body(p, B, has_cat, mesh, platform, learn_missing, out, score, Xb,
                g_all, h_all, bag, fmask, is_cat_feat, t, k, root_hist=None,
-               bmask=None, n_rows=None):
+               bmask=None, n_rows=None, value_scale=None):
     """One (iteration, class) tree: grow, record into slot t, update scores.
 
     Shared by the per-iteration ``_step_jit`` dispatch and the chunked
@@ -81,6 +86,10 @@ def _step_body(p, B, has_cat, mesh, platform, learn_missing, out, score, Xb,
         # each row's leaf comes straight out of the grower's partition
         # state — re-traversing 10M rows cost ~5 s/tree (gather-bound)
         leaves = tree.pop("row_leaf")
+    if value_scale is not None:
+        # DART: the new tree lands pre-scaled by 1/(k+1) — same f32
+        # multiply order as the CPU mirror (finalize with lr, then scale)
+        tree = dict(tree, value=tree["value"] * value_scale)
     col = jnp.take(score, k, axis=1) + tree["value"][leaves]
     score = jax.lax.dynamic_update_index_in_dim(score, col, k, axis=1)
     for key in _TREE_KEYS:
@@ -391,6 +400,40 @@ def _goss_body(p, N, g_all, h_all, u, valid):
 _goss_jit = partial(jax.jit, static_argnames=("p", "N"))(_goss_body)
 
 
+_dart_replay_jit = partial(jax.jit, static_argnames=("depth_bound",))(
+    lambda trees, Xb, init, depth_bound: _accumulate(
+        trees, Xb, init, depth_bound))
+
+
+@partial(jax.jit, static_argnames=("depth_bound",))
+def _dart_drop_jit(out, score, tids, tcls, Xb, factor_drop, depth_bound):
+    """DART drop bookkeeping in ONE dispatch: ``tids`` (max_drop*K,)
+    padded with -1 names the dropped tree slots, ``tcls`` their class
+    columns, ``factor_drop`` = f32(k/(k+1)) computed HOST-side (the same
+    rounding the CPU mirror uses — deriving it on device as 1 - 1/(k+1)
+    lands 1 ulp off at e.g. k=2 and would let near-tie splits diverge by
+    backend).  Returns (score - dcontrib, value table with dropped rows
+    * factor_drop).  ``depth_bound`` is a STATIC bound >= any tree's
+    depth — traversal is exact for any such bound, and out["max_depth"]
+    cannot be trusted here (resume restores tree arrays but not the
+    per-slot depth log, and the resumed run must reproduce the
+    uninterrupted one bitwise)."""
+
+    def body(i, acc):
+        t = jnp.maximum(tids[i], 0)
+        tree = {key: out[key][t] for key in _TREE_KEYS}
+        lv = tree_leaves(tree, Xb, depth_bound)
+        c = tree["value"][lv] * (tids[i] >= 0).astype(jnp.float32)
+        return acc.at[:, tcls[i]].add(c)
+
+    dcontrib = jax.lax.fori_loop(0, tids.shape[0], body,
+                                 jnp.zeros_like(score))
+    T = out["value"].shape[0]
+    newval = out["value"].at[
+        jnp.where(tids >= 0, tids, T)].multiply(factor_drop, mode="drop")
+    return score - dcontrib, newval
+
+
 @jax.jit
 def _apply_valid_jit(out, t, vXb, vs_col, depth_bound):
     tree = {key: out[key][t] for key in _TREE_KEYS}
@@ -536,10 +579,11 @@ def train_device(
              if learn_missing and bundled_np is not None and bundled_np.any()
              else None)
 
-    def step(out, score, g_all, h_all, bag, fmask, t, k, root_hist=None):
+    def step(out, score, g_all, h_all, bag, fmask, t, k, root_hist=None,
+             value_scale=None):
         return _step_jit(p_key, B, has_cat, mesh, plat, learn_missing, out,
                          score, Xb, g_all, h_all, bag, fmask, is_cat_feat, t, k,
-                         root_hist, bmask, n_rows=N)
+                         root_hist, bmask, n_rows=N, value_scale=value_scale)
 
     # ---- resume / warm start -------------------------------------------------
     out = _empty_out_device(T, p.max_nodes, CAT_WORDS)
@@ -673,7 +717,10 @@ def train_device(
     host_eval = any(getattr(fn, "host_only", True) for _, _, fn in evaluators)
     chunkable = (not (valids and host_eval)
                  and not (valids and p.early_stopping_rounds
-                          and p.eval_period < 2))
+                          and p.eval_period < 2)
+                 # DART mutates previously grown trees every iteration
+                 # (drop + rescale) — host-orchestrated dispatch only
+                 and p.boosting != "dart")
     if chunkable:
         # the tunnel kills single programs running longer than ~60 s
         # (measured: 45 s OK, 65 s crashes the worker) — budget ~40 s per
@@ -957,7 +1004,39 @@ def train_device(
                 bag = shard_rows(mesh, bag)[0]
         fmask = ones_feat if feat_mask_np is None else jnp.asarray(feat_mask_np)
 
-        g_all, h_all = grads(score)
+        # ---- DART drop (mirrors cpu/trainer.py arithmetic exactly) --------
+        value_scale = None
+        if p.boosting == "dart":
+            drop_np = dart_drop_set(p, it, it)
+            if drop_np.size:
+                kd = int(drop_np.size)
+                inv = jnp.float32(1.0 / (kd + 1))
+                fdrop = jnp.float32(np.float32(kd / (kd + 1.0)))
+                Dmax = p.max_drop * K
+                tids_np = np.full((Dmax,), -1, np.int32)
+                tcls_np = np.zeros((Dmax,), np.int32)
+                flat = (drop_np[:, None] * K
+                        + np.arange(K)[None, :]).reshape(-1)
+                tids_np[: flat.size] = flat
+                tcls_np[: flat.size] = np.tile(np.arange(K), kd)
+                tids = jnp.asarray(tids_np)
+                tcls = jnp.asarray(tcls_np)
+                db = (p.max_depth if p.max_depth > 0
+                      else max(p.effective_num_leaves - 1, 1))
+                score_eff, newval = _dart_drop_jit(
+                    out, score, tids, tcls, Xb, fdrop, db)
+                out = dict(out)
+                out["value"] = newval
+                value_scale = inv
+                g_all, h_all = grads(score_eff)
+                # score/vscores are REBUILT after the grow below by the
+                # exact replay-sum a resumed run computes (_accumulate) —
+                # incremental drop deltas round differently and would
+                # break the resume bit-identity invariant
+            else:
+                g_all, h_all = grads(score)
+        else:
+            g_all, h_all = grads(score)
         if p.boosting == "goss":
             u_np = np.pad(goss_uniform(p, it, N), (0, pad), constant_values=2.0)
             u = jnp.asarray(u_np)
@@ -975,12 +1054,27 @@ def train_device(
         for k in range(K):
             t = it * K + k
             out, score = step(out, score, g_all, h_all, bag, fmask, t, k,
-                              None if roots is None else roots[k])
-            for vi, vXb in enumerate(vXbs):
-                vscores[vi] = vscores[vi].at[:, k].set(
-                    _apply_valid_jit(out, t, vXb, vscores[vi][:, k],
-                                     out["max_depth"][t])
-                )
+                              None if roots is None else roots[k],
+                              value_scale=value_scale)
+            if value_scale is None:
+                for vi, vXb in enumerate(vXbs):
+                    vscores[vi] = vscores[vi].at[:, k].set(
+                        _apply_valid_jit(out, t, vXb, vscores[vi][:, k],
+                                         out["max_depth"][t])
+                    )
+        if value_scale is not None:
+            # DART drop iteration: rebuild carried scores as the replay-sum
+            # over the CURRENT (rescaled) value table — the construction a
+            # resumed run performs, so checkpoint boundaries are bitwise
+            trees_live = {key: out[key].reshape((T // K, K)
+                                                + out[key].shape[1:])
+                          for key in _TREE_KEYS}
+            db = (p.max_depth if p.max_depth > 0
+                  else max(p.effective_num_leaves - 1, 1))
+            score = _dart_replay_jit(trees_live, Xb, jnp.asarray(init), db)
+            vscores = [_dart_replay_jit(trees_live, vXb, jnp.asarray(init),
+                                        db)
+                       for vXb in vXbs]
 
         info: dict = {"iteration": it}
         if comm is not None:

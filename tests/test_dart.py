@@ -1,0 +1,102 @@
+"""DART boosting: cross-backend parity, drop determinism, score
+bookkeeping consistency, and kill-and-resume bit identity."""
+
+import numpy as np
+import pytest
+
+import dryad_tpu as dryad
+from dryad_tpu.config import make_params
+from dryad_tpu.cpu.trainer import dart_drop_set
+from dryad_tpu.datasets import higgs_like
+from dryad_tpu.metrics import auc
+
+PARAMS = dict(objective="binary", boosting="dart", num_trees=20,
+              num_leaves=15, max_depth=4, max_bins=32, drop_rate=0.4,
+              skip_drop=0.3, seed=2)
+
+
+@pytest.fixture(scope="module")
+def data():
+    X, y = higgs_like(4000, seed=23)
+    return X, y, dryad.Dataset(X, y, max_bins=32)
+
+
+def test_drop_set_deterministic_and_capped():
+    p = make_params(dict(PARAMS, skip_drop=0.0, drop_rate=0.9, max_drop=5))
+    a = dart_drop_set(p, 7, 30)
+    b = dart_drop_set(p, 7, 30)
+    np.testing.assert_array_equal(a, b)
+    assert a.size <= 5
+    assert dart_drop_set(p, 3, 0).size == 0
+    p1 = make_params(dict(PARAMS, skip_drop=1.0))
+    assert dart_drop_set(p1, 9, 9).size == 0   # always skipped
+
+
+def test_dart_cpu_device_parity(data):
+    X, y, ds = data
+    bc = dryad.train(PARAMS, ds, backend="cpu")
+    bt = dryad.train(PARAMS, ds, backend="tpu")
+    np.testing.assert_array_equal(bc.feature, bt.feature)
+    np.testing.assert_array_equal(bc.threshold, bt.threshold)
+    np.testing.assert_allclose(bc.value, bt.value, rtol=1e-5, atol=1e-6)
+    # drops actually happened: some trees carry rescaled (shrunk) values
+    assert (np.abs(bt.value).max(axis=1)[1:]
+            < np.abs(bt.value).max(axis=1).max()).any()
+
+
+def test_dart_quality_and_differs_from_gbdt(data):
+    X, y, ds = data
+    b_dart = dryad.train(PARAMS, ds, backend="cpu")
+    b_gbdt = dryad.train(dict(PARAMS, boosting="gbdt"), ds, backend="cpu")
+    a_dart = auc(y, dryad.predict(b_dart, X, raw_score=True))
+    a_gbdt = auc(y, dryad.predict(b_gbdt, X, raw_score=True))
+    assert a_dart > 0.7                       # learns
+    assert not np.array_equal(b_dart.value, b_gbdt.value)  # really dropped
+    assert abs(a_dart - a_gbdt) < 0.08        # same ballpark
+
+
+@pytest.mark.parametrize("backend", ["cpu", "tpu"])
+def test_dart_valid_bookkeeping_consistent(data, backend):
+    """Incrementally-adjusted valid scores (drop/rescale deltas applied
+    in-place every iteration) must match a from-scratch recompute off the
+    final rescaled tree table."""
+    X, y, ds = data
+    seen = {}
+    b = dryad.train(dict(PARAMS, num_trees=10), ds, [ds], backend=backend,
+                    callback=lambda it, info: seen.update(info))
+    final = seen["valid_auc"]
+    recomp = auc(y, b.predict_binned(ds.X_binned, raw_score=True))
+    assert abs(final - recomp) < 1e-5
+
+
+@pytest.mark.parametrize("backend", ["cpu", "tpu"])
+def test_dart_kill_and_resume_bit_identical(tmp_path, data, backend):
+    """The drop draw is keyed on (seed, iteration) and rescales live in the
+    checkpointed value table, so resume reproduces the uninterrupted run."""
+    X, y, ds = data
+    p = dict(PARAMS, num_trees=12)
+    full = dryad.train(p, ds, backend=backend)
+
+    class Crash(RuntimeError):
+        pass
+
+    def crash_at(it, info):
+        if it == 7:
+            raise Crash
+
+    ckdir = str(tmp_path / backend)
+    with pytest.raises(Crash):
+        dryad.train(p, ds, backend=backend, checkpoint_dir=ckdir,
+                    checkpoint_every=3, callback=crash_at)
+    resumed = dryad.train(p, ds, backend=backend, checkpoint_dir=ckdir,
+                          checkpoint_every=3, resume=True)
+    np.testing.assert_array_equal(full.feature, resumed.feature)
+    np.testing.assert_array_equal(full.value, resumed.value)
+    np.testing.assert_array_equal(
+        dryad.predict(full, X, raw_score=True),
+        dryad.predict(resumed, X, raw_score=True))
+
+
+def test_dart_rejects_early_stopping():
+    with pytest.raises(ValueError, match="early_stopping"):
+        make_params(dict(PARAMS, early_stopping_rounds=3)).validate()
