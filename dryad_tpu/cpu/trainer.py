@@ -110,10 +110,19 @@ def normalize_valids(valid) -> list[tuple[str, Dataset]]:
     return out
 
 
-def update_best(best_iteration, best_value, stale, iteration, value, higher):
+def update_best(p, best_iteration, best_value, stale, iteration, value,
+                higher):
     """Early-stopping bookkeeping shared by every eval path (CPU sync,
     device sync, device deferred replay) — one definition so the three can
-    never diverge.  Returns (best_iteration, best_value, stale)."""
+    never diverge.  Returns (best_iteration, best_value, stale).
+
+    DART is a no-op BY CONSTRUCTION here (not at the call sites, so a new
+    caller can't forget the gate — ADVICE r4): drops after the best
+    iteration rescale EARLIER trees in place, so the prefix ending at
+    best_iteration is not the ensemble that produced the best score and
+    predict must never truncate there."""
+    if p.boosting == "dart":
+        return best_iteration, best_value, stale
     improved = best_value is None or (
         value > best_value if higher else value < best_value)
     if improved:
@@ -417,9 +426,15 @@ def train_cpu(
                 vleaves = predict_tree_leaves(
                     init_booster.tree_arrays(), vXb, t, init_booster.max_depth_seen)
                 vscore[:, t % K] += init_booster.value[t, vleaves]
-        best_iteration = init_booster.best_iteration
-        best_value = init_booster.train_state.get("best_value")
-        stale = init_booster.train_state.get("stale", 0)
+        if p.boosting != "dart":
+            best_iteration = init_booster.best_iteration
+            best_value = init_booster.train_state.get("best_value")
+            stale = init_booster.train_state.get("stale", 0)
+        # else: a DART continuation from a booster that recorded
+        # best_iteration (e.g. gbdt-with-early-stopping init) must NOT
+        # inherit it — the coming drops rescale trees inside that prefix,
+        # so truncating predict there would score a model that never
+        # existed (ADVICE r4); DART's own checkpoints always carry -1
 
     all_rows = np.arange(N, dtype=np.int64)
     for it in range(start_iter, T // K):
@@ -516,7 +531,7 @@ def train_cpu(
                 if vi > 0:
                     continue  # early stopping watches the first set only
                 best_iteration, best_value, stale = update_best(
-                    best_iteration, best_value, stale, it, value, higher)
+                    p, best_iteration, best_value, stale, it, value, higher)
                 if p.early_stopping_rounds and stale >= p.early_stopping_rounds:
                     stop = True
                     T = (it + 1) * K  # trim unfilled trailing trees

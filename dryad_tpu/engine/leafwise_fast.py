@@ -83,6 +83,17 @@ def supports(p: Params, num_features: int, total_bins: int,
     return leafwise_fast_supported(p, num_features, total_bins, num_rows)
 
 
+def phase_plan(depth_cap: int):
+    """(d_switch, P_narrow, P_full) for the two-phase expansion loop — the
+    ONE definition of the leafwise phase boundary, shared with
+    train._comm_stats so the observability accounting mirrors the grower's
+    actual per-level candidate widths (ADVICE r4 / r5 review)."""
+    P_full = 1 << max(depth_cap - 1, 0)
+    P_narrow = min(8, P_full)
+    d_switch = 4 if (depth_cap > 4 and P_full > 8) else depth_cap
+    return d_switch, P_narrow, P_full
+
+
 def grow_tree_leafwise_batched(
     params: Params,
     total_bins: int,
@@ -350,8 +361,7 @@ def grow_tree_leafwise_batched(
             return st_new
         return level_body
 
-    P_narrow = min(8, Pf)
-    d_switch = 4 if (D > 4 and Pf > 8) else D
+    d_switch, P_narrow, _ = phase_plan(D)
     exp_st = jax.lax.fori_loop(
         0, d_switch,
         make_level_body(P_narrow,

@@ -43,6 +43,21 @@ from dryad_tpu.engine.histogram import (
 from dryad_tpu.engine.split import NEG_INF, find_best_split
 
 
+def phase_plan(depth_cap: int, num_leaves: int, nat_live: bool):
+    """(d_switch, P_narrow, P_full) for the two-phase level loop — the ONE
+    definition of the phase boundary, shared with train._comm_stats so the
+    observability accounting mirrors the grower's actual program (ADVICE
+    r4).  The switch sits at depth 5 (<= 16 candidates = _NAT_SLOTS) when
+    the natural-order pass is live so level 4 rides it too, else at the
+    measured depth-4 boundary."""
+    P_full = min(1 << (depth_cap - 1), num_leaves - 1)
+    d_cut = 5 if nat_live else 4
+    d_switch = d_cut if (depth_cap > d_cut and P_full > (1 << (d_cut - 1))) \
+        else depth_cap
+    P_narrow = min(1 << (d_switch - 1), num_leaves - 1)
+    return d_switch, P_narrow, P_full
+
+
 def grow_tree_levelwise(
     params: Params,
     total_bins: int,
@@ -170,11 +185,8 @@ def grow_tree_levelwise(
     # when the natural-order pass is live so level 4 rides it too
     # (_NAT_SLOTS = 16; sort+gather-free beats the plan path ~70 ms/level
     # at 10M), else at the measured depth-4 boundary.
-    P_full = min(1 << (depth_cap - 1), L - 1)
-    d_cut = 5 if nat_tiles is not None else 4
-    d_switch = d_cut if (depth_cap > d_cut and P_full > (1 << (d_cut - 1))) \
-        else depth_cap
-    P_narrow = min(1 << (d_switch - 1), L - 1)
+    d_switch, P_narrow, P_full = phase_plan(depth_cap, L,
+                                            nat_tiles is not None)
 
     st = {
         "row_slot": row_slot, "slot_node": slot_node, "slot_gain": slot_gain,

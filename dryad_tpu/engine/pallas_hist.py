@@ -637,6 +637,16 @@ _NAT_DROP = 31        # sel sentinel (any value >= _NAT_SLOTS drops the row)
 _NAT_GATE_MB = int(os.environ.get("DRYAD_NAT_MB", "512"))
 
 
+def nat_gate_admits(num_rows: int, num_features: int, itemsize: int,
+                    n_shards: int = 1) -> bool:
+    """The ONE natural-order gate predicate (GLOBAL padded matrix bytes vs
+    ``_NAT_GATE_MB``) — shared by maybe_natural_tiles and
+    train._comm_stats so the observability accounting can never drift from
+    the grower's actual program choice (ADVICE r4)."""
+    return (num_rows * n_shards * num_features * itemsize
+            <= (_NAT_GATE_MB << 20))
+
+
 def maybe_natural_tiles(Xb: jnp.ndarray, total_bins: int,
                         axis_name: str | None = None):
     """natural_tiles when the GLOBAL matrix is small enough, else None.
@@ -660,7 +670,7 @@ def maybe_natural_tiles(Xb: jnp.ndarray, total_bins: int,
     """
     n_shards = int(jax.lax.psum(1, axis_name)) if axis_name else 1
     N, F = Xb.shape
-    if N * n_shards * F * Xb.dtype.itemsize > (_NAT_GATE_MB << 20):
+    if not nat_gate_admits(N, F, Xb.dtype.itemsize, n_shards):
         return None
     return natural_tiles(Xb, total_bins)
 

@@ -277,11 +277,10 @@ def _comm_stats(p, F: int, B: int, K: int, n_shards: int,
     L = p.effective_num_leaves
     if p.growth == "depthwise" and p.max_depth > 0:
         D = p.max_depth
-        P_full = min(1 << (D - 1), L - 1)
-        # mirror levelwise.py's phase boundary: depth 5 when the
-        # natural-order pass is live (its gate is a pure function of the
-        # GLOBAL matrix size, which num_rows carries), else depth 4
-        from dryad_tpu.engine import pallas_hist
+        # the gate predicate and phase boundary are the growers' OWN
+        # helpers (pallas_hist.nat_gate_admits, levelwise.phase_plan) so
+        # this accounting cannot drift from the program choice (ADVICE r4)
+        from dryad_tpu.engine import levelwise, pallas_hist
         from dryad_tpu.engine.histogram import resolve_backend
 
         bin_bytes = 1 if B <= 256 else 2
@@ -292,11 +291,8 @@ def _comm_stats(p, F: int, B: int, K: int, n_shards: int,
                     and resolve_backend(p.hist_backend, segmented=True,
                                         platform=platform) == "pallas"
                     and pallas_hist.supports(B)
-                    and gate_rows * F * bin_bytes
-                    <= (pallas_hist._NAT_GATE_MB << 20))
-        d_cut = 5 if nat_live else 4
-        d_switch = d_cut if (D > d_cut and P_full > (1 << (d_cut - 1))) else D
-        P_narrow = min(1 << (d_switch - 1), L - 1)
+                    and pallas_hist.nat_gate_admits(gate_rows, F, bin_bytes))
+        d_switch, P_narrow, P_full = levelwise.phase_plan(D, L, nat_live)
         widths = [P_narrow] * d_switch + [P_full] * (D - d_switch)
     else:
         from dryad_tpu.engine import leafwise_fast
@@ -304,9 +300,7 @@ def _comm_stats(p, F: int, B: int, K: int, n_shards: int,
         if (p.growth == "leafwise"
                 and leafwise_fast.supports(p, F, B, num_rows)):
             D = p.max_depth
-            Pf = 1 << max(D - 1, 0)
-            P_narrow = min(8, Pf)
-            d_switch = 4 if (D > 4 and Pf > 8) else D
+            d_switch, P_narrow, Pf = leafwise_fast.phase_plan(D)
             widths = [P_narrow] * d_switch + [Pf] * (D - d_switch)
         else:
             widths = [1] * (L - 1)          # one masked pass per split
@@ -646,8 +640,11 @@ def train_device(
             for vXb in vXbs
         ]
     best_iteration, best_value, stale = -1, None, 0
-    if init_booster is not None:
-        # resume continues the eval/early-stop state exactly where it stopped
+    if init_booster is not None and p.boosting != "dart":
+        # resume continues the eval/early-stop state exactly where it
+        # stopped; DART continuations must NOT inherit a recorded
+        # best_iteration (the coming drops rescale trees inside that
+        # prefix — see update_best), and DART's own checkpoints carry -1
         best_iteration = init_booster.best_iteration
         best_value = init_booster.train_state.get("best_value")
         stale = init_booster.train_state.get("stale", 0)
@@ -656,7 +653,9 @@ def train_device(
         """Fold one eval's values into eval_history + best-iteration state —
         the ONE bookkeeping used by every deferred replay (per-iteration
         deferred flush and the chunked path's buffer flush), so the two can
-        never diverge."""
+        never diverge.  DART keeps eval_history but never records
+        best_iteration (update_best itself is the no-op — see its
+        docstring)."""
         nonlocal best_iteration, best_value, stale, eval_history
         _, higher0, _ = evaluators[0]
         if eval_history is None:
@@ -666,7 +665,7 @@ def train_device(
             eval_history.setdefault(f"{vname}_{mname}", []).append(
                 [int(it_d), float(vals[vi])])
         best_iteration, best_value, stale = update_best(
-            best_iteration, best_value, stale, int(it_d), float(vals[0]),
+            p, best_iteration, best_value, stale, int(it_d), float(vals[0]),
             higher0)
 
     def flush_deferred():
@@ -947,7 +946,7 @@ def train_device(
                                 zip(valids, evaluators)):
                             info[f"{vname}_{mname}"] = float(val_rows[j][vi])
                         best_iteration, best_value, stale = update_best(
-                            best_iteration, best_value, stale, j,
+                            p, best_iteration, best_value, stale, j,
                             float(val_rows[j][0]), higher0)
                         if (p.early_stopping_rounds
                                 and stale >= p.early_stopping_rounds):
@@ -1097,7 +1096,8 @@ def train_device(
                     if vi > 0:
                         continue  # early stopping watches the first set only
                     best_iteration, best_value, stale = update_best(
-                        best_iteration, best_value, stale, it, value, higher)
+                        p, best_iteration, best_value, stale, it, value,
+                        higher)
                     if (p.early_stopping_rounds
                             and stale >= p.early_stopping_rounds):
                         stop = True

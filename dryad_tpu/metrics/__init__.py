@@ -80,7 +80,11 @@ def poisson_deviance(y_true: np.ndarray, raw_score: np.ndarray) -> float:
     term drops for y == 0 (its limit), mu = exp(raw)."""
     y = np.asarray(y_true, np.float64)
     mu = np.exp(np.asarray(raw_score, np.float64))
-    ylog = np.where(y > 0, y * np.log(np.maximum(y, 1e-300) / mu), 0.0)
+    # clamp epsilon is 1e-30 to MATCH metrics.device.poisson_deviance_device
+    # exactly (1e-300 is unrepresentable in f32); the clamp is live only for
+    # 0 < y < 1e-30, where the y multiplier makes the difference immaterial,
+    # but host and device must agree bit-for-bit on the formula (ADVICE r4)
+    ylog = np.where(y > 0, y * np.log(np.maximum(y, 1e-30) / mu), 0.0)
     return float(np.mean(2.0 * (ylog - (y - mu))))
 
 

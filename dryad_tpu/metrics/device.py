@@ -90,7 +90,8 @@ def mae_device(y, s):
 
 
 def poisson_deviance_device(y, s):
-    """Mirror of metrics.poisson_deviance (raw log-rate scores)."""
+    """Mirror of metrics.poisson_deviance (raw log-rate scores); the 1e-30
+    clamp epsilon matches the host mirror exactly (ADVICE r4)."""
     mu = jnp.exp(s)
     ylog = jnp.where(y > 0, y * jnp.log(jnp.maximum(y, 1e-30) / mu), 0.0)
     return jnp.mean(2.0 * (ylog - (y - mu)))

@@ -67,6 +67,11 @@ def test_dart_valid_bookkeeping_consistent(data, backend):
     final = seen["valid_auc"]
     recomp = auc(y, b.predict_binned(ds.X_binned, raw_score=True))
     assert abs(final - recomp) < 1e-5
+    # DART must NOT record best_iteration (ADVICE r4 high): drops after the
+    # best iteration rescale EARLIER trees in place, so the prefix ending at
+    # best_iteration is not the ensemble that was scored — predict must
+    # default to the full (final, rescaled) model.
+    assert b.best_iteration == -1
 
 
 @pytest.mark.parametrize("backend", ["cpu", "tpu"])
