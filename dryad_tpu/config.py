@@ -109,6 +109,13 @@ class Params:
     # iterations for multiclass; skipped entirely with prob skip_drop),
     # fits the new tree against the pruned ensemble, then scales the new
     # tree by 1/(k+1) and the k dropped iterations by k/(k+1).
+    # rf: random-forest mode (LightGBM boosting_type="rf" semantics):
+    # every tree fits the gradients at the CONSTANT init score (no
+    # residual chaining), trains on a fresh bagged subset (subsample < 1
+    # required, per-iteration Philox draw), shrinkage is forced to 1.0
+    # (see effective_learning_rate), and the prediction is
+    # init + (sum of tree outputs) / n_iterations — an average of
+    # full-strength trees rather than a boosted sum.
     boosting: str = "gbdt"
     goss_top_rate: float = 0.2
     goss_other_rate: float = 0.1
@@ -171,6 +178,15 @@ class Params:
         """Trees trained per boosting iteration (K for multiclass, else 1)."""
         return self.num_class if self.objective == "multiclass" else 1
 
+    @property
+    def effective_learning_rate(self) -> float:
+        """1.0 under boosting='rf' — rf averages full-strength trees
+        (LightGBM likewise forces shrinkage 1.0 in rf mode); shrinking
+        them would just scale the average.  Both leaf-value finalizers
+        (engine/grower.py, cpu/histogram.leaf_output) use THIS, never the
+        raw learning_rate, so the two backends cannot diverge."""
+        return 1.0 if self.boosting == "rf" else self.learning_rate
+
     def validate(self) -> "Params":
         if self.objective not in OBJECTIVES:
             raise ValueError(f"objective must be one of {OBJECTIVES}, got {self.objective!r}")
@@ -186,8 +202,15 @@ class Params:
             raise ValueError("min_data_in_leaf must be >= 1")
         if any(m not in (-1, 0, 1) for m in self.monotone_constraints):
             raise ValueError("monotone_constraints entries must be -1, 0 or +1")
-        if self.boosting not in ("gbdt", "goss", "dart"):
-            raise ValueError("boosting must be 'gbdt', 'goss' or 'dart'")
+        if self.boosting not in ("gbdt", "goss", "dart", "rf"):
+            raise ValueError("boosting must be 'gbdt', 'goss', 'dart' or 'rf'")
+        if self.boosting == "rf" and self.subsample >= 1.0:
+            # without row bagging every rf tree would fit the SAME
+            # gradients on the SAME rows and the average would equal one
+            # tree (LightGBM likewise requires bagging for rf)
+            raise ValueError(
+                "boosting='rf' requires subsample < 1.0: trees only "
+                "de-correlate through per-iteration row bagging")
         if self.boosting == "dart":
             if not (0.0 <= self.drop_rate <= 1.0):
                 raise ValueError("drop_rate must be in [0, 1]")

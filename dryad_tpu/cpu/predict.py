@@ -47,6 +47,18 @@ def predict_tree_leaves(
     return node
 
 
+def rf_average(raw, init_score, n_iter: int) -> np.ndarray:
+    """THE rf averaging transform: init + (Σ - init) * (1/n) in f32 with a
+    HOST-computed reciprocal (config.py rf note).  One definition shared by
+    both predict backends and the CPU trainer's streamed eval — the
+    arithmetic is a bit-identity invariant (a device division lowers as
+    reciprocal-multiply and device multiply-add fuses to FMA, each 1 ulp
+    off host; measured breaking CPU↔TPU predict equality)."""
+    inv = np.float32(1.0) / np.float32(n_iter)
+    init = np.asarray(init_score, np.float32)
+    return (init + (np.asarray(raw) - init) * inv).astype(np.float32)
+
+
 def predict_binned_cpu(
     booster, Xb: np.ndarray, num_iteration: Optional[int] = None
 ) -> np.ndarray:
@@ -64,10 +76,11 @@ def predict_binned_cpu(
     score = native.predict_accumulate(
         Xb, trees, booster.init_score, n_iter * K, K, booster.max_depth_seen
     )
-    if score is not None:
-        return score
-    score = np.broadcast_to(booster.init_score, (N, K)).astype(np.float32).copy()
-    for t in range(n_iter * K):
-        leaves = predict_tree_leaves(trees, Xb, t, booster.max_depth_seen)
-        score[:, t % K] += booster.value[t, leaves]
+    if score is None:
+        score = np.broadcast_to(booster.init_score, (N, K)).astype(np.float32).copy()
+        for t in range(n_iter * K):
+            leaves = predict_tree_leaves(trees, Xb, t, booster.max_depth_seen)
+            score[:, t % K] += booster.value[t, leaves]
+    if booster.params.boosting == "rf" and n_iter > 0:
+        score = rf_average(score, booster.init_score, n_iter)
     return score

@@ -166,6 +166,14 @@ def predict_contrib(booster, Xb: np.ndarray,
         for n in range(N):
             _tree_shap_one(feature, left, right, value, cover,
                            decisions[n], out[n, k])
+    if booster.params.boosting == "rf" and n_iter > 0:
+        # rf predictions average the trees (config.py), so every per-tree
+        # term — contributions AND tree expectations — scales by 1/n while
+        # the init_score bias term does not; the efficiency property
+        # (contributions + bias == raw prediction) is preserved exactly
+        init = np.asarray(booster.init_score, np.float64)
+        out /= n_iter
+        out[:, :, F] += init[None, :] * (1.0 - 1.0 / n_iter)
     return out[:, 0] if K == 1 else out
 
 

@@ -325,7 +325,8 @@ class _TreeGrower:
                 continue
             out["feature"][t, node] = -1
             out["value"][t, node] = leaf_output(
-                leaf_G[slot], leaf_H[slot], self.p.lambda_l2, self.p.learning_rate,
+                leaf_G[slot], leaf_H[slot], self.p.lambda_l2,
+                self.p.effective_learning_rate,
                 leaf_lo[slot], leaf_hi[slot],
             )
         return max_seen_depth
@@ -402,6 +403,12 @@ def train_cpu(
                 f"init_booster already has {prev.num_iterations} iterations; "
                 f"new num_trees={T // K} must be >= that"
             )
+        if ("rf" in (prev.params.boosting, p.boosting)
+                and prev.params.boosting != p.boosting):
+            raise ValueError(
+                "cannot continue training across rf and non-rf boosting: "
+                "rf predictions AVERAGE the trees, so a mixed tree table "
+                "has no sound aggregation")
         for t in range(prev.num_total_trees):
             leaves = predict_tree_leaves(prev.tree_arrays(), Xb, t, prev.max_depth_seen)
             score[:, t % K] += prev.value[t, leaves]
@@ -436,6 +443,22 @@ def train_cpu(
         # so truncating predict there would score a model that never
         # existed (ADVICE r4); DART's own checkpoints always carry -1
 
+    def _grad_hess(sc):
+        if p.objective == "lambdarank":
+            g_, h_ = obj.grad_hess_np(sc[:, 0], y, data.weight,
+                                      query_offsets=qoff)
+            return g_[:, None], h_[:, None]
+        if K > 1:
+            return obj.grad_hess_np(sc, y, data.weight)
+        g_, h_ = obj.grad_hess_np(sc[:, 0], y, data.weight)
+        return g_[:, None], h_[:, None]
+
+    # rf: every tree fits the gradients at the CONSTANT init score — trees
+    # de-correlate only through the per-iteration bag, never through
+    # residual chaining — so grad/hess are computed ONCE (config.py rf note)
+    rf_gh = (_grad_hess(np.broadcast_to(init, (N, K)).astype(np.float32))
+             if p.boosting == "rf" else None)
+
     all_rows = np.arange(N, dtype=np.int64)
     for it in range(start_iter, T // K):
         # resuming from a checkpoint taken at the early-stop boundary must
@@ -469,14 +492,7 @@ def train_cpu(
                 for c in range(K):
                     out["value"][int(d_it) * K + c] *= factor_drop
 
-        if p.objective == "lambdarank":
-            grads, hess = obj.grad_hess_np(score[:, 0], y, data.weight, query_offsets=qoff)
-            grads, hess = grads[:, None], hess[:, None]
-        elif K > 1:
-            grads, hess = obj.grad_hess_np(score, y, data.weight)
-        else:
-            grads, hess = obj.grad_hess_np(score[:, 0], y, data.weight)
-            grads, hess = grads[:, None], hess[:, None]
+        grads, hess = rf_gh if rf_gh is not None else _grad_hess(score)
 
 
         row_mask, feat_mask = sample_masks(p, it, N, F)
@@ -522,6 +538,13 @@ def train_cpu(
             from dryad_tpu.metrics import evaluate_raw
 
             for vi, ((vname, vds), vscore) in enumerate(zip(valids, vscores)):
+                if p.boosting == "rf":
+                    # rf scores a model that AVERAGES the trees grown so
+                    # far — the EXACT shared transform predict applies, so
+                    # the streamed metric equals a post-hoc recompute
+                    from dryad_tpu.cpu.predict import rf_average
+
+                    vscore = rf_average(vscore, init, it + 1)
                 name, value, higher = evaluate_raw(
                     p.objective, p.metric, vds.y,
                     vscore if K > 1 else vscore[:, 0],

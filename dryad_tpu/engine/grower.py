@@ -159,7 +159,7 @@ def finalize_leaf_values(p: Params, M: int, slot_node, slot_G, slot_H,
     raw = -(slot_G / (slot_H + jnp.float32(p.lambda_l2)))
     if slot_lo is not None:
         raw = jnp.clip(raw, slot_lo, slot_hi)
-    vals = raw * jnp.float32(p.learning_rate)
+    vals = raw * jnp.float32(p.effective_learning_rate)
     idx = jnp.where(slot_node >= 0, slot_node, M)
     return value.at[idx].set(vals, mode="drop")
 

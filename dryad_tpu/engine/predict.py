@@ -14,6 +14,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 
 def tree_leaves(tree: dict, Xb: jnp.ndarray, depth_bound) -> jnp.ndarray:
@@ -70,8 +71,12 @@ def _accumulate(trees: dict, Xb: jnp.ndarray, init: jnp.ndarray, depth_bound: in
 
 def predict_binned_device(
     booster, Xb, num_iteration: Optional[int] = None
-) -> jnp.ndarray:
-    """``dryad.predict`` device backend on pre-binned rows → raw scores (N, K)."""
+):
+    """``dryad.predict`` device backend on pre-binned rows → raw scores
+    (N, K).  Returns a device array — except under ``boosting='rf'``,
+    where the final averaging transform runs on host (see below) and a
+    numpy array comes back; the sole caller (Booster.predict_binned) ends
+    in ``np.asarray`` either way."""
     K = booster.num_outputs
     if num_iteration is None:
         n_iter = booster.best_iteration if booster.best_iteration > 0 else booster.num_iterations
@@ -85,4 +90,13 @@ def predict_binned_device(
     }
     Xb = jnp.asarray(Xb)
     init = jnp.asarray(booster.init_score)
-    return _accumulate(trees, Xb, init, max(booster.max_depth_seen, 1))
+    raw = _accumulate(trees, Xb, init, max(booster.max_depth_seen, 1))
+    if booster.params.boosting == "rf" and n_iter > 0:
+        # rf averaging runs ON HOST via the ONE shared transform (device
+        # FMA fusion is 1 ulp off — see cpu/predict.rf_average); the
+        # accumulation stays on device, only the final elementwise
+        # transform moves (predict ends in one host fetch anyway)
+        from dryad_tpu.cpu.predict import rf_average
+
+        return rf_average(np.asarray(raw), booster.init_score, n_iter)
+    return raw

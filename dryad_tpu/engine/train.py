@@ -152,7 +152,7 @@ def _chunk_jit(p, B, has_cat, mesh, platform, learn_missing, N, K, pad,
                bmask=None, bag_bits=None, fmask_chunk=None,
                metric_names=(), ndcg_at=10, eval_period=1, total_iters=0,
                vXbs=(), vys=(), vqids=(), vscores=(), eval_buf=None,
-               eval_its=None, eval_cnt=None):
+               eval_its=None, eval_cnt=None, init_arr=None):
     """``n_iters`` whole boosting iterations inside ONE program.
 
     Through a remote device tunnel every host dispatch costs seconds at 10M
@@ -186,7 +186,13 @@ def _chunk_jit(p, B, has_cat, mesh, platform, learn_missing, N, K, pad,
         else:
             bag_i = bag
         fmask_i = fmask if fmask_chunk is None else fmask_chunk[i]
-        g_all, h_all = _grads_body(p, N, K, pad, score, y, weight, qoff,
+        # rf: grads at the CONSTANT init score (loop-invariant — XLA hoists
+        # the computation out of the fori body); broadcast inside the trace
+        # so no (NP, K) constant ships through the remote-compile tunnel
+        score_g = (jnp.broadcast_to(init_arr.astype(jnp.float32),
+                                    score.shape)
+                   if p.boosting == "rf" else score)
+        g_all, h_all = _grads_body(p, N, K, pad, score_g, y, weight, qoff,
                                    rank_row, rank_col, rank_Q, rank_S)
         if p.boosting == "goss":
             # device-drawn uniforms (bit-identical to the host generator)
@@ -241,9 +247,20 @@ def _chunk_jit(p, B, has_cat, mesh, platform, learn_missing, N, K, pad,
 
             def write(args):
                 buf, its, cnt = args
+                if p.boosting == "rf":
+                    # score the AVERAGED model — same fp32 transform as
+                    # predict (_rf_avg_jit / cpu mirror); the reciprocal is
+                    # an exact IEEE division (identical to the host's), the
+                    # iteration count is traced so it can't be host-side
+                    initf = init_arr.astype(jnp.float32)
+                    inv_it = jnp.float32(1.0) / (it_now + 1).astype(jnp.float32)
+                    vs_eval = [initf + (vscores[vi] - initf) * inv_it
+                               for vi in range(n_valid)]
+                else:
+                    vs_eval = list(vscores)
                 vals = jnp.stack([
                     eval_value(metric_names[vi], ndcg_at, vys[vi],
-                               vscores[vi], vqids[vi])
+                               vs_eval[vi], vqids[vi])
                     for vi in range(n_valid)])
                 return (buf.at[cnt].set(vals), its.at[cnt].set(it_now),
                         cnt + 1)
@@ -399,6 +416,17 @@ _dart_replay_jit = partial(jax.jit, static_argnames=("depth_bound",))(
         trees, Xb, init, depth_bound))
 
 
+@jax.jit
+def _rf_avg_jit(vs, init, inv):
+    """rf eval transform: averaged raw score init + (Σ - init)*(1/n) with
+    the HOST-computed reciprocal — the same arithmetic as both predict
+    paths (cpu/predict.py), so the metric scores the model predict would
+    serve (up to device FMA fusion of the multiply-add, a 1-ulp
+    tie-flip-only difference)."""
+    initf = init.astype(jnp.float32)
+    return initf + (vs - initf) * inv
+
+
 @partial(jax.jit, static_argnames=("depth_bound",))
 def _dart_drop_jit(out, score, tids, tcls, Xb, factor_drop, depth_bound):
     """DART drop bookkeeping in ONE dispatch: ``tids`` (max_drop*K,)
@@ -549,6 +577,14 @@ def train_device(
         return _grads_jit(p_key, N, K, pad, score, y, weight, qoff_j,
                           rank_row, rank_col, rank_Q, rank_S)
 
+    # rf: grad/hess at the CONSTANT init score, computed ONCE — trees
+    # de-correlate only through the per-iteration bag (config.py rf note);
+    # `score` itself still accumulates tree sums (predict-time averaging)
+    rf_gh = grads(score) if p.boosting == "rf" else None
+    # loop-invariant device init for the rf eval transform (uploading it
+    # per eval costs a tunnel round-trip each)
+    init_dev = jnp.asarray(init) if p.boosting == "rf" else None
+
     learn_missing = data.has_missing
     if jax.process_count() > 1:
         # multi-host: the flag is a static jit arg and rows are sharded per
@@ -592,6 +628,12 @@ def train_device(
             )
         if prev.num_total_trees > T:
             raise ValueError("new num_trees must cover the init_booster's iterations")
+        if ("rf" in (prev.params.boosting, p.boosting)
+                and prev.params.boosting != p.boosting):
+            raise ValueError(
+                "cannot continue training across rf and non-rf boosting: "
+                "rf predictions AVERAGE the trees, so a mixed tree table "
+                "has no sound aggregation")
         prev_trees = {
             key: jnp.asarray(v).reshape((prev.num_iterations, K) + v.shape[1:])
             for key, v in prev.tree_arrays().items()
@@ -911,7 +953,7 @@ def train_device(
                 jnp.int32(it), jnp.int32(n), bmask, bag_bits, fmask_chunk,
                 metric_names, p.ndcg_at, p.eval_period, total_iters,
                 vXbs_t, vys_t, vqids_t, vscores_t, eval_buf, eval_its,
-                eval_cnt)
+                eval_cnt, init_arr=jnp.asarray(init))
 
             if not calibrated:
                 # drain the pipeline: chunk 0 absorbs compile, chunk 1 is
@@ -1035,7 +1077,7 @@ def train_device(
             else:
                 g_all, h_all = grads(score)
         else:
-            g_all, h_all = grads(score)
+            g_all, h_all = rf_gh if rf_gh is not None else grads(score)
         if p.boosting == "goss":
             u_np = np.pad(goss_uniform(p, it, N), (0, pad), constant_values=2.0)
             u = jnp.asarray(u_np)
@@ -1083,7 +1125,14 @@ def train_device(
         # the training tail is never silently unscored
         eval_now = (it + 1) % p.eval_period == 0 or it + 1 == T // K
         if valids and eval_now:
-            vals_dev = [fn(vscores[vi])
+            if p.boosting == "rf":
+                # rf scores the AVERAGED model — same transform as predict
+                inv_it = jnp.float32(np.float32(1.0) / np.float32(it + 1))
+                vs_eval = [_rf_avg_jit(vs, init_dev, inv_it)
+                           for vs in vscores]
+            else:
+                vs_eval = vscores
+            vals_dev = [fn(vs_eval[vi])
                         for vi, (_, _, fn) in enumerate(evaluators)]
             if not sync_eval:
                 deferred.append((it, vals_dev))

@@ -1,0 +1,193 @@
+"""Random-forest boosting mode (boosting="rf", LightGBM rf semantics —
+SURVEY.md §2 #9/#10 de-facto surface; VERDICT r4 missing #2).
+
+Semantics pinned here (config.py rf note): trees fit gradients at the
+CONSTANT init score on per-iteration bags, shrinkage is forced to 1.0,
+and predictions AVERAGE the trees: raw = init + Σ_t value_t / n_iter.
+"""
+
+import numpy as np
+import pytest
+
+import dryad_tpu as dryad
+from dryad_tpu.metrics import auc
+
+PARAMS = dict(objective="binary", boosting="rf", num_trees=25,
+              num_leaves=31, max_depth=6, max_bins=64, subsample=0.7,
+              colsample=0.8, seed=5)
+
+
+@pytest.fixture(scope="module")
+def data():
+    from dryad_tpu.datasets import higgs_like
+
+    X, y = higgs_like(8000, seed=3)
+    return X, y, dryad.Dataset(X, y, max_bins=64)
+
+
+def test_rf_requires_bagging():
+    with pytest.raises(ValueError, match="subsample"):
+        dryad.make_params(dict(PARAMS, subsample=1.0))
+
+
+def test_rf_forces_unit_shrinkage():
+    p = dryad.make_params(dict(PARAMS, learning_rate=0.05))
+    assert p.effective_learning_rate == 1.0
+    assert dryad.make_params(dict(PARAMS, boosting="gbdt", subsample=1.0,
+                                  learning_rate=0.05)
+                             ).effective_learning_rate == 0.05
+
+
+def test_rf_cpu_device_parity(data):
+    """CLAUDE.md invariant: identical structures; near-equal values
+    (separately-trained value tables differ by reduction order, same
+    tolerance class as DART); bit-identical predict on the SAME booster."""
+    X, y, ds = data
+    bc = dryad.train(PARAMS, ds, backend="cpu")
+    bt = dryad.train(PARAMS, ds, backend="tpu")
+    np.testing.assert_array_equal(bc.feature, bt.feature)
+    np.testing.assert_array_equal(bc.threshold, bt.threshold)
+    np.testing.assert_allclose(bc.value, bt.value, rtol=1e-4, atol=1e-6)
+    p_cpu = bc.predict_binned(ds.X_binned, raw_score=True, backend="cpu")
+    p_tpu = bc.predict_binned(ds.X_binned, raw_score=True, backend="tpu")
+    np.testing.assert_array_equal(p_cpu, np.asarray(p_tpu))
+
+
+def test_rf_prediction_is_average_of_trees(data):
+    """raw == init + Σ_t value_t * (1/n) with the host-computed reciprocal."""
+    X, y, ds = data
+    b = dryad.train(PARAMS, ds, backend="cpu")
+    raw = b.predict_binned(ds.X_binned, raw_score=True)
+    from dryad_tpu.cpu.predict import predict_tree_leaves
+
+    trees = b.tree_arrays()
+    total = np.zeros(ds.X_binned.shape[0], np.float32)
+    for t in range(b.num_total_trees):
+        lv = predict_tree_leaves(trees, ds.X_binned, t, b.max_depth_seen)
+        total += b.value[t, lv]
+    inv = np.float32(1.0) / np.float32(b.num_iterations)
+    expect = np.float32(b.init_score[0]) + total * inv
+    np.testing.assert_allclose(raw, expect, rtol=1e-6, atol=1e-7)
+    # trees are full-strength: averaging (not summing) keeps raw bounded
+    assert np.abs(raw).max() < np.abs(total).max()
+
+
+def test_rf_quality_and_differs_from_gbdt(data):
+    X, y, ds = data
+    b_rf = dryad.train(PARAMS, ds, backend="cpu")
+    b_gb = dryad.train(dict(PARAMS, boosting="gbdt"), ds, backend="cpu")
+    a_rf = auc(y, dryad.predict(b_rf, X, raw_score=True))
+    a_gb = auc(y, dryad.predict(b_gb, X, raw_score=True))
+    assert a_rf > 0.7                       # forest learns
+    assert not np.array_equal(b_rf.value, b_gb.value)
+    # rf trees all fit the SAME constant-gradient target: structures repeat
+    # only bag-to-bag, so the model is valid but weaker than boosting here
+    assert a_gb - a_rf < 0.15
+
+
+@pytest.mark.parametrize("backend", ["cpu", "tpu"])
+def test_rf_valid_bookkeeping_matches_predict(data, backend):
+    """The metric streamed during training scores the AVERAGED model —
+    exactly what predict serves."""
+    X, y, ds = data
+    seen = {}
+    b = dryad.train(dict(PARAMS, num_trees=10), ds, [ds], backend=backend,
+                    callback=lambda it, info: seen.update(info))
+    # seen holds the LAST iteration's value; predict defaults to
+    # best_iteration (recorded for rf — sound, unlike DART), so recompute
+    # at the full length explicitly
+    recomp = auc(y, b.predict_binned(ds.X_binned, raw_score=True,
+                                     num_iteration=b.num_iterations))
+    assert abs(seen["valid_auc"] - recomp) < 1e-5
+
+
+def test_rf_chunked_deferred_eval_matches_recompute(data):
+    """No callback / no early stopping -> the CHUNKED device program runs
+    rf (constant-gradient grads + in-program averaged eval); its deferred
+    history must score the model predict serves."""
+    X, y, ds = data
+    b = dryad.train(dict(PARAMS, num_trees=10), ds, [ds], backend="tpu")
+    hist = b.train_state["eval_history"]["valid_auc"]
+    assert [it for it, _ in hist] == list(range(10))
+    recomp = auc(y, b.predict_binned(ds.X_binned, raw_score=True,
+                                     num_iteration=b.num_iterations))
+    # same math, different fusion shape (documented tolerance)
+    np.testing.assert_allclose(hist[-1][1], recomp, rtol=2e-5, atol=2e-6)
+
+
+@pytest.mark.parametrize("backend", ["cpu", "tpu"])
+def test_rf_kill_and_resume_bit_identical(tmp_path, data, backend):
+    X, y, ds = data
+    p = dict(PARAMS, num_trees=12)
+    full = dryad.train(p, ds, backend=backend)
+
+    class Crash(RuntimeError):
+        pass
+
+    def crash_at(it, info):
+        if it == 7:
+            raise Crash
+
+    ckdir = str(tmp_path / backend)
+    with pytest.raises(Crash):
+        dryad.train(p, ds, backend=backend, checkpoint_dir=ckdir,
+                    checkpoint_every=3, callback=crash_at)
+    resumed = dryad.train(p, ds, backend=backend, checkpoint_dir=ckdir,
+                          checkpoint_every=3, resume=True)
+    np.testing.assert_array_equal(full.feature, resumed.feature)
+    np.testing.assert_array_equal(full.value, resumed.value)
+    np.testing.assert_array_equal(
+        dryad.predict(full, X, raw_score=True),
+        dryad.predict(resumed, X, raw_score=True))
+
+
+def test_rf_mixed_mode_continuation_rejected(data):
+    X, y, ds = data
+    b_gb = dryad.train(dict(PARAMS, boosting="gbdt", num_trees=5), ds,
+                       backend="cpu")
+    with pytest.raises(ValueError, match="rf"):
+        dryad.train(dict(PARAMS, num_trees=10), ds, backend="cpu",
+                    init_booster=b_gb)
+
+
+def test_rf_shap_efficiency(data):
+    """contributions + bias == averaged raw prediction, exactly."""
+    X, y, ds = data
+    b = dryad.train(PARAMS, ds, backend="cpu")
+    raw = b.predict_binned(ds.X_binned[:64], raw_score=True)
+    contrib = b.predict_binned(ds.X_binned[:64], pred_contrib=True)
+    np.testing.assert_allclose(contrib.sum(axis=1), raw,
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_rf_early_stopping_allowed(data):
+    """rf + early stopping is sound (prefix of an rf model IS an rf model
+    of fewer trees — unlike DART) and truncates predict at the best."""
+    X, y, ds = data
+    b = dryad.train(dict(PARAMS, num_trees=20, early_stopping_rounds=3),
+                    ds, [ds], backend="cpu")
+    assert b.best_iteration > 0
+    raw_best = b.predict_binned(ds.X_binned, raw_score=True)
+    raw_all = b.predict_binned(ds.X_binned, raw_score=True,
+                               num_iteration=b.num_iterations)
+    if b.best_iteration < b.num_iterations:
+        assert not np.array_equal(raw_best, raw_all)
+
+
+def test_rf_multiclass(data):
+    from dryad_tpu.datasets import covertype_like
+
+    X, y = covertype_like(4000, seed=11)
+    ds = dryad.Dataset(X, y, max_bins=32)
+    p = dict(PARAMS, objective="multiclass", num_class=7, max_bins=32,
+             num_trees=8)
+    bc = dryad.train(p, ds, backend="cpu")
+    bt = dryad.train(p, ds, backend="tpu")
+    # rf refits the SAME constant gradients every iteration, so fp32
+    # near-tie argmax flips between backends recur more often than under
+    # boosting (documented tolerance, CLAUDE.md) — bound the divergence
+    # instead of requiring zero
+    mismatch = (bc.feature != bt.feature).mean()
+    assert mismatch < 0.02, f"{mismatch:.4f} of nodes diverged"
+    acc = (dryad.predict(bc, X).argmax(axis=1) == y).mean()
+    assert acc > 0.5
