@@ -178,6 +178,100 @@ class Booster:
         out = get_objective(self.params).transform_np(raw)
         return out if self.num_outputs > 1 else out[:, 0] if out.ndim == 2 else out
 
+    # ---- refit -------------------------------------------------------------
+    def refit(self, X: np.ndarray, y: np.ndarray, *,
+              weight: Optional[np.ndarray] = None,
+              decay_rate: float = 0.9) -> "Booster":
+        """LightGBM-style refit: keep every tree's STRUCTURE, re-derive the
+        leaf values from new data (model adaptation without regrowth).
+
+        Walking trees in training order with scores accumulated as in
+        training, each leaf gets ``decay_rate * old + (1 - decay_rate) *
+        new``, where ``new`` is the Newton value -G/(H+λ) · shrinkage from
+        the new data's grad/hess at the current refit scores (LightGBM's
+        ``Booster.refit`` semantics).  L1-family objectives take the
+        residual-percentile renewal instead of Newton (the same
+        objectives.renew_alpha convention training uses).  Leaves that
+        receive no new rows keep their old value.  ``X`` is binned through
+        the model's OWN frozen mapper.  DART models are rejected (their
+        value table mixes rescale generations — no per-tree gradient
+        step to refit).  Returns a new Booster; eval/early-stop state and
+        best_iteration are cleared (they describe the old fit).  Monotone
+        constraints are NOT re-enforced: the split structure remains the
+        monotone-chosen one, but refitted leaf values come from new-data
+        statistics without the grower's bound clamping (documented
+        divergence — the training-time bounds are not stored on the
+        model).
+        """
+        from dryad_tpu.cpu.predict import predict_tree_leaves
+        from dryad_tpu.objectives import get_objective
+        from dryad_tpu.objectives import renew_alpha as _renew_alpha
+
+        p = self.params
+        if p.boosting == "dart":
+            raise ValueError("refit is unsupported for DART models: the "
+                             "value table mixes drop-rescale generations")
+        if p.objective == "lambdarank":
+            raise ValueError("refit is unsupported for lambdarank models: "
+                             "per-query lambda gradients need query "
+                             "groups, which refit does not take")
+        if not (0.0 <= decay_rate <= 1.0):
+            raise ValueError("decay_rate must be in [0, 1]")
+        K = self.num_outputs
+        Xb = self.mapper.transform(np.asarray(X, np.float32))
+        y = np.asarray(y, np.float32)
+        w = None if weight is None else np.asarray(weight, np.float32)
+        obj = get_objective(p)
+        N = Xb.shape[0]
+        T = self.num_total_trees
+        trees = self.tree_arrays()
+        value = self.value.copy()
+        lam = np.float32(p.lambda_l2)
+        lr = np.float32(p.effective_learning_rate)
+        decay = np.float32(decay_rate)
+        renew_a = (_renew_alpha(p)
+                   if w is None and p.boosting in ("gbdt", "goss") else None)
+        score = np.broadcast_to(self.init_score, (N, K)).astype(np.float32).copy()
+        score0 = score.copy()           # rf: gradients at the constant init
+        g = h = None
+        depth = max(self.max_depth_seen, 1)
+
+        def _gh(sc):
+            if K > 1:
+                return obj.grad_hess_np(sc, y, w)
+            g1, h1 = obj.grad_hess_np(sc[:, 0], y, w)
+            return g1[:, None], h1[:, None]
+
+        rf_gh = _gh(score0) if p.boosting == "rf" else None
+        for t in range(T):
+            k = t % K
+            if k == 0:
+                # rf gradients are constant (trainer parity) — one pass
+                g, h = rf_gh if rf_gh is not None else _gh(score)
+            lv = predict_tree_leaves(trees, Xb, t, depth)
+            leaf_nodes = np.unique(lv)
+            for node in leaf_nodes:
+                m = lv == node
+                if renew_a is not None:
+                    from dryad_tpu.cpu.trainer import type1_quantile
+
+                    rs = np.sort((y[m] - score[m, k]).astype(np.float32))
+                    new_v = type1_quantile(rs, renew_a) * lr
+                else:
+                    G = np.float32(g[m, k].sum(dtype=np.float64))
+                    H = np.float32(h[m, k].sum(dtype=np.float64))
+                    new_v = np.float32(-(G / (H + lam))) * lr
+                value[t, node] = (decay * value[t, node]
+                                  + (np.float32(1.0) - decay) * new_v)
+            score[:, k] += value[t, lv]
+        return Booster(
+            p, self.mapper, self.feature, self.threshold, self.left,
+            self.right, value, self.is_cat, self.cat_bitset,
+            self.init_score, self.max_depth_seen, best_iteration=-1,
+            gain=self.gain, default_left=self.default_left,
+            cover=self.cover,
+        )
+
     # ---- serialization -----------------------------------------------------
     def save(self, path: str) -> None:
         with open(path, "wb") as f:

@@ -130,6 +130,28 @@ def update_best(p, best_iteration, best_value, stale, iteration, value,
     return best_iteration, best_value, stale + 1
 
 
+def type1_quantile(sorted_r: np.ndarray, alpha: float) -> np.float32:
+    """Type-1 (inverse-CDF, no interpolation) quantile pick — THE renewal
+    order-statistic convention: index clip(ceil(f32(alpha)·f32(cnt)) - 1,
+    0, cnt-1) into the sorted residuals.  A pure element selection with
+    the index arithmetic in f32, so the CPU trainer, Booster.refit and the
+    device mirror (engine/train._renew_values) choose the bitwise-identical
+    value for identical inputs."""
+    cnt = sorted_r.size
+    kf = np.ceil(np.float32(alpha) * np.float32(cnt))
+    kidx = min(max(int(kf) - 1, 0), cnt - 1)
+    return np.float32(sorted_r[kidx])
+
+
+def renew_leaf_values_np(out, t, r, lv, alpha, lr):
+    """L1-family leaf renewal, CPU mirror of engine/train._renew_values:
+    replace each leaf's value with the type-1 alpha-quantile of its in-bag
+    residuals ``r`` times the shrinkage (see type1_quantile)."""
+    for node in np.unique(lv):
+        rs = np.sort(r[lv == node])
+        out["value"][t, node] = type1_quantile(rs, alpha) * np.float32(lr)
+
+
 def sample_masks(params: Params, iteration: int, num_rows: int, num_features: int):
     """Host-side deterministic bagging/colsample masks, shared by both backends."""
     row_mask = None
@@ -459,6 +481,13 @@ def train_cpu(
     rf_gh = (_grad_hess(np.broadcast_to(init, (N, K)).astype(np.float32))
              if p.boosting == "rf" else None)
 
+    # L1-family leaf renewal — same gates as the device trainer (train.py)
+    from dryad_tpu.objectives import renew_alpha as _obj_renew_alpha
+
+    renew_a = (_obj_renew_alpha(p)
+               if data.weight is None and p.boosting in ("gbdt", "goss")
+               else None)
+
     all_rows = np.arange(N, dtype=np.int64)
     for it in range(start_iter, T // K):
         # resuming from a checkpoint taken at the early-stop boundary must
@@ -506,6 +535,12 @@ def train_cpu(
             t = it * K + k
             d = grower.grow(grads[:, k], hess[:, k], rows, feat_mask, out, t)
             max_depth_seen = max(max_depth_seen, d)
+            if renew_a is not None:
+                lv = predict_tree_leaves(out, Xb[rows], t,
+                                         max(max_depth_seen, 1))
+                r = (y[rows] - score[rows, k]).astype(np.float32)
+                renew_leaf_values_np(out, t, r, lv, renew_a,
+                                     p.effective_learning_rate)
             if value_scale != 1.0:
                 out["value"][t] *= value_scale
             if not drop.size:

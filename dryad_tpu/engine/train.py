@@ -41,6 +41,7 @@ from dryad_tpu.dataset import Dataset
 from dryad_tpu.engine.grower import grow_any
 from dryad_tpu.engine.predict import _accumulate, tree_leaves
 from dryad_tpu.objectives import get_objective
+from dryad_tpu.objectives import renew_alpha as obj_renew_alpha
 
 _TREE_KEYS = ("feature", "threshold", "left", "right", "value", "is_cat",
               "cat_bitset", "gain", "default_left", "cover")
@@ -54,15 +55,43 @@ _TREE_KEYS = ("feature", "threshold", "left", "right", "value", "is_cat",
 _CHUNK_FB_LIMIT = 1 << 19
 
 
+def _renew_values(value, feature, leaves, y, score_k, bag, alpha, lr, M):
+    """Post-growth leaf renewal (objectives.renew_alpha): replace each
+    leaf's Newton value with the type-1 (inverse-CDF, no interpolation)
+    alpha-quantile of its in-bag residuals y - score, times the shrinkage.
+
+    Convention shared BITWISE with cpu/trainer.renew_leaf_values_np: the
+    order statistic at index clip(ceil(f32(alpha)·f32(cnt)) - 1, 0, cnt-1)
+    is a pure element selection — no interpolation arithmetic — so both
+    backends pick the identical f32 value and the only cross-backend
+    wobble is the residuals' own ulp-level score differences.  One global
+    two-key sort (leaf id primary, residual secondary; out-of-bag rows get
+    sentinel id M and sink to the tail) + a searchsorted for the segment
+    bounds — O(N log N) per tree, paid only by the robust objectives."""
+    r = y - score_k
+    lv = jnp.where(bag, leaves.astype(jnp.int32), M)
+    lv_s, r_s = jax.lax.sort((lv, r), num_keys=2)
+    bounds = jnp.searchsorted(lv_s, jnp.arange(M + 1, dtype=jnp.int32))
+    cnt = bounds[1:] - bounds[:-1]                       # (M,) per node
+    kf = jnp.ceil(jnp.float32(alpha) * cnt.astype(jnp.float32))
+    kidx = jnp.clip(kf.astype(jnp.int32) - 1, 0, jnp.maximum(cnt - 1, 0))
+    sel = jnp.clip(bounds[:-1] + kidx, 0, r_s.shape[0] - 1)
+    stat = r_s[sel] * jnp.float32(lr)
+    return jnp.where((feature < 0) & (cnt > 0), stat, value)
+
+
 def _step_body(p, B, has_cat, mesh, platform, learn_missing, out, score, Xb,
                g_all, h_all, bag, fmask, is_cat_feat, t, k, root_hist=None,
-               bmask=None, n_rows=None, value_scale=None):
+               bmask=None, n_rows=None, value_scale=None, y=None,
+               renew_alpha=None):
     """One (iteration, class) tree: grow, record into slot t, update scores.
 
     Shared by the per-iteration ``_step_jit`` dispatch and the chunked
     ``_chunk_jit`` fast path, so the two can never diverge.  ``root_hist``
     carries the class's slice of the shared-plan multiclass root pass
-    (single-device path only).
+    (single-device path only).  ``renew_alpha`` (static) turns on L1-family
+    leaf renewal — the residuals are taken against the PRE-update score,
+    the same ensemble the gradients saw.
     """
     out = dict(out)
     g = jnp.take(g_all, k, axis=1)
@@ -86,6 +115,11 @@ def _step_body(p, B, has_cat, mesh, platform, learn_missing, out, score, Xb,
         # each row's leaf comes straight out of the grower's partition
         # state — re-traversing 10M rows cost ~5 s/tree (gather-bound)
         leaves = tree.pop("row_leaf")
+    if renew_alpha is not None:
+        tree = dict(tree, value=_renew_values(
+            tree["value"], tree["feature"], leaves, y,
+            jnp.take(score, k, axis=1), bag, renew_alpha,
+            p.effective_learning_rate, p.max_nodes))
     if value_scale is not None:
         # DART: the new tree lands pre-scaled by 1/(k+1) — same f32
         # multiply order as the CPU mirror (finalize with lr, then scale)
@@ -100,7 +134,8 @@ def _step_body(p, B, has_cat, mesh, platform, learn_missing, out, score, Xb,
 
 _step_jit = partial(jax.jit,
                     static_argnames=("p", "B", "has_cat", "mesh", "platform",
-                                     "learn_missing", "n_rows"))(_step_body)
+                                     "learn_missing", "n_rows",
+                                     "renew_alpha"))(_step_body)
 # Module-level jit keyed on the static (params, bins, mesh) triple — the
 # compiled program is reused across ``train_device`` calls (a closure-local
 # jit would recompile per call and dwarf the training itself).  out/score
@@ -145,14 +180,15 @@ _grads_jit = partial(jax.jit,
          static_argnames=("p", "B", "has_cat", "mesh", "platform",
                           "learn_missing", "N", "K", "pad", "rank_Q",
                           "rank_S", "metric_names", "ndcg_at", "eval_period",
-                          "total_iters"))
+                          "total_iters", "renew_alpha"))
 def _chunk_jit(p, B, has_cat, mesh, platform, learn_missing, N, K, pad,
                rank_Q, rank_S, out, score, Xb, y, weight, bag, fmask,
                is_cat_feat, qoff, rank_row, rank_col, it0, n_iters,
                bmask=None, bag_bits=None, fmask_chunk=None,
                metric_names=(), ndcg_at=10, eval_period=1, total_iters=0,
                vXbs=(), vys=(), vqids=(), vscores=(), eval_buf=None,
-               eval_its=None, eval_cnt=None, init_arr=None):
+               eval_its=None, eval_cnt=None, init_arr=None,
+               renew_alpha=None):
     """``n_iters`` whole boosting iterations inside ONE program.
 
     Through a remote device tunnel every host dispatch costs seconds at 10M
@@ -225,7 +261,7 @@ def _chunk_jit(p, B, has_cat, mesh, platform, learn_missing, N, K, pad,
                 p, B, has_cat, mesh, platform, learn_missing, out, score,
                 Xb, g_all, h_all, bag_i, fmask_i, is_cat_feat, t, k,
                 root_hist=None if roots is None else roots[k], bmask=bmask,
-                n_rows=N)
+                n_rows=N, y=y, renew_alpha=renew_alpha)
 
         if n_valid:
             new_vs = []
@@ -609,11 +645,20 @@ def train_device(
              if learn_missing and bundled_np is not None and bundled_np.any()
              else None)
 
+    # L1-family leaf renewal (objectives.renew_alpha): gated OFF for
+    # weighted data (unweighted percentile only — documented divergence)
+    # and for dart/rf, whose residual bookkeeping diverges from the
+    # carried score (drop-pruned / constant-init ensembles)
+    renew_a = (obj_renew_alpha(p)
+               if data.weight is None and p.boosting in ("gbdt", "goss")
+               else None)
+
     def step(out, score, g_all, h_all, bag, fmask, t, k, root_hist=None,
              value_scale=None):
         return _step_jit(p_key, B, has_cat, mesh, plat, learn_missing, out,
                          score, Xb, g_all, h_all, bag, fmask, is_cat_feat, t, k,
-                         root_hist, bmask, n_rows=N, value_scale=value_scale)
+                         root_hist, bmask, n_rows=N, value_scale=value_scale,
+                         y=y, renew_alpha=renew_a)
 
     # ---- resume / warm start -------------------------------------------------
     out = _empty_out_device(T, p.max_nodes, CAT_WORDS)
@@ -953,7 +998,7 @@ def train_device(
                 jnp.int32(it), jnp.int32(n), bmask, bag_bits, fmask_chunk,
                 metric_names, p.ndcg_at, p.eval_period, total_iters,
                 vXbs_t, vys_t, vqids_t, vscores_t, eval_buf, eval_its,
-                eval_cnt, init_arr=jnp.asarray(init))
+                eval_cnt, init_arr=jnp.asarray(init), renew_alpha=renew_a)
 
             if not calibrated:
                 # drain the pipeline: chunk 0 absorbs compile, chunk 1 is

@@ -126,10 +126,9 @@ def _weighted_percentile(y: np.ndarray, weight, q: float) -> float:
 
 class L1:
     """Absolute error on raw scores.  Gradient sign(s - y), hessian 1
-    (LightGBM's formulation); leaf values are the regularized mean of
-    signs scaled by the learning rate — the per-leaf median renewal some
-    engines add is NOT performed (documented divergence; quantile/huber
-    cover the common robust cases with the same caveat)."""
+    (LightGBM's formulation); after growth the trainers RENEW each leaf to
+    the median of its in-bag residuals (see renew_alpha — LightGBM's
+    RenewTreeOutput semantics), replacing the sign-mean Newton value."""
 
     name = "l1"
     num_outputs = 1
@@ -244,8 +243,8 @@ class Fair:
 class Quantile:
     """Pinball loss at level ``alpha``: the booster estimates the alpha-
     quantile of y | x.  Gradient is -alpha below the data, (1 - alpha)
-    above; hessian 1 (LightGBM's formulation, same no-leaf-renewal caveat
-    as L1)."""
+    above; hessian 1 (LightGBM's formulation; leaves are renewed to the
+    alpha-percentile of in-bag residuals post-growth, see renew_alpha)."""
 
     name = "quantile"
     num_outputs = 1
@@ -440,6 +439,31 @@ class LambdaRank:
     @staticmethod
     def transform_np(score):
         return score
+
+
+def renew_alpha(params) -> float | None:
+    """Percentile level for post-growth leaf renewal, or None.
+
+    LightGBM refits L1-family leaf outputs to residual percentiles after
+    the tree is grown (RenewTreeOutput): the Newton step -G/(H+λ) with
+    unit hessians estimates the leaf MEAN of the gradient signs, while the
+    L1-optimal leaf value is the residual MEDIAN (and the pinball-optimal
+    value the alpha-quantile).  Applied for l1 (median), quantile
+    (params.alpha), and huber (median — the L1-family treatment; huber's
+    minimizer lies between mean and median and the median is the robust
+    choice).  The trainers additionally gate renewal OFF for weighted
+    datasets (our percentile is unweighted — documented divergence), for
+    boosting dart/rf (dart redefines the ensemble mid-iteration; rf
+    gradients live at the constant init score), and for monotone
+    constraints (the grower clamps Newton values to the monotone bounds;
+    an unclamped percentile could re-break the ordering) — see train.py."""
+    if params.monotone_constraints and any(params.monotone_constraints):
+        return None
+    if params.objective in ("l1", "huber"):
+        return 0.5
+    if params.objective == "quantile":
+        return params.alpha
+    return None
 
 
 def get_objective(params) -> object:

@@ -1,0 +1,246 @@
+"""L1-family leaf renewal (objectives.renew_alpha — LightGBM
+RenewTreeOutput semantics; VERDICT r4 missing #3): post-growth refit of
+leaf values to residual percentiles on both backends."""
+
+import numpy as np
+import pytest
+
+import dryad_tpu as dryad
+from dryad_tpu.config import make_params
+from dryad_tpu.objectives import renew_alpha
+
+
+def _toy(n=6000, seed=4):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, 8)).astype(np.float32)
+    y = (X[:, 0] * 2 + np.sin(X[:, 1] * 3)
+         + rng.standard_t(2.0, n) * 0.5).astype(np.float32)
+    return X, y
+
+
+def test_renew_alpha_levels():
+    assert renew_alpha(make_params(objective="l1")) == 0.5
+    assert renew_alpha(make_params(objective="huber")) == 0.5
+    assert renew_alpha(make_params(objective="quantile", alpha=0.73)) == 0.73
+    assert renew_alpha(make_params(objective="regression")) is None
+    assert renew_alpha(make_params(objective="binary")) is None
+
+
+def test_single_tree_leaves_are_residual_medians():
+    """One depth-2 L1 tree: every leaf value must be exactly the type-1
+    median of its residuals (y - init) times the learning rate."""
+    X, y = _toy(2000)
+    ds = dryad.Dataset(X, y, max_bins=64)
+    p = dict(objective="l1", num_trees=1, num_leaves=4, max_depth=2,
+             learning_rate=0.3, min_data_in_leaf=20)
+    b = dryad.train(p, ds, backend="cpu")
+    from dryad_tpu.cpu.predict import predict_tree_leaves
+
+    lv = predict_tree_leaves(b.tree_arrays(), ds.X_binned, 0,
+                             b.max_depth_seen)
+    r = (y - np.float32(b.init_score[0])).astype(np.float32)
+    for node in np.unique(lv):
+        rs = np.sort(r[lv == node])
+        kf = np.ceil(np.float32(0.5) * np.float32(rs.size))
+        kidx = min(max(int(kf) - 1, 0), rs.size - 1)
+        expect = np.float32(rs[kidx]) * np.float32(0.3)
+        assert b.value[0, node] == expect, (node, b.value[0, node], expect)
+
+
+@pytest.mark.parametrize("obj,alpha", [("l1", None), ("huber", None),
+                                       ("quantile", 0.9)])
+def test_renewal_cpu_device_parity(obj, alpha):
+    """Both backends renew identically: same structures, near-equal values
+    (tie-free short fixture, CLAUDE.md parity convention)."""
+    X, y = _toy()
+    ds = dryad.Dataset(X, y, max_bins=32)
+    p = dict(objective=obj, num_trees=8, num_leaves=15, max_bins=32,
+             learning_rate=0.2)
+    if alpha:
+        p["alpha"] = alpha
+    bc = dryad.train(p, ds, backend="cpu")
+    bt = dryad.train(p, ds, backend="tpu")
+    np.testing.assert_array_equal(bc.feature, bt.feature)
+    np.testing.assert_array_equal(bc.threshold, bt.threshold)
+    np.testing.assert_allclose(bc.value, bt.value, rtol=1e-4, atol=1e-5)
+
+
+def test_renewal_improves_quantile_loss():
+    """The alpha-percentile refit must beat Newton-only leaves on pinball
+    loss (the property LightGBM's renewal exists for)."""
+    import dryad_tpu.objectives as O
+
+    X, y = _toy(12000)
+    ds = dryad.Dataset(X[:9000], y[:9000])
+    Xt, yt = X[9000:], y[9000:]
+    p = dict(objective="quantile", alpha=0.9, num_trees=40, num_leaves=31)
+    b_on = dryad.train(p, ds, backend="cpu")
+    real = O.renew_alpha
+    try:
+        O.renew_alpha = lambda _: None
+        b_off = dryad.train(p, ds, backend="cpu")
+    finally:
+        O.renew_alpha = real
+
+    def pinball(yv, s, a):
+        d = yv - s
+        return float(np.mean(np.maximum(a * d, (a - 1) * d)))
+
+    on = pinball(yt, dryad.predict(b_on, Xt), 0.9)
+    off = pinball(yt, dryad.predict(b_off, Xt), 0.9)
+    assert on < off, (on, off)
+
+
+def test_weighted_data_skips_renewal():
+    """Weighted datasets keep Newton leaves (unweighted percentile only —
+    documented divergence): unit weights must reproduce the
+    renewal-disabled run exactly."""
+    import dryad_tpu.objectives as O
+
+    X, y = _toy(3000)
+    p = dict(objective="l1", num_trees=4, num_leaves=15)
+    w = np.ones_like(y)
+    b_w = dryad.train(p, dryad.Dataset(X, y, weight=w), backend="cpu")
+    real = O.renew_alpha
+    try:
+        O.renew_alpha = lambda _: None
+        b_off = dryad.train(p, dryad.Dataset(X, y), backend="cpu")
+    finally:
+        O.renew_alpha = real
+    np.testing.assert_array_equal(b_w.value, b_off.value)
+
+
+def test_renewal_with_bagging_uses_bag_rows():
+    """Renewal statistics come from the in-bag rows only; the run must
+    stay cross-backend consistent under bagging."""
+    X, y = _toy()
+    ds = dryad.Dataset(X, y, max_bins=32)
+    p = dict(objective="l1", num_trees=6, num_leaves=15, max_bins=32,
+             subsample=0.6, seed=9)
+    bc = dryad.train(p, ds, backend="cpu")
+    bt = dryad.train(p, ds, backend="tpu")
+    np.testing.assert_array_equal(bc.feature, bt.feature)
+    np.testing.assert_allclose(bc.value, bt.value, rtol=1e-4, atol=1e-5)
+
+
+def test_sharded_renewal_parity():
+    """The renewal sort under a mesh is a GSPMD global sort (same class as
+    the GOSS quantile, CLAUDE.md): N-shard must equal 1-shard."""
+    import jax
+
+    from dryad_tpu.engine.distributed import make_mesh
+    from dryad_tpu.engine.train import train_device
+
+    X, y = _toy(4096, seed=41)
+    ds = dryad.Dataset(X, y, max_bins=32)
+    p = make_params(dict(objective="l1", num_trees=5, num_leaves=15,
+                         max_bins=32, seed=7))
+    mesh = make_mesh(jax.devices()[:8])
+    b1 = train_device(p, ds)
+    b8 = train_device(p, ds, mesh=mesh)
+    for k in ("feature", "threshold", "left", "right"):
+        np.testing.assert_array_equal(b1.tree_arrays()[k],
+                                      b8.tree_arrays()[k])
+    np.testing.assert_allclose(b1.value, b8.value, atol=1e-3)
+
+
+# ---- Booster.refit (LightGBM-style model adaptation) -----------------------
+
+def test_refit_decay_one_is_identity():
+    X, y = _toy(3000)
+    ds = dryad.Dataset(X, y)
+    b = dryad.train(dict(objective="regression", num_trees=5,
+                         num_leaves=15), ds, backend="cpu")
+    rb = b.refit(X, y, decay_rate=1.0)
+    np.testing.assert_array_equal(rb.value, b.value)
+    np.testing.assert_array_equal(rb.feature, b.feature)
+
+
+def test_refit_same_data_reproduces_l2_values():
+    """decay=0 on the training data re-derives the SAME Newton leaves the
+    trainer computed (histogram sums vs direct sums — allclose)."""
+    X, y = _toy(4000)
+    ds = dryad.Dataset(X, y)
+    b = dryad.train(dict(objective="regression", num_trees=6,
+                         num_leaves=15, min_data_in_leaf=20),
+                    ds, backend="cpu")
+    rb = b.refit(X, y, decay_rate=0.0)
+    np.testing.assert_allclose(rb.value, b.value, rtol=1e-4, atol=1e-5)
+
+
+def test_refit_adapts_to_shifted_data():
+    """Refit on shifted labels must beat the stale model there."""
+    X, y = _toy(8000)
+    ds = dryad.Dataset(X[:4000], y[:4000])
+    b = dryad.train(dict(objective="regression", num_trees=30,
+                         num_leaves=31), ds, backend="cpu")
+    Xs, ys = X[4000:], y[4000:] + 2.5          # shifted domain
+    rb = b.refit(Xs[:3000], ys[:3000], decay_rate=0.1)
+    mse_old = float(np.mean((dryad.predict(b, Xs[3000:]) - ys[3000:]) ** 2))
+    mse_new = float(np.mean((dryad.predict(rb, Xs[3000:]) - ys[3000:]) ** 2))
+    assert mse_new < mse_old, (mse_new, mse_old)
+
+
+def test_refit_l1_uses_renewal_convention():
+    """L1 refit at decay 0 on the training data matches a renewal pass."""
+    X, y = _toy(3000)
+    ds = dryad.Dataset(X, y)
+    b = dryad.train(dict(objective="l1", num_trees=4, num_leaves=15),
+                    ds, backend="cpu")
+    rb = b.refit(X, y, decay_rate=0.0)
+    np.testing.assert_allclose(rb.value, b.value, rtol=1e-4, atol=1e-5)
+
+
+def test_refit_rejects_dart_and_bad_decay():
+    X, y = _toy(2000)
+    ds = dryad.Dataset(X, y)
+    bd = dryad.train(dict(objective="regression", boosting="dart",
+                          num_trees=4, num_leaves=7), ds, backend="cpu")
+    with pytest.raises(ValueError, match="DART"):
+        bd.refit(X, y)
+    b = dryad.train(dict(objective="regression", num_trees=2,
+                         num_leaves=7), ds, backend="cpu")
+    with pytest.raises(ValueError, match="decay_rate"):
+        b.refit(X, y, decay_rate=1.5)
+
+
+def test_refit_rf_keeps_average_semantics():
+    X, y = _toy(4000)
+    ds = dryad.Dataset(X, y)
+    b = dryad.train(dict(objective="regression", boosting="rf",
+                         num_trees=10, num_leaves=15, subsample=0.7),
+                    ds, backend="cpu")
+    rb = b.refit(X, y, decay_rate=0.5)
+    pred = dryad.predict(rb, X)
+    assert np.isfinite(pred).all()
+    # still averaged: magnitudes stay in label range, not 10x
+    assert np.abs(pred - y.mean()).max() < 10 * np.abs(y - y.mean()).max()
+
+
+def test_monotone_constraints_disable_renewal():
+    """Renewal is gated off under monotone constraints: the grower clamps
+    Newton values to the monotone bounds, and an unclamped percentile
+    could re-break the ordering (objectives.renew_alpha)."""
+    assert renew_alpha(make_params(
+        objective="l1", monotone_constraints=(1, 0, 0, 0, 0, 0, 0, 0))) is None
+    X, y = _toy(4000)
+    ds = dryad.Dataset(X, y, max_bins=32)
+    p = dict(objective="l1", num_trees=8, num_leaves=15, max_bins=32,
+             monotone_constraints=[1] + [0] * 7)
+    b = dryad.train(p, ds, backend="cpu")
+    # monotonicity holds: bumping the constrained feature never lowers pred
+    Xa = X[:500].copy()
+    Xb2 = Xa.copy()
+    Xb2[:, 0] += 2.0
+    assert (dryad.predict(b, Xb2) >= dryad.predict(b, Xa) - 1e-6).all()
+
+
+def test_refit_rejects_lambdarank():
+    from dryad_tpu.datasets import mslr_like
+
+    X, y, group = mslr_like(num_queries=30, seed=3)
+    ds = dryad.Dataset(X, y, group=group, max_bins=32)
+    b = dryad.train(dict(objective="lambdarank", num_trees=3,
+                         num_leaves=7, max_bins=32), ds, backend="cpu")
+    with pytest.raises(ValueError, match="lambdarank"):
+        b.refit(X, y)
