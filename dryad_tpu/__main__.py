@@ -125,7 +125,11 @@ def cmd_dump(args) -> int:
     import dryad_tpu as dryad
 
     booster = dryad.Booster.load(args.model)
-    text = json.dumps(booster.dump_model(), indent=2)
+    # --text emits the versioned round-trippable format (Booster.save_text
+    # / load_text — bit-identical predict); the default dump_model() JSON
+    # is a lighter inspection view without the mapper
+    text = (booster.dump_text() if getattr(args, "text", False)
+            else json.dumps(booster.dump_model(), indent=2))
     if args.out:
         with open(args.out, "w") as f:
             f.write(text)
@@ -168,6 +172,9 @@ def main(argv=None) -> int:
     d = sub.add_parser("dump", help="dump model structure as JSON")
     d.add_argument("--model", required=True)
     d.add_argument("--out")
+    d.add_argument("--text", action="store_true",
+                   help="versioned round-trippable text format "
+                        "(Booster.load_text)")
     d.set_defaults(fn=cmd_dump)
 
     args = ap.parse_args(argv)

@@ -229,8 +229,7 @@ class Booster:
         lam = np.float32(p.lambda_l2)
         lr = np.float32(p.effective_learning_rate)
         decay = np.float32(decay_rate)
-        renew_a = (_renew_alpha(p)
-                   if w is None and p.boosting in ("gbdt", "goss") else None)
+        renew_a = _renew_alpha(p, weighted=w is not None)
         score = np.broadcast_to(self.init_score, (N, K)).astype(np.float32).copy()
         score0 = score.copy()           # rf: gradients at the constant init
         g = h = None
@@ -260,6 +259,11 @@ class Booster:
                 else:
                     G = np.float32(g[m, k].sum(dtype=np.float64))
                     H = np.float32(h[m, k].sum(dtype=np.float64))
+                    if H + lam == 0.0:
+                        # zero-hessian leaf (lambda_l2=0 + saturated
+                        # scores): no Newton information — keep the old
+                        # value rather than blending ±inf/NaN in
+                        continue
                     new_v = np.float32(-(G / (H + lam))) * lr
                 value[t, node] = (decay * value[t, node]
                                   + (np.float32(1.0) - decay) * new_v)
@@ -312,6 +316,110 @@ class Booster:
     def load(cls, path: str) -> "Booster":
         with open(path, "rb") as f:
             return cls.from_bytes(f.read())
+
+    # ---- versioned TEXT format (interop + inspection) ----------------------
+    TEXT_FORMAT_VERSION = 1
+
+    def dump_text(self) -> str:
+        """Versioned, human-readable JSON text dump of the FULL model —
+        params, the frozen bin mapper (edges / categorical vocab / bundle
+        plan), and every tree's node arrays incl. categorical bitsets,
+        per-node covers, gains and learned missing directions — such that
+        ``Booster.from_text(dump_text())`` predicts BIT-IDENTICALLY
+        (test_model_text.py).  Floats serialize through Python float (an
+        exact f64 widening of the stored f32), which json round-trips
+        exactly; ±inf appears as JSON ``Infinity`` (Python's json default,
+        documented deviation from strict JSON).  Categorical bitsets are
+        stored sparsely as {node: [8 uint32 words]} for nodes with any
+        set bit."""
+        trees = []
+        for t in range(self.num_total_trees):
+            cat_rows = {}
+            nz = np.flatnonzero(self.cat_bitset[t].any(axis=1))
+            for n in nz:
+                cat_rows[str(int(n))] = [int(w) for w in self.cat_bitset[t, n]]
+            trees.append({
+                "feature": [int(v) for v in self.feature[t]],
+                "threshold": [int(v) for v in self.threshold[t]],
+                "left": [int(v) for v in self.left[t]],
+                "right": [int(v) for v in self.right[t]],
+                "value": [float(v) for v in self.value[t]],
+                "is_cat": [int(v) for v in self.is_cat[t]],
+                "default_left": [int(v) for v in self.default_left[t]],
+                "gain": [float(v) for v in self.gain[t]],
+                "cover": [float(v) for v in self.cover[t]],
+                "cat_bitset": cat_rows,
+            })
+        doc = {
+            "format": "dryad-text",
+            "format_version": self.TEXT_FORMAT_VERSION,
+            "params": self.params.to_dict(),
+            "init_score": [float(v) for v in self.init_score],
+            "max_depth_seen": self.max_depth_seen,
+            "best_iteration": self.best_iteration,
+            "cat_words": int(self.cat_bitset.shape[2]),
+            "max_nodes": int(self.feature.shape[1]),
+            "mapper": self.mapper.to_json_dict(),
+            "trees": trees,
+        }
+        return json.dumps(doc, indent=1)
+
+    def save_text(self, path: str) -> None:
+        with open(path, "w") as f:
+            f.write(self.dump_text())
+
+    @classmethod
+    def from_text(cls, text: str) -> "Booster":
+        doc = json.loads(text)
+        if doc.get("format") != "dryad-text":
+            raise ValueError("not a dryad text model dump")
+        if doc["format_version"] > cls.TEXT_FORMAT_VERSION:
+            raise ValueError(
+                f"text format version {doc['format_version']} is newer than "
+                f"this library supports ({cls.TEXT_FORMAT_VERSION})")
+        params = Params.from_dict(doc["params"])
+        md = doc["mapper"]
+        if md["type"] == "bundled":
+            from dryad_tpu.data.bundling import BundledMapper
+
+            mapper = BundledMapper.from_json_dict(md)
+        else:
+            mapper = BinMapper.from_json_dict(md)
+        T, M = len(doc["trees"]), int(doc["max_nodes"])
+        W = int(doc["cat_words"])
+        feature = np.empty((T, M), np.int32)
+        threshold = np.empty((T, M), np.int32)
+        left = np.empty((T, M), np.int32)
+        right = np.empty((T, M), np.int32)
+        value = np.empty((T, M), np.float32)
+        is_cat = np.empty((T, M), bool)
+        default_left = np.empty((T, M), bool)
+        gain = np.empty((T, M), np.float32)
+        cover = np.empty((T, M), np.float32)
+        cat_bitset = np.zeros((T, M, W), np.uint32)
+        for t, tr in enumerate(doc["trees"]):
+            feature[t] = tr["feature"]
+            threshold[t] = tr["threshold"]
+            left[t] = tr["left"]
+            right[t] = tr["right"]
+            value[t] = np.asarray(tr["value"], np.float32)
+            is_cat[t] = np.asarray(tr["is_cat"], bool)
+            default_left[t] = np.asarray(tr["default_left"], bool)
+            gain[t] = np.asarray(tr["gain"], np.float32)
+            cover[t] = np.asarray(tr["cover"], np.float32)
+            for n_str, words in tr["cat_bitset"].items():
+                cat_bitset[t, int(n_str)] = np.asarray(words, np.uint32)
+        return cls(
+            params, mapper, feature, threshold, left, right, value,
+            is_cat, cat_bitset, np.asarray(doc["init_score"], np.float32),
+            int(doc["max_depth_seen"]), int(doc.get("best_iteration", -1)),
+            gain=gain, cover=cover, default_left=default_left,
+        )
+
+    @classmethod
+    def load_text(cls, path: str) -> "Booster":
+        with open(path) as f:
+            return cls.from_text(f.read())
 
     @classmethod
     def from_bytes(cls, data: bytes) -> "Booster":

@@ -481,12 +481,10 @@ def train_cpu(
     rf_gh = (_grad_hess(np.broadcast_to(init, (N, K)).astype(np.float32))
              if p.boosting == "rf" else None)
 
-    # L1-family leaf renewal — same gates as the device trainer (train.py)
+    # L1-family leaf renewal — the gate lives wholly in renew_alpha
     from dryad_tpu.objectives import renew_alpha as _obj_renew_alpha
 
-    renew_a = (_obj_renew_alpha(p)
-               if data.weight is None and p.boosting in ("gbdt", "goss")
-               else None)
+    renew_a = _obj_renew_alpha(p, weighted=data.weight is not None)
 
     all_rows = np.arange(N, dtype=np.int64)
     for it in range(start_iter, T // K):

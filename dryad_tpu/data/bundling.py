@@ -254,6 +254,19 @@ class BundledMapper:
         return Xb
 
     # ---- serialization -----------------------------------------------------
+    def to_json_dict(self) -> dict:
+        """Text-format dump (Booster.save_text): the base mapper's JSON
+        plus the bundle plan."""
+        return {
+            "type": "bundled",
+            "base": self.base.to_json_dict(),
+            "bundles": [list(map(int, m)) for m in self.bundles],
+        }
+
+    @classmethod
+    def from_json_dict(cls, d: dict) -> "BundledMapper":
+        return cls(BinMapper.from_json_dict(d["base"]), d["bundles"])
+
     def to_bytes(self) -> bytes:
         buf = io.BytesIO()
         arrs = {

@@ -193,6 +193,41 @@ class BinMapper:
         return pad, n_edges
 
     # ---- serialization -----------------------------------------------------
+    def to_json_dict(self) -> dict:
+        """JSON-safe structural dump for the versioned model TEXT format
+        (Booster.save_text).  Floats pass through Python float (exact f64
+        widening of the f32 edges), so json round-trips them bit-exactly;
+        ±inf edges serialize as JSON Infinity (Python json default)."""
+        return {
+            "type": "plain",
+            "max_bins": int(self.max_bins),
+            "features": [
+                {
+                    "is_categorical": bool(f.is_categorical),
+                    "edges": [float(e) for e in np.asarray(f.edges, np.float32)],
+                    "cat_values": [float(v) for v in
+                                   np.asarray(f.cat_values, np.float32)],
+                    "cat_bins": [int(b) for b in f.cat_bins],
+                    "n_bins": int(f.n_bins),
+                }
+                for f in self.features
+            ],
+        }
+
+    @classmethod
+    def from_json_dict(cls, d: dict) -> "BinMapper":
+        feats = [
+            FeatureBins(
+                bool(f["is_categorical"]),
+                np.asarray(f["edges"], np.float32),
+                np.asarray(f["cat_values"], np.float32),
+                np.asarray(f["cat_bins"], np.int32),
+                int(f["n_bins"]),
+            )
+            for f in d["features"]
+        ]
+        return cls(feats, int(d["max_bins"]))
+
     def to_bytes(self) -> bytes:
         buf = io.BytesIO()
         arrs: dict[str, np.ndarray] = {

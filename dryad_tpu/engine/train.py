@@ -41,7 +41,6 @@ from dryad_tpu.dataset import Dataset
 from dryad_tpu.engine.grower import grow_any
 from dryad_tpu.engine.predict import _accumulate, tree_leaves
 from dryad_tpu.objectives import get_objective
-from dryad_tpu.objectives import renew_alpha as obj_renew_alpha
 
 _TREE_KEYS = ("feature", "threshold", "left", "right", "value", "is_cat",
               "cat_bitset", "gain", "default_left", "cover")
@@ -617,9 +616,18 @@ def train_device(
     # de-correlate only through the per-iteration bag (config.py rf note);
     # `score` itself still accumulates tree sums (predict-time averaging)
     rf_gh = grads(score) if p.boosting == "rf" else None
-    # loop-invariant device init for the rf eval transform (uploading it
-    # per eval costs a tunnel round-trip each)
-    init_dev = jnp.asarray(init) if p.boosting == "rf" else None
+    # loop-invariant device-resident init, shared by the rf eval transform
+    # and every chunk dispatch (re-wrapping the host array per call costs
+    # a tunnel upload each); replicated explicitly on a mesh so the chunk
+    # jit never sees mixed placements
+    if mesh is not None:
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as _PS
+
+        init_dev = jax.device_put(np.asarray(init),
+                                  NamedSharding(mesh, _PS()))
+    else:
+        init_dev = jnp.asarray(init)
 
     learn_missing = data.has_missing
     if jax.process_count() > 1:
@@ -645,13 +653,12 @@ def train_device(
              if learn_missing and bundled_np is not None and bundled_np.any()
              else None)
 
-    # L1-family leaf renewal (objectives.renew_alpha): gated OFF for
-    # weighted data (unweighted percentile only — documented divergence)
-    # and for dart/rf, whose residual bookkeeping diverges from the
-    # carried score (drop-pruned / constant-init ensembles)
-    renew_a = (obj_renew_alpha(p)
-               if data.weight is None and p.boosting in ("gbdt", "goss")
-               else None)
+    # L1-family leaf renewal — the gate (weighted / boosting / monotone)
+    # lives wholly in renew_alpha; imported LATE so test monkeypatching of
+    # dryad_tpu.objectives.renew_alpha reaches this trainer too
+    from dryad_tpu.objectives import renew_alpha as _obj_renew_alpha
+
+    renew_a = _obj_renew_alpha(p, weighted=data.weight is not None)
 
     def step(out, score, g_all, h_all, bag, fmask, t, k, root_hist=None,
              value_scale=None):
@@ -998,7 +1005,7 @@ def train_device(
                 jnp.int32(it), jnp.int32(n), bmask, bag_bits, fmask_chunk,
                 metric_names, p.ndcg_at, p.eval_period, total_iters,
                 vXbs_t, vys_t, vqids_t, vscores_t, eval_buf, eval_its,
-                eval_cnt, init_arr=jnp.asarray(init), renew_alpha=renew_a)
+                eval_cnt, init_arr=init_dev, renew_alpha=renew_a)
 
             if not calibrated:
                 # drain the pipeline: chunk 0 absorbs compile, chunk 1 is

@@ -441,7 +441,7 @@ class LambdaRank:
         return score
 
 
-def renew_alpha(params) -> float | None:
+def renew_alpha(params, weighted: bool = False) -> float | None:
     """Percentile level for post-growth leaf renewal, or None.
 
     LightGBM refits L1-family leaf outputs to residual percentiles after
@@ -451,12 +451,18 @@ def renew_alpha(params) -> float | None:
     value the alpha-quantile).  Applied for l1 (median), quantile
     (params.alpha), and huber (median — the L1-family treatment; huber's
     minimizer lies between mean and median and the median is the robust
-    choice).  The trainers additionally gate renewal OFF for weighted
-    datasets (our percentile is unweighted — documented divergence), for
-    boosting dart/rf (dart redefines the ensemble mid-iteration; rf
-    gradients live at the constant init score), and for monotone
-    constraints (the grower clamps Newton values to the monotone bounds;
-    an unclamped percentile could re-break the ordering) — see train.py."""
+    choice).
+
+    The ENTIRE gate lives here (not at the call sites, so a new caller
+    can't forget part of it — same rule as update_best's DART gate):
+    renewal is OFF for weighted datasets (our percentile is unweighted —
+    documented divergence), for boosting dart/rf (dart redefines the
+    ensemble mid-iteration; rf gradients live at the constant init
+    score), and for monotone constraints (the grower clamps Newton values
+    to the monotone bounds; an unclamped percentile could re-break the
+    ordering)."""
+    if weighted or params.boosting not in ("gbdt", "goss"):
+        return None
     if params.monotone_constraints and any(params.monotone_constraints):
         return None
     if params.objective in ("l1", "huber"):

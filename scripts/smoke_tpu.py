@@ -145,9 +145,74 @@ def smoke_pallas_natural_order():
     print("pallas natural-order multi-slot: lowers and agrees on device")
 
 
+def smoke_train_parity():
+    """Tiny end-to-end train on the ATTACHED device vs the CPU reference:
+    identical tree structures and bitwise same-booster predict (the
+    CLAUDE.md parity invariant).  Covers the chunked device program (no
+    callback), bagging, and the leaf-renewal sort in one pass — a TPU-only
+    lowering regression in any of them lands here instead of surfacing as
+    a silently wrong bench number (VERDICT r4 weak #4)."""
+    import dryad_tpu as dryad
+    from dryad_tpu.datasets import higgs_like
+
+    X, y = higgs_like(20_000, seed=31)
+    ds = dryad.Dataset(X, y, max_bins=64)
+    configs = [
+        ("gbdt", dict(objective="binary", num_trees=8, num_leaves=31,
+                      max_bins=64)),
+        ("bagged", dict(objective="binary", num_trees=6, num_leaves=15,
+                        max_bins=64, subsample=0.7, colsample=0.8)),
+        ("l1-renewal", dict(objective="l1", num_trees=6, num_leaves=15,
+                            max_bins=64)),
+    ]
+    for name, p in configs:
+        bc = dryad.train(p, ds, backend="cpu")
+        bt = dryad.train(p, ds, backend="tpu")
+        np.testing.assert_array_equal(bc.feature, bt.feature,
+                                      err_msg=f"{name}: tree structures")
+        np.testing.assert_array_equal(bc.threshold, bt.threshold,
+                                      err_msg=f"{name}: thresholds")
+        pc = bc.predict_binned(ds.X_binned, raw_score=True, backend="cpu")
+        pt = bc.predict_binned(ds.X_binned, raw_score=True, backend="tpu")
+        np.testing.assert_array_equal(pc, np.asarray(pt),
+                                      err_msg=f"{name}: predict bit-identity")
+    print(f"train parity on device: {len(configs)} configs — structures "
+          "identical, predict bitwise")
+
+
+_ALL_SMOKES = [
+    smoke_shared_vs_per_class,
+    smoke_pallas_vs_xla,
+    smoke_pallas_u16_and_records,
+    smoke_pallas_wide_segment_count,
+    smoke_pallas_natural_order,
+]
+
+
+def main(argv=None) -> int:
+    """``--gate``: the driver-runnable on-device check (CLAUDE.md) — all
+    kernel smokes + the train-parity pass; every failure is reported and
+    the exit code is non-zero on ANY drift."""
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--gate", action="store_true",
+                    help="run all smokes + train parity; exit 1 on drift")
+    args = ap.parse_args(argv)
+    smokes = list(_ALL_SMOKES) + ([smoke_train_parity] if args.gate else [])
+    failed = []
+    for fn in smokes:
+        try:
+            fn()
+        except Exception as e:  # noqa: BLE001 — aggregate, report, exit 1
+            failed.append((fn.__name__, e))
+            print(f"FAIL {fn.__name__}: {e}")
+    if failed:
+        print(f"GATE FAILED: {len(failed)}/{len(smokes)} smokes drifted")
+        return 1
+    print(f"GATE OK: {len(smokes)} smokes clean")
+    return 0
+
+
 if __name__ == "__main__":
-    smoke_shared_vs_per_class()
-    smoke_pallas_vs_xla()
-    smoke_pallas_u16_and_records()
-    smoke_pallas_wide_segment_count()
-    smoke_pallas_natural_order()
+    raise SystemExit(main())

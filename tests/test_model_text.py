@@ -1,0 +1,106 @@
+"""Versioned model TEXT dump/load (VERDICT r4 missing #4): a stable,
+inspectable JSON format whose round-trip predicts bit-identically —
+covering categorical bitsets, per-node covers, gains and learned missing
+directions."""
+
+import json
+
+import numpy as np
+import pytest
+
+import dryad_tpu as dryad
+from dryad_tpu.booster import Booster
+
+
+def _cat_nan_model():
+    """Categoricals + NaNs + learned missing directions in one model."""
+    rng = np.random.default_rng(7)
+    N = 6000
+    X = rng.normal(size=(N, 6)).astype(np.float32)
+    X[:, 0] = rng.integers(0, 12, N)               # categorical
+    X[rng.random((N, 6)) < 0.1] = np.nan           # missing everywhere
+    y = ((X[:, 0] % 3 == 0) ^ (np.nan_to_num(X[:, 1]) > 0)).astype(np.float32)
+    ds = dryad.Dataset(X, y, categorical_features=[0], max_bins=64)
+    b = dryad.train(dict(objective="binary", num_trees=12, num_leaves=15),
+                    ds, backend="cpu")
+    return X, ds, b
+
+
+def test_text_round_trip_bit_identical(tmp_path):
+    X, ds, b = _cat_nan_model()
+    path = str(tmp_path / "model.txt")
+    b.save_text(path)
+    rb = Booster.load_text(path)
+    # every array round-trips exactly
+    for key in ("feature", "threshold", "left", "right", "value", "is_cat",
+                "cat_bitset", "gain", "cover", "default_left"):
+        np.testing.assert_array_equal(getattr(b, key), getattr(rb, key),
+                                      err_msg=key)
+    np.testing.assert_array_equal(b.init_score, rb.init_score)
+    # raw predict on RAW features (exercises the mapper round-trip too)
+    np.testing.assert_array_equal(
+        dryad.predict(b, X, raw_score=True),
+        dryad.predict(rb, X, raw_score=True))
+    # and on both backends
+    np.testing.assert_array_equal(
+        rb.predict_binned(ds.X_binned, raw_score=True, backend="cpu"),
+        np.asarray(rb.predict_binned(ds.X_binned, raw_score=True,
+                                     backend="tpu")))
+
+
+def test_text_dump_is_inspectable_json():
+    _, _, b = _cat_nan_model()
+    doc = json.loads(b.dump_text())
+    assert doc["format"] == "dryad-text"
+    assert doc["format_version"] == 1
+    assert doc["params"]["objective"] == "binary"
+    t0 = doc["trees"][0]
+    for key in ("feature", "threshold", "left", "right", "value", "is_cat",
+                "default_left", "gain", "cover", "cat_bitset"):
+        assert key in t0, key
+    # the categorical split's bitset really appears
+    assert any(tr["cat_bitset"] for tr in doc["trees"])
+
+
+def test_text_version_guard():
+    _, _, b = _cat_nan_model()
+    doc = json.loads(b.dump_text())
+    doc["format_version"] = 99
+    with pytest.raises(ValueError, match="newer"):
+        Booster.from_text(json.dumps(doc))
+    with pytest.raises(ValueError, match="not a dryad"):
+        Booster.from_text(json.dumps({"format": "something-else"}))
+
+
+def test_text_round_trip_bundled_efb(tmp_path):
+    """EFB-bundled (sparse) models carry the bundle plan through text."""
+    rng = np.random.default_rng(3)
+    N, F = 4000, 30
+    X = np.zeros((N, F), np.float32)
+    for f in range(F):            # mutually exclusive-ish sparse columns
+        rows = rng.choice(N, N // F, replace=False)
+        X[rows, f] = rng.normal(size=rows.size)
+    y = (X.sum(axis=1) > 0).astype(np.float32)
+    ds = dryad.Dataset(X, y, max_bins=32)
+    b = dryad.train(dict(objective="binary", num_trees=6, num_leaves=15),
+                    ds, backend="cpu")
+    path = str(tmp_path / "m.txt")
+    b.save_text(path)
+    rb = Booster.load_text(path)
+    np.testing.assert_array_equal(
+        dryad.predict(b, X, raw_score=True),
+        dryad.predict(rb, X, raw_score=True))
+
+
+def test_text_round_trip_multiclass_shap(tmp_path):
+    """Covers survive: SHAP on the reloaded model equals the original."""
+    from dryad_tpu.datasets import covertype_like
+
+    X, y = covertype_like(3000, seed=5)
+    ds = dryad.Dataset(X, y, max_bins=32)
+    b = dryad.train(dict(objective="multiclass", num_class=7, num_trees=4,
+                         num_leaves=15, max_bins=32), ds, backend="cpu")
+    rb = Booster.from_text(b.dump_text())
+    np.testing.assert_array_equal(
+        b.predict_binned(ds.X_binned[:100], pred_contrib=True),
+        rb.predict_binned(ds.X_binned[:100], pred_contrib=True))

@@ -77,7 +77,7 @@ def test_renewal_improves_quantile_loss():
     b_on = dryad.train(p, ds, backend="cpu")
     real = O.renew_alpha
     try:
-        O.renew_alpha = lambda _: None
+        O.renew_alpha = lambda *a, **k: None
         b_off = dryad.train(p, ds, backend="cpu")
     finally:
         O.renew_alpha = real
@@ -103,7 +103,7 @@ def test_weighted_data_skips_renewal():
     b_w = dryad.train(p, dryad.Dataset(X, y, weight=w), backend="cpu")
     real = O.renew_alpha
     try:
-        O.renew_alpha = lambda _: None
+        O.renew_alpha = lambda *a, **k: None
         b_off = dryad.train(p, dryad.Dataset(X, y), backend="cpu")
     finally:
         O.renew_alpha = real
