@@ -331,7 +331,14 @@ class Booster:
         exactly; ±inf appears as JSON ``Infinity`` (Python's json default,
         documented deviation from strict JSON).  Categorical bitsets are
         stored sparsely as {node: [8 uint32 words]} for nodes with any
-        set bit."""
+        set bit.
+
+        train_state (eval history, early-stop staleness) is deliberately
+        NOT serialized: the text format is the interop/inspection
+        surface; resuming training mid-stream is the binary
+        ``save``/``load`` (and checkpoint.py) contract.  A text-reloaded
+        booster predicts identically but, used as ``init_booster``,
+        continues with fresh early-stop state."""
         trees = []
         for t in range(self.num_total_trees):
             cat_rows = {}

@@ -1,0 +1,245 @@
+"""Leaf-ordered permutation kernel (VERDICT r4 #2 / CLAUDE.md open item
+#1): maintain the per-tree record table GROUPED BY LEAF incrementally,
+deleting the two dominant deep-level data-movement costs at 10M rows —
+the full-N packed sort (~75 ms/level) and the half-N per-access record
+gather (~110 ms/level).
+
+Layout invariant.  Records live in a TILE-ALIGNED leaf-ordered buffer:
+segment k (one leaf slot) owns ``lt[k] = max(ceil(cnt[k]/T), 1)``
+consecutive row tiles; rows past cnt[k] in its range are ZERO (sentinel)
+rows — zero weight, bin 0, contributing nothing to any histogram (the
+same sentinel algebra pallas_hist's plans use).
+
+Per level, every segment splits into (left, right) children (pass-through
+segments keep all rows "left").  A row's destination is a pure function
+of (source tile, side, stable rank within that (tile, side)), so the
+movement decomposes into per-tile work with NO sort and NO row scatter:
+
+* **stable two-way compaction on the MXU**: records are uint8 lanes
+  (bytes are exact in bf16; the 0/1 one-hot times byte products
+  accumulate exactly in f32), ``P_side (T, T) @ rec (T, WB)`` compacts
+  one side's rows to the front in stable order and zero-fills the tail —
+  and zeros ARE the sentinel encoding;
+* **two fixed-size windowed writes per tile** at row offsets
+  ``dst_side[i] = T·new_base(child) + (side-rows of earlier tiles of the
+  same segment)``.
+
+Write-ordering safety.  The new layout places ALL left children (in
+source-segment order), one slack tile, ALL right children (same order),
+one slack tile.  Pallas grid steps execute sequentially, and within a
+region each write begins exactly where the previous real rows ended, so
+a write's zero tail is either overwritten by a LATER step of the same
+region, lands in the segment's own pad slots, or falls into slack —
+never on rows written earlier.  (An interleaved [L_k][R_k] layout breaks
+this: an L tail can cross into R territory that earlier steps already
+wrote — found in design review, hence the region split.)
+
+The histogram pass then reads the selected children's segments as
+CONTIGUOUS tile runs (tile-granular gathers move ~20 KB per access —
+bandwidth-bound, not access-bound), and no per-level sort exists at all.
+
+Self-contained and bitwise-tested in interpret mode
+(tests/test_leafperm.py); ``scripts/exp_r5_perm.py`` measures it
+on-device against the sort+gather pair it replaces.  Wiring into
+``levelwise.py``'s deep phase is gated on that measurement (STATUS.md).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_TILE_ROWS = 512     # must match pallas_hist._TILE_ROWS (shared layouts)
+
+
+def _interpret(platform: str | None = None) -> bool:
+    return (platform or jax.default_backend()) == "cpu"
+
+
+def aligned_layout(counts: jnp.ndarray, T: int = _TILE_ROWS):
+    """(lt, base): per-segment tile counts (>= 1 each) and first-tile
+    indices for exact row ``counts``; ``base[-1]`` = total tiles."""
+    cnt = counts.astype(jnp.int32)
+    lt = jnp.maximum((cnt + (T - 1)) // T, 1)
+    base = jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                            jnp.cumsum(lt).astype(jnp.int32)])
+    return lt, base
+
+
+def _perm_kernel(dstl_ref, dstr_ref, pos_ref, rec_ref, init_ref, out_ref,
+                 outl_vmem, outr_vmem, seml, semr, *, T: int, WB: int):
+    """One source tile: two stable compactions + two windowed writes.
+
+    ``pos`` (1, 2, T) int32: row j's in-tile output rank on its side
+    (the other side's plane holds T = "no row"), so each one-hot
+    ``iota_o == pos[side]`` compacts one side to the front and zero-fills
+    the rest."""
+    i = pl.program_id(0)
+    rec = rec_ref[0].astype(jnp.bfloat16)              # (T, WB)
+    iota_o = jax.lax.broadcasted_iota(jnp.int32, (T, T), 0)
+    PL = (iota_o == pos_ref[0, 0][None, :]).astype(jnp.bfloat16)
+    PR = (iota_o == pos_ref[0, 1][None, :]).astype(jnp.bfloat16)
+    outl_vmem[...] = jax.lax.dot_general(
+        PL, rec, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32).astype(jnp.uint8)
+    outr_vmem[...] = jax.lax.dot_general(
+        PR, rec, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32).astype(jnp.uint8)
+    cl = pltpu.make_async_copy(
+        outl_vmem, out_ref.at[pl.ds(dstl_ref[i], T), :], seml)
+    cr = pltpu.make_async_copy(
+        outr_vmem, out_ref.at[pl.ds(dstr_ref[i], T), :], semr)
+    cl.start()
+    cr.start()
+    # waits keep the writes ordered with the NEXT step's (they overlap a
+    # predecessor's zero tail by design) and the scratch reusable
+    cl.wait()
+    cr.wait()
+
+
+@functools.partial(jax.jit, static_argnames=("n_out_tiles", "platform"))
+def permute_records(rec: jnp.ndarray, pos: jnp.ndarray, dstl: jnp.ndarray,
+                    dstr: jnp.ndarray, n_out_tiles: int,
+                    platform: str | None = None) -> jnp.ndarray:
+    """Apply one level's movement.
+
+    rec (n_tiles*T, WB) uint8; pos (n_tiles, 2, T) int32 in-tile ranks
+    (T = no row, incl. every sentinel row); dstl/dstr (n_tiles,) int32
+    destination ROW offsets.  ``n_out_tiles`` MUST include the two slack
+    tiles ``level_moves`` accounts for.  Returns the new (n_out_tiles*T,
+    WB) uint8 leaf-ordered buffer.
+
+    The output is ALIASED to a zero buffer: rows no DMA write covers
+    (inner pad rows of multi-tile segments with uneven source fill,
+    untouched slack) must be zero sentinels — an uninitialized ANY-space
+    buffer holds stale HBM bytes on real hardware (interpret mode
+    zero-fills and masks this; caught in review)."""
+    n_rows, WB = rec.shape
+    T = _TILE_ROWS
+    n_tiles = n_rows // T
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(n_tiles,),
+        in_specs=[
+            pl.BlockSpec((1, 2, T), lambda i, dl, dr: (i, 0, 0)),
+            pl.BlockSpec((1, T, WB), lambda i, dl, dr: (i, 0, 0)),
+            pl.BlockSpec(memory_space=pltpu.MemorySpace.ANY),
+        ],
+        out_specs=pl.BlockSpec(memory_space=pltpu.MemorySpace.ANY),
+        scratch_shapes=[
+            pltpu.VMEM((T, WB), jnp.uint8),
+            pltpu.VMEM((T, WB), jnp.uint8),
+            pltpu.SemaphoreType.DMA,
+            pltpu.SemaphoreType.DMA,
+        ],
+    )
+    zeros = jnp.zeros((n_out_tiles * T, WB), jnp.uint8)
+    out = pl.pallas_call(
+        functools.partial(_perm_kernel, T=T, WB=WB),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((n_out_tiles * T, WB), jnp.uint8),
+        # operand index counts the 2 prefetched scalars first: 2=pos,
+        # 3=rec, 4=zeros -> alias the zero buffer to the output
+        input_output_aliases={4: 0},
+        interpret=_interpret(platform),
+    )(dstl, dstr, pos.astype(jnp.int32), rec.reshape(n_tiles, T, WB), zeros)
+    return out
+
+
+def level_moves(tile_slot: jnp.ndarray, side: jnp.ndarray,
+                cnt_l: jnp.ndarray, cnt_r: jnp.ndarray,
+                T: int = _TILE_ROWS):
+    """XLA bookkeeping for one level — O(N) elementwise + O(n_tiles)
+    prefix work, no sort.
+
+    tile_slot (n_tiles,) int32: source segment per tile (layout
+    invariant).  side (n_tiles*T,) int32: 0 = left child, 1 = right
+    child, anything else = sentinel (vanishes).  cnt_l/cnt_r (P,) int32
+    EXACT child row counts per parent segment (pass-through parents put
+    everything in cnt_l with cnt_r = 0; their right segment still gets
+    the mandatory 1-tile allocation but receives only zeros).
+
+    Returns (pos, dstl, dstr, base_l, base_r, n_out_tiles): the new
+    layout is [left children in parent order | slack | right children |
+    slack]; ``base_l``/``base_r`` are (P+1,) FIRST-TILE indices of each
+    parent's left/right child segment (right already offset past the
+    left region), from which callers derive the next level's tile→segment
+    map.  ``n_out_tiles`` is a traced scalar — callers pick the static
+    bound (see tiles_bound)."""
+    n_tiles = tile_slot.shape[0]
+    s2 = side.reshape(n_tiles, T)
+    isl = (s2 == 0).astype(jnp.int32)
+    isr = (s2 == 1).astype(jnp.int32)
+    rkl = jnp.cumsum(isl, axis=1) - isl                # stable in-tile ranks
+    rkr = jnp.cumsum(isr, axis=1) - isr
+    nl_t = isl.sum(axis=1)
+    nr_t = isr.sum(axis=1)
+    cl = jnp.cumsum(nl_t) - nl_t                       # global tile prefixes
+    cr = jnp.cumsum(nr_t) - nr_t
+    first = jnp.concatenate([jnp.ones((1,), bool),
+                             tile_slot[1:] != tile_slot[:-1]])
+    # per-tile prefix WITHIN its segment = global prefix minus the
+    # segment's first tile's global prefix (max-scan trick: cl is
+    # non-decreasing, so carrying the last first-tile value is a max scan)
+    segl = jax.lax.associative_scan(jnp.maximum, jnp.where(first, cl, -1))
+    segr = jax.lax.associative_scan(jnp.maximum, jnp.where(first, cr, -1))
+    prefl = cl - segl
+    prefr = cr - segr
+
+    lt_l, base_l = aligned_layout(cnt_l, T)            # left region
+    lt_r, base_r = aligned_layout(cnt_r, T)            # right region
+    left_tiles = base_l[-1]
+    # region layout: [left | 1 slack | right | 1 slack]
+    off_r = left_tiles + 1
+    dstl = (base_l[tile_slot] * T + prefl).astype(jnp.int32)
+    dstr = ((off_r + base_r[tile_slot]) * T + prefr).astype(jnp.int32)
+    n_out_tiles = off_r + base_r[-1] + 1
+
+    pos = jnp.stack([jnp.where(s2 == 0, rkl, T),
+                     jnp.where(s2 == 1, rkr, T)], axis=1).astype(jnp.int32)
+    return pos, dstl, dstr, base_l, base_r + off_r, n_out_tiles
+
+
+def tiles_bound(n_rows: int, n_parents: int, T: int = _TILE_ROWS) -> int:
+    """Static bound for ``n_out_tiles``: every row lands somewhere
+    (ceil(n/T) + per-segment alignment waste) + mandatory empty-segment
+    tiles + the two slack tiles."""
+    return n_rows // T + 2 * n_parents + 3
+
+
+# ---------------------------------------------------------------------------
+# numpy reference (the bitwise oracle for tests)
+# ---------------------------------------------------------------------------
+
+def permute_records_np(rec: np.ndarray, tile_slot: np.ndarray,
+                       side: np.ndarray, cnt_l: np.ndarray,
+                       cnt_r: np.ndarray, n_out_tiles: int,
+                       T: int = _TILE_ROWS) -> np.ndarray:
+    """Reference: stable per-(segment, side) order into the
+    [left | slack | right | slack] aligned layout."""
+    n_tiles = tile_slot.shape[0]
+    WB = rec.shape[1]
+    lt_l = np.maximum(-(-np.asarray(cnt_l) // T), 1)
+    lt_r = np.maximum(-(-np.asarray(cnt_r) // T), 1)
+    base_l = np.concatenate([[0], np.cumsum(lt_l)]).astype(np.int64)
+    off_r = base_l[-1] + 1
+    base_r = off_r + np.concatenate([[0], np.cumsum(lt_r)]).astype(np.int64)
+    out = np.zeros((n_out_tiles * T, WB), np.uint8)
+    fill_l = np.zeros(len(cnt_l), np.int64)
+    fill_r = np.zeros(len(cnt_r), np.int64)
+    for i in range(n_tiles):
+        s = tile_slot[i]
+        for j in range(T):
+            sd = side[i * T + j]
+            if sd == 0:
+                out[base_l[s] * T + fill_l[s]] = rec[i * T + j]
+                fill_l[s] += 1
+            elif sd == 1:
+                out[base_r[s] * T + fill_r[s]] = rec[i * T + j]
+                fill_r[s] += 1
+    return out

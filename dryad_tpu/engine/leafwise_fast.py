@@ -54,6 +54,7 @@ from dryad_tpu.engine.grower import (
     pack_cat_bitset,
     root_stats,
 )
+from dryad_tpu.engine import levelwise
 from dryad_tpu.engine.histogram import build_hist, build_hist_segmented
 from dryad_tpu.engine.split import NEG_INF, find_best_split
 
@@ -240,15 +241,7 @@ def grow_tree_leafwise_batched(
                 w0r = rec_r[:, 0]
                 rf = rec_r[:, 1].astype(jnp.int32)
                 row_do = (w0r >> 31) != 0
-                if F <= 256:
-                    iota_f = jnp.arange(F, dtype=jnp.int32)
-                    bins_rf = jnp.max(
-                        jnp.where(rf[:, None] == iota_f[None, :], Xb,
-                                  jnp.zeros((), Xb.dtype)),
-                        axis=1).astype(jnp.int32)
-                else:
-                    bins_rf = jnp.take_along_axis(
-                        Xb, rf[:, None], axis=1)[:, 0].astype(jnp.int32)
+                bins_rf = levelwise.select_bins(Xb, rf)
                 go_left = bins_rf <= ((w0r >> 16)
                                       & jnp.uint32(0x1FFF)).astype(jnp.int32)
                 if learn_missing:

@@ -43,6 +43,42 @@ from dryad_tpu.engine.histogram import (
 from dryad_tpu.engine.split import NEG_INF, find_best_split
 
 
+def partition_prefers_reduce(num_features: int, itemsize: int) -> bool:
+    """Partition formulation choice, shared by both level-synchronous
+    growers: the masked reduce over the CONTIGUOUS (N, F) matrix vs the
+    per-row column gather.
+
+    The reduce's traffic is N·F·itemsize sequential bytes; the gather
+    costs ~per-ACCESS (CLAUDE.md: ~30 ms per 10M accesses, bytes nearly
+    free).  Crossover: reading F·itemsize bytes/row beats one random
+    access while F·itemsize ≲ 20 KB of sequential traffic per row-access
+    saved — far above any supported width.  r4 gated the reduce at
+    F <= 256 on the 10M=28-feature measurement alone, sending
+    Epsilon-shaped (400k × 2000) configs to the ~320 ms-class gather; r5
+    widens the gate to 4 KB/row (u8: F <= 4096, u16: F <= 2048), measured
+    on the Epsilon shape (exp_r5_eps.py: reduce 19 ms vs gather 63 ms/
+    level at 400k x 2000)."""
+    return num_features * itemsize <= 4096
+
+
+def select_bins(Xb: jnp.ndarray, rf: jnp.ndarray) -> jnp.ndarray:
+    """Each row's bin id on its per-row feature ``rf`` — THE partition
+    column-select, shared by both level-synchronous growers so the
+    formulation (and the gate above) can never diverge between them (the
+    r4 F<=256 gate had to be widened in two copies; review r5).  Masked
+    reduce over the contiguous (N, F) matrix when the gate admits (at
+    most one column matches per row), per-row gather otherwise."""
+    F = Xb.shape[1]
+    if partition_prefers_reduce(F, Xb.dtype.itemsize):
+        iota_f = jnp.arange(F, dtype=jnp.int32)
+        return jnp.max(
+            jnp.where(rf[:, None] == iota_f[None, :], Xb,
+                      jnp.zeros((), Xb.dtype)),
+            axis=1).astype(jnp.int32)
+    return jnp.take_along_axis(Xb, rf[:, None], axis=1)[:, 0].astype(
+        jnp.int32)
+
+
 def phase_plan(depth_cap: int, num_leaves: int, nat_live: bool):
     """(d_switch, P_narrow, P_full) for the two-phase level loop — the ONE
     definition of the phase boundary, shared with train._comm_stats so the
@@ -292,20 +328,7 @@ def grow_tree_levelwise(
                 w0r = rec_r[:, 0]
                 rf = rec_r[:, 1].astype(jnp.int32)
                 row_do = ((w0r >> 31) != 0) & (row_slot < L)
-                if F <= 256:
-                    # masked reduce over F (at most one column matches per
-                    # row): reads (N, F) CONTIGUOUSLY — ~10x faster than the
-                    # per-row random gather at F=28, but its traffic scales
-                    # with F while the gather's is ~per-access, so wide
-                    # matrices keep the gather (static per-config choice)
-                    iota_f = jnp.arange(F, dtype=jnp.int32)
-                    bins_rf = jnp.max(
-                        jnp.where(rf[:, None] == iota_f[None, :], Xb,
-                                  jnp.zeros((), Xb.dtype)),
-                        axis=1).astype(jnp.int32)
-                else:
-                    bins_rf = jnp.take_along_axis(
-                        Xb, rf[:, None], axis=1)[:, 0].astype(jnp.int32)
+                bins_rf = select_bins(Xb, rf)
                 thr_r = ((w0r >> 16) & jnp.uint32(0x1FFF)).astype(jnp.int32)
                 go_left = bins_rf <= thr_r
                 if learn_missing:

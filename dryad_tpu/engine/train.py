@@ -954,6 +954,7 @@ def train_device(
         chunk_idx = 0
         t_mark = None
         calibrated = False
+        inflight: list = []
 
         it = start_iter
         while it < total_iters:
@@ -1018,6 +1019,22 @@ def train_device(
                     CH = max(1, min(cap, int(20.0 / per_iter)))
                     calibrated = True
                 t_mark = now
+            else:
+                # Cap the async run-ahead to ~2 chunks.  Without this, a
+                # deferred-eval 500-tree run enqueues its entire chunk
+                # stream in seconds and the FIRST fetch (a checkpoint
+                # flush or the end-of-run flush) then waits minutes behind
+                # the queue — through the remote tunnel any request
+                # pending much past ~60 s is killed and surfaces as a
+                # device error (two headline runs died exactly this way,
+                # 2026-07-31; sync-eval runs were immune because their
+                # per-chunk fetch keeps the host in lockstep).  Blocking
+                # on the chunk TWO dispatches back keeps one chunk of
+                # pipeline overlap (chunks are calibrated to ~20 s, so any
+                # later fetch waits <= ~2 chunks ~= 40 s).
+                inflight.append(out["max_depth"])
+                if len(inflight) > 2:
+                    jax.block_until_ready(inflight.pop(0))
             chunk_idx += 1
 
             evs = eval_iters_in(it, it + n)

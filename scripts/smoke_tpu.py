@@ -3,8 +3,10 @@
 lowerings are fusion-sensitive, so program-level contracts need a check
 on real hardware).
 
-Run after touching histogram builders or the Pallas kernel:
-    PYTHONPATH=/root/.axon_site:/root/repo python scripts/smoke_tpu.py
+Run after touching histogram builders, growers, or predict (CLAUDE.md —
+``--gate`` adds the on-device train-parity pass and exits non-zero on
+any drift):
+    PYTHONPATH=/root/.axon_site:/root/repo python scripts/smoke_tpu.py --gate
 """
 
 import numpy as np

@@ -1,0 +1,145 @@
+"""Leaf-ordered permutation kernel (engine/leafperm.py): bitwise equality
+with the numpy oracle in interpret mode, layout invariants, and the
+multi-level refinement chain."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from dryad_tpu.engine import leafperm
+
+T = leafperm._TILE_ROWS
+
+
+def _mk_layout(rng, seg_counts, WB=64):
+    """Build a tile-aligned layout: records with distinctive bytes,
+    sentinel rows zero.  Returns (rec, tile_slot, row_seg)."""
+    lt = np.maximum(-(-np.asarray(seg_counts) // T), 1)
+    n_tiles = int(lt.sum())
+    rec = np.zeros((n_tiles * T, WB), np.uint8)
+    tile_slot = np.repeat(np.arange(len(seg_counts)), lt).astype(np.int32)
+    row_seg = np.full(n_tiles * T, -1, np.int32)
+    base = np.concatenate([[0], np.cumsum(lt)])
+    rid = 0
+    for s, cnt in enumerate(seg_counts):
+        r0 = base[s] * T
+        for j in range(cnt):
+            rec[r0 + j] = rng.integers(1, 255, WB, dtype=np.uint8)
+            row_seg[r0 + j] = s
+            rid += 1
+    return rec, tile_slot, row_seg
+
+
+def _sides(rng, row_seg, p_right=0.5):
+    """Random left/right per real row; sentinel rows get 2."""
+    side = np.where(row_seg >= 0,
+                    (rng.random(row_seg.size) < p_right).astype(np.int32),
+                    2).astype(np.int32)
+    return side
+
+
+def _counts(row_seg, side, n_seg):
+    cl = np.zeros(n_seg, np.int32)
+    cr = np.zeros(n_seg, np.int32)
+    for s, sd in zip(row_seg, side):
+        if s >= 0:
+            if sd == 0:
+                cl[s] += 1
+            elif sd == 1:
+                cr[s] += 1
+    return cl, cr
+
+
+@pytest.mark.parametrize("seg_counts,p_right", [
+    ([700, 3, 1200, 0, 513], 0.5),      # ragged, incl. empty segment
+    ([2048], 0.0),                      # pass-through (all left)
+    ([100, 100, 100], 1.0),             # all right
+    ([1, 1, 1, 1], 0.5),                # tiny segments, all mandatory pads
+])
+def test_permute_matches_oracle(seg_counts, p_right):
+    rng = np.random.default_rng(hash((tuple(seg_counts), p_right)) % 2**31)
+    rec, tile_slot, row_seg = _mk_layout(rng, seg_counts)
+    side = _sides(rng, row_seg, p_right)
+    cl, cr = _counts(row_seg, side, len(seg_counts))
+
+    pos, dstl, dstr, base_l, base_r, n_out = leafperm.level_moves(
+        jnp.asarray(tile_slot), jnp.asarray(side),
+        jnp.asarray(cl), jnp.asarray(cr))
+    bound = leafperm.tiles_bound(rec.shape[0], len(seg_counts))
+    assert int(n_out) <= bound
+    got = np.asarray(leafperm.permute_records(
+        jnp.asarray(rec), pos, dstl, dstr, bound))
+    want = leafperm.permute_records_np(rec, tile_slot, side, cl, cr, bound)
+    np.testing.assert_array_equal(got[: int(n_out) * T],
+                                  want[: int(n_out) * T])
+
+
+def test_multi_level_chain():
+    """Three refinement levels keep every real record exactly once and
+    all pads zero — the invariant the grower integration relies on."""
+    rng = np.random.default_rng(7)
+    seg_counts = [5000, 2000]
+    rec, tile_slot, row_seg = _mk_layout(rng, seg_counts)
+    orig = {bytes(r) for r in rec if r.any()}
+    for level in range(3):
+        n_seg = int(tile_slot.max()) + 1
+        side = _sides(rng, row_seg, 0.4)
+        cl, cr = _counts(row_seg, side, n_seg)
+        pos, dstl, dstr, base_l, base_r, n_out = leafperm.level_moves(
+            jnp.asarray(tile_slot), jnp.asarray(side),
+            jnp.asarray(cl), jnp.asarray(cr))
+        bound = leafperm.tiles_bound(rec.shape[0], n_seg)
+        rec = np.asarray(leafperm.permute_records(
+            jnp.asarray(rec), pos, dstl, dstr, bound))[: int(n_out) * T]
+        # rebuild bookkeeping for the next level from the returned bases:
+        # every child AND each slack tile becomes its own segment (slack
+        # = an empty segment: its rows are all sentinels), in LAYOUT order
+        base_l, base_r = np.asarray(base_l), np.asarray(base_r)
+        n_tiles = rec.shape[0] // T
+        seg_list = (
+            [(int(base_l[k]), int(cl[k])) for k in range(n_seg)]
+            + [(int(base_l[-1]), 0)]                     # left slack
+            + [(int(base_r[k]), int(cr[k])) for k in range(n_seg)]
+            + [(int(base_r[-1]), 0)]                     # right slack
+        )
+        seg_list.sort(key=lambda t: t[0])
+        tile_slot = np.zeros(n_tiles, np.int32)
+        row_seg = np.full(n_tiles * T, -1, np.int32)
+        for newid, (b, c) in enumerate(seg_list):
+            lt = max(-(-c // T), 1)
+            tile_slot[b:b + lt] = newid
+            row_seg[b * T: b * T + c] = newid
+        got = {bytes(r) for r in rec if r.any()}
+        assert got == orig, f"level {level}: record set changed"
+        # every row outside a segment's count range is a zero sentinel
+        live = np.zeros(rec.shape[0], bool)
+        for b, c in seg_list:
+            live[b * T: b * T + c] = True
+        assert not rec[~live].any(), f"level {level}: nonzero pad rows"
+
+
+def test_stability_within_side():
+    """Rows keep their source order within (segment, side) — the grower's
+    determinism (and CPU parity) depends on stable partition."""
+    rng = np.random.default_rng(3)
+    cnt = 1500
+    rec, tile_slot, row_seg = _mk_layout(rng, [cnt])
+    # tag rows with their index in bytes 0..3 to check ordering
+    idx = np.arange(cnt, dtype=np.uint32)
+    rec[:cnt, :4] = idx.view(np.uint8).reshape(cnt, 4)
+    side = _sides(rng, row_seg, 0.5)
+    cl, cr = _counts(row_seg, side, 1)
+    pos, dstl, dstr, base_l, base_r, n_out = leafperm.level_moves(
+        jnp.asarray(tile_slot), jnp.asarray(side),
+        jnp.asarray(cl), jnp.asarray(cr))
+    bound = leafperm.tiles_bound(rec.shape[0], 1)
+    out = np.asarray(leafperm.permute_records(
+        jnp.asarray(rec), pos, dstl, dstr, bound))
+    lrows = out[: int(cl[0])]
+    rrows = out[int(base_r[0]) * T: int(base_r[0]) * T + int(cr[0])]
+    lidx = lrows[:, :4].copy().view(np.uint32).ravel()
+    ridx = rrows[:, :4].copy().view(np.uint32).ravel()
+    assert (np.diff(lidx) > 0).all()
+    assert (np.diff(ridx) > 0).all()
+    np.testing.assert_array_equal(np.sort(np.concatenate([lidx, ridx])), idx)
