@@ -55,6 +55,14 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 _TILE_ROWS = 512     # must match pallas_hist._TILE_ROWS (shared layouts)
+# Destination-row granule: Mosaic can only slice an HBM uint8 memref at
+# sublane-tile multiples ("failed to prove divisible by the tiling" for
+# arbitrary offsets — measured on v5e), so every windowed write starts on
+# a 32-row boundary.  Each source tile's per-side contribution therefore
+# OCCUPIES roundup32(rows) slots; the ≤31-row gaps are zero sentinels.
+# Overhead ≤ 2*32/512 = 12.5% extra rows per level, non-compounding (the
+# next level's compaction drops sentinels and re-pads afresh).
+_ALIGN = 32
 
 
 def _interpret(platform: str | None = None) -> bool:
@@ -80,20 +88,29 @@ def _perm_kernel(dstl_ref, dstr_ref, pos_ref, rec_ref, init_ref, out_ref,
     ``iota_o == pos[side]`` compacts one side to the front and zero-fills
     the rest."""
     i = pl.program_id(0)
-    rec = rec_ref[0].astype(jnp.bfloat16)              # (T, WB)
+    # Mosaic has no direct u8->bf16 cast; route through i32/f32 (byte
+    # values <= 255 are exact at every step)
+    rec = (rec_ref[0].astype(jnp.int32).astype(jnp.float32)
+           .astype(jnp.bfloat16))                      # (T, WB)
     iota_o = jax.lax.broadcasted_iota(jnp.int32, (T, T), 0)
     PL = (iota_o == pos_ref[0, 0][None, :]).astype(jnp.bfloat16)
     PR = (iota_o == pos_ref[0, 1][None, :]).astype(jnp.bfloat16)
     outl_vmem[...] = jax.lax.dot_general(
         PL, rec, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32).astype(jnp.uint8)
+        preferred_element_type=jnp.float32).astype(jnp.int32).astype(
+            jnp.uint8).reshape(T // _ALIGN, _ALIGN, WB)
     outr_vmem[...] = jax.lax.dot_general(
         PR, rec, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32).astype(jnp.uint8)
+        preferred_element_type=jnp.float32).astype(jnp.int32).astype(
+            jnp.uint8).reshape(T // _ALIGN, _ALIGN, WB)
+    # the out ref is viewed in _ALIGN-row GRANULES (g, _ALIGN, WB) and the
+    # dst scalars arrive pre-divided by _ALIGN: Mosaic cannot PROVE a raw
+    # runtime row offset divisible by its tiling, but a leading-granule
+    # index is divisible by construction
     cl = pltpu.make_async_copy(
-        outl_vmem, out_ref.at[pl.ds(dstl_ref[i], T), :], seml)
+        outl_vmem, out_ref.at[pl.ds(dstl_ref[i], T // _ALIGN)], seml)
     cr = pltpu.make_async_copy(
-        outr_vmem, out_ref.at[pl.ds(dstr_ref[i], T), :], semr)
+        outr_vmem, out_ref.at[pl.ds(dstr_ref[i], T // _ALIGN)], semr)
     cl.start()
     cr.start()
     # waits keep the writes ordered with the NEXT step's (they overlap a
@@ -132,53 +149,60 @@ def permute_records(rec: jnp.ndarray, pos: jnp.ndarray, dstl: jnp.ndarray,
         ],
         out_specs=pl.BlockSpec(memory_space=pltpu.MemorySpace.ANY),
         scratch_shapes=[
-            pltpu.VMEM((T, WB), jnp.uint8),
-            pltpu.VMEM((T, WB), jnp.uint8),
+            pltpu.VMEM((T // _ALIGN, _ALIGN, WB), jnp.uint8),
+            pltpu.VMEM((T // _ALIGN, _ALIGN, WB), jnp.uint8),
             pltpu.SemaphoreType.DMA,
             pltpu.SemaphoreType.DMA,
         ],
     )
-    zeros = jnp.zeros((n_out_tiles * T, WB), jnp.uint8)
+    G = n_out_tiles * T // _ALIGN
+    zeros = jnp.zeros((G, _ALIGN, WB), jnp.uint8)
     out = pl.pallas_call(
         functools.partial(_perm_kernel, T=T, WB=WB),
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((n_out_tiles * T, WB), jnp.uint8),
+        out_shape=jax.ShapeDtypeStruct((G, _ALIGN, WB), jnp.uint8),
         # operand index counts the 2 prefetched scalars first: 2=pos,
         # 3=rec, 4=zeros -> alias the zero buffer to the output
         input_output_aliases={4: 0},
         interpret=_interpret(platform),
-    )(dstl, dstr, pos.astype(jnp.int32), rec.reshape(n_tiles, T, WB), zeros)
-    return out
+    )(dstl // _ALIGN, dstr // _ALIGN, pos.astype(jnp.int32),
+      rec.reshape(n_tiles, T, WB), zeros)
+    return out.reshape(n_out_tiles * T, WB)
 
 
 def level_moves(tile_slot: jnp.ndarray, side: jnp.ndarray,
-                cnt_l: jnp.ndarray, cnt_r: jnp.ndarray,
-                T: int = _TILE_ROWS):
+                n_parents: int, T: int = _TILE_ROWS):
     """XLA bookkeeping for one level — O(N) elementwise + O(n_tiles)
     prefix work, no sort.
 
     tile_slot (n_tiles,) int32: source segment per tile (layout
     invariant).  side (n_tiles*T,) int32: 0 = left child, 1 = right
-    child, anything else = sentinel (vanishes).  cnt_l/cnt_r (P,) int32
-    EXACT child row counts per parent segment (pass-through parents put
-    everything in cnt_l with cnt_r = 0; their right segment still gets
-    the mandatory 1-tile allocation but receives only zeros).
+    child, anything else = sentinel (vanishes).  ``n_parents`` (static):
+    parent segment count P; pass-through parents route all rows left —
+    their right segment still gets the mandatory 1-tile allocation but
+    receives only zeros.
 
     Returns (pos, dstl, dstr, base_l, base_r, n_out_tiles): the new
     layout is [left children in parent order | slack | right children |
     slack]; ``base_l``/``base_r`` are (P+1,) FIRST-TILE indices of each
     parent's left/right child segment (right already offset past the
     left region), from which callers derive the next level's tile→segment
-    map.  ``n_out_tiles`` is a traced scalar — callers pick the static
-    bound (see tiles_bound)."""
+    map.  Within a segment, each source tile's contribution sits at an
+    _ALIGN-rounded offset (interior runs of < _ALIGN zero sentinels — see
+    the _ALIGN note), so real rows are NOT a contiguous prefix.
+    ``n_out_tiles`` is a traced scalar — callers pick the static bound
+    (see tiles_bound)."""
     n_tiles = tile_slot.shape[0]
+    A = _ALIGN
     s2 = side.reshape(n_tiles, T)
     isl = (s2 == 0).astype(jnp.int32)
     isr = (s2 == 1).astype(jnp.int32)
     rkl = jnp.cumsum(isl, axis=1) - isl                # stable in-tile ranks
     rkr = jnp.cumsum(isr, axis=1) - isr
-    nl_t = isl.sum(axis=1)
-    nr_t = isr.sum(axis=1)
+    # each tile's contribution OCCUPIES an _ALIGN-rounded slot run so its
+    # write start stays Mosaic-sliceable (see _ALIGN note)
+    nl_t = -(-isl.sum(axis=1) // A) * A
+    nr_t = -(-isr.sum(axis=1) // A) * A
     cl = jnp.cumsum(nl_t) - nl_t                       # global tile prefixes
     cr = jnp.cumsum(nr_t) - nr_t
     first = jnp.concatenate([jnp.ones((1,), bool),
@@ -191,8 +215,19 @@ def level_moves(tile_slot: jnp.ndarray, side: jnp.ndarray,
     prefl = cl - segl
     prefr = cr - segr
 
-    lt_l, base_l = aligned_layout(cnt_l, T)            # left region
-    lt_r, base_r = aligned_layout(cnt_r, T)            # right region
+    # segment capacities cover the PADDED contributions (per-segment sum
+    # of rounded per-tile sizes = last prefix + last size)
+    lastl = jnp.where(
+        jnp.concatenate([tile_slot[1:] != tile_slot[:-1],
+                         jnp.ones((1,), bool)]), prefl + nl_t, -1)
+    lastr = jnp.where(
+        jnp.concatenate([tile_slot[1:] != tile_slot[:-1],
+                         jnp.ones((1,), bool)]), prefr + nr_t, -1)
+    P = int(n_parents)
+    pad_l = jnp.zeros((P,), jnp.int32).at[tile_slot].max(lastl)
+    pad_r = jnp.zeros((P,), jnp.int32).at[tile_slot].max(lastr)
+    lt_l, base_l = aligned_layout(pad_l, T)            # left region
+    lt_r, base_r = aligned_layout(pad_r, T)            # right region
     left_tiles = base_l[-1]
     # region layout: [left | 1 slack | right | 1 slack]
     off_r = left_tiles + 1
@@ -206,10 +241,15 @@ def level_moves(tile_slot: jnp.ndarray, side: jnp.ndarray,
 
 
 def tiles_bound(n_rows: int, n_parents: int, T: int = _TILE_ROWS) -> int:
-    """Static bound for ``n_out_tiles``: every row lands somewhere
-    (ceil(n/T) + per-segment alignment waste) + mandatory empty-segment
-    tiles + the two slack tiles."""
-    return n_rows // T + 2 * n_parents + 3
+    """Static bound for ``n_out_tiles``: every row lands somewhere, each
+    source tile adds up to 2·(_ALIGN-1) interior pad rows (alignment
+    rounding per side), plus per-segment tile-alignment waste, mandatory
+    empty-segment tiles and the two slack tiles.  The padding does NOT
+    compound across levels (pads drop at the next compaction): the tile
+    count converges to ≲ rows/T · 1/(1 − 2·_ALIGN/T) ≈ 1.14x."""
+    n_src_tiles = n_rows // T
+    pad_rows = 2 * _ALIGN * n_src_tiles
+    return (n_rows + pad_rows) // T + 2 * n_parents + 4
 
 
 # ---------------------------------------------------------------------------
@@ -217,29 +257,63 @@ def tiles_bound(n_rows: int, n_parents: int, T: int = _TILE_ROWS) -> int:
 # ---------------------------------------------------------------------------
 
 def permute_records_np(rec: np.ndarray, tile_slot: np.ndarray,
-                       side: np.ndarray, cnt_l: np.ndarray,
-                       cnt_r: np.ndarray, n_out_tiles: int,
-                       T: int = _TILE_ROWS) -> np.ndarray:
+                       side: np.ndarray, n_parents: int, n_out_tiles: int,
+                       T: int = _TILE_ROWS):
     """Reference: stable per-(segment, side) order into the
-    [left | slack | right | slack] aligned layout."""
+    [left | slack | right | slack] layout with _ALIGN-rounded per-tile
+    contributions — mirrors level_moves exactly.
+
+    Returns (out, tile_slot_new, row_seg_new): the permuted buffer plus
+    the NEXT level's tile→segment map and per-row segment ids (−1 for
+    sentinels), segments numbered [left children 0..P−1, then right
+    children P..2P−1] in parent order."""
+    A = _ALIGN
     n_tiles = tile_slot.shape[0]
     WB = rec.shape[1]
-    lt_l = np.maximum(-(-np.asarray(cnt_l) // T), 1)
-    lt_r = np.maximum(-(-np.asarray(cnt_r) // T), 1)
+    P = n_parents
+    # padded per-segment capacities (sum of rounded per-tile sizes)
+    pad_l = np.zeros(P, np.int64)
+    pad_r = np.zeros(P, np.int64)
+    for i in range(n_tiles):
+        s = tile_slot[i]
+        sd = side[i * T:(i + 1) * T]
+        pad_l[s] += -(-int((sd == 0).sum()) // A) * A
+        pad_r[s] += -(-int((sd == 1).sum()) // A) * A
+    lt_l = np.maximum(-(-pad_l // T), 1)
+    lt_r = np.maximum(-(-pad_r // T), 1)
     base_l = np.concatenate([[0], np.cumsum(lt_l)]).astype(np.int64)
     off_r = base_l[-1] + 1
     base_r = off_r + np.concatenate([[0], np.cumsum(lt_r)]).astype(np.int64)
     out = np.zeros((n_out_tiles * T, WB), np.uint8)
-    fill_l = np.zeros(len(cnt_l), np.int64)
-    fill_r = np.zeros(len(cnt_r), np.int64)
+    row_seg = np.full(n_out_tiles * T, -1, np.int64)
+    tile_slot_new = np.full(n_out_tiles, -1, np.int64)
+    for s in range(P):
+        tile_slot_new[base_l[s]: base_l[s + 1]] = s
+        tile_slot_new[base_r[s]: base_r[s + 1]] = P + s
+    # slack (and trailing bound) tiles hold only sentinels: absorb them
+    # into the PRECEDING segment so tile→segment stays a sequence of
+    # consecutive runs (level_moves' prefix bookkeeping requires it); an
+    # extra all-sentinel tile contributes a rounded-zero size — harmless
+    for i in range(n_out_tiles):
+        if tile_slot_new[i] < 0:
+            tile_slot_new[i] = tile_slot_new[i - 1] if i else 0
+    fill_l = np.zeros(P, np.int64)
+    fill_r = np.zeros(P, np.int64)
     for i in range(n_tiles):
         s = tile_slot[i]
+        nl = nr = 0
         for j in range(T):
             sd = side[i * T + j]
             if sd == 0:
-                out[base_l[s] * T + fill_l[s]] = rec[i * T + j]
-                fill_l[s] += 1
+                pos = base_l[s] * T + fill_l[s] + nl
+                out[pos] = rec[i * T + j]
+                row_seg[pos] = s
+                nl += 1
             elif sd == 1:
-                out[base_r[s] * T + fill_r[s]] = rec[i * T + j]
-                fill_r[s] += 1
-    return out
+                pos = base_r[s] * T + fill_r[s] + nr
+                out[pos] = rec[i * T + j]
+                row_seg[pos] = P + s
+                nr += 1
+        fill_l[s] += -(-nl // A) * A
+        fill_r[s] += -(-nr // A) * A
+    return out, tile_slot_new, row_seg

@@ -663,7 +663,10 @@ def maybe_natural_tiles(Xb: jnp.ndarray, total_bins: int,
     skip-empty tiles, device-cached X) the same measurement shows it
     WINNING (2.78 -> 2.55 s/iter at 10M), so the default gate is now
     512 MB — wide enough for Higgs-10M's 280 MB, still excluding
-    Epsilon-shaped 800 MB matrices that were never measured under it.
+    Epsilon-shaped 800 MB matrices: r5 finally measured that shape
+    (exp_r5_eps.py: nat 347 vs plan 368 ms per 16-slot level — a ~6%
+    win worth ~1% of an Epsilon iteration) and KEEPS the exclusion; the
+    small win does not justify doubling peak bin-matrix residency.
     ``DRYAD_NAT_MB`` overrides for measurement — read ONCE at import (a
     per-call read would be silently ignored whenever the jit cache already
     holds a program for these shapes: the env var is not part of the key).

@@ -852,6 +852,14 @@ def train_device(
         # model under-estimates (Epsilon 1.25x); the second-chunk
         # calibration still re-derives CH from measurement either way
         CH = max(1, min(64, int(25.0 / max(est_for_ch, 1e-3))))
+        # DRYAD_CH_MAX caps the chunk length (initial AND calibrated) —
+        # an operational escape hatch for tunnel phases that kill
+        # standard-length (~20 s) chunk executions: the 2026-07-31
+        # 500-tree 10M headline runs died 6/6 with CH 6-8 while CH <= 2
+        # runs sailed through (same program, same data).  Off by default.
+        _ch_max = int(os.environ.get("DRYAD_CH_MAX", "0"))
+        if _ch_max > 0:
+            CH = min(CH, _ch_max)
         # The cost model overestimates (measured 1.7-4x — fixed overheads
         # amortize sublinearly), so a model-derived CH of 1 may really
         # afford 2-4 iterations: admit single-iteration chunks when the
@@ -1017,6 +1025,8 @@ def train_device(
                     per_iter = max((now - t_mark) / n, 1e-4)
                     cap = CH0 if bagging else 64
                     CH = max(1, min(cap, int(20.0 / per_iter)))
+                    if _ch_max > 0:
+                        CH = min(CH, _ch_max)
                     calibrated = True
                 t_mark = now
             else:
@@ -1032,9 +1042,13 @@ def train_device(
                 # on the chunk TWO dispatches back keeps one chunk of
                 # pipeline overlap (chunks are calibrated to ~20 s, so any
                 # later fetch waits <= ~2 chunks ~= 40 s).
+                # a REAL one-element fetch, not block_until_ready — the
+                # latter returned instantly on this tunnel for jit scalar
+                # results (CLAUDE.md measuring notes) and would leave the
+                # cap a no-op; the ~100 ms fetch RTT is <1% of a chunk
                 inflight.append(out["max_depth"])
                 if len(inflight) > 2:
-                    jax.block_until_ready(inflight.pop(0))
+                    jax.device_get(inflight.pop(0)[:1])
             chunk_idx += 1
 
             evs = eval_iters_in(it, it + n)

@@ -27,9 +27,12 @@ def loop_time(fn, *arrays, K=8):
         return jax.lax.fori_loop(0, K, lambda i, s: fn(s, *arrays), s0)
 
     f = jax.jit(prog)
-    f(jnp.float32(0), *arrays).block_until_ready()     # compile + warm
+    # REAL fetches: block_until_ready returned instantly through this
+    # tunnel and measured 0.0 ms until the float() fetch was added
+    # (CLAUDE.md measuring notes, r5)
+    float(f(jnp.float32(0), *arrays))                  # compile + warm
     t0 = time.perf_counter()
-    f(jnp.float32(1), *arrays).block_until_ready()
+    float(f(jnp.float32(1), *arrays))
     return (time.perf_counter() - t0) / K * 1000
 
 
@@ -44,17 +47,21 @@ def main():
 
     # ---- 1. partition: reduce vs gather ------------------------------------
     def part_reduce(s, Xb, rf):
-        rfp = (rf + s.astype(jnp.int32)) % F           # perturb the INDEX
+        # integer-meaningful perturbation: s advances by whole units per
+        # rep (the earlier s + eps*sum draft rounded to a CONSTANT under
+        # the int cast and XLA hoisted the whole stage — the CLAUDE.md
+        # dead-input trap)
+        rfp = (rf + s.astype(jnp.int32)) % F
         iota_f = jnp.arange(F, dtype=jnp.int32)
         bins = jnp.max(jnp.where(rfp[:, None] == iota_f[None, :], Xb,
                                  jnp.zeros((), Xb.dtype)),
                        axis=1).astype(jnp.int32)
-        return jnp.sum(bins).astype(jnp.float32)
+        return s + 1.0 + jnp.sum(bins).astype(jnp.float32) * 1e-20
 
     def part_gather(s, Xb, rf):
         rfp = (rf + s.astype(jnp.int32)) % F
         bins = jnp.take_along_axis(Xb, rfp[:, None], axis=1)[:, 0]
-        return jnp.sum(bins.astype(jnp.int32)).astype(jnp.float32)
+        return s + 1.0 + jnp.sum(bins.astype(jnp.int32)).astype(jnp.float32) * 1e-20
 
     t_red = loop_time(part_reduce, Xb, rf)
     t_gat = loop_time(part_gather, Xb, rf)
@@ -69,7 +76,7 @@ def main():
     sel = jnp.asarray(sel_np)
     t0 = time.perf_counter()
     nat = pallas_hist.natural_tiles(Xb, B)
-    jax.block_until_ready(nat)
+    float(jnp.sum(nat[0, 0, 0].astype(jnp.float32)))   # REAL fetch
     t_tiles = time.perf_counter() - t0
     print(f"nat tiles build: {t_tiles:.1f} s "
           f"(buffer {nat.size * nat.dtype.itemsize / 1e9:.2f} GB)",
@@ -78,7 +85,7 @@ def main():
     def nat_step(s, nat, g, h, sel):
         selp = (sel + s.astype(jnp.int32)) % P          # perturb the SLOT
         out = pallas_hist.build_hist_small(nat, g, h, selp, P, B, F)
-        return out[0, 0, 0, 0]
+        return s + 1.0 + out[0, 0, 0, 0] * 1e-20
 
     t_nat = loop_time(nat_step, nat, g, h, sel, K=3)
 
@@ -88,7 +95,7 @@ def main():
     def plan_step(s, Xb, g, h, sel):
         selp = (sel + s.astype(jnp.int32)) % P
         out = build_hist_segmented(Xb, g, h, selp, P, B, backend="pallas")
-        return out[0, 0, 0, 0]
+        return s + 1.0 + out[0, 0, 0, 0] * 1e-20
 
     t_plan = loop_time(plan_step, Xb, g, h, sel, K=3)
     print(f"16-slot level pass  nat {t_nat:7.0f} ms   plan(sort+gather+"

@@ -22,14 +22,16 @@ from dryad_tpu.engine import pallas_hist
 T = leafperm._TILE_ROWS
 
 
-def loop_time(fn, *arrays, K=5):
+def loop_time(fn, *a_, K=5):
     def prog(s0, *a):
         return jax.lax.fori_loop(0, K, lambda i, s: fn(s, *a), s0)
 
     f = jax.jit(prog)
-    f(jnp.float32(0), *arrays).block_until_ready()
+    # REAL fetches — block_until_ready is a no-op through this tunnel
+    # (CLAUDE.md measuring notes, r5)
+    float(f(jnp.float32(0), *a_))
     t0 = time.perf_counter()
-    f(jnp.float32(1), *arrays).block_until_ready()
+    float(f(jnp.float32(1), *a_))
     return (time.perf_counter() - t0) / K * 1000
 
 
@@ -53,18 +55,13 @@ def device_correctness_check():
     side = np.where(row_seg >= 0,
                     (rng.random(row_seg.size) < 0.5).astype(np.int32),
                     2).astype(np.int32)
-    cl = np.zeros(len(seg_counts), np.int32)
-    cr = np.zeros(len(seg_counts), np.int32)
-    for s, sd in zip(row_seg, side):
-        if s >= 0 and sd < 2:
-            (cl if sd == 0 else cr)[s] += 1
     pos, dstl, dstr, _, _, n_out = leafperm.level_moves(
-        jnp.asarray(tile_slot), jnp.asarray(side),
-        jnp.asarray(cl), jnp.asarray(cr))
+        jnp.asarray(tile_slot), jnp.asarray(side), len(seg_counts))
     bound = leafperm.tiles_bound(rec.shape[0], len(seg_counts))
     got = np.asarray(leafperm.permute_records(
         jnp.asarray(rec), pos, dstl, dstr, bound))
-    want = leafperm.permute_records_np(rec, tile_slot, side, cl, cr, bound)
+    want, _, _ = leafperm.permute_records_np(rec, tile_slot, side,
+                                             len(seg_counts), bound)
     np.testing.assert_array_equal(got[: int(n_out) * T],
                                   want[: int(n_out) * T])
     print("on-device bitwise vs oracle: OK", flush=True)
@@ -99,19 +96,15 @@ def main():
     # ---- permutation kernel: bookkeeping + move ---------------------------
     def perm_step(s, rec_d, tile_slot_d, row_seg_d, u):
         # perturbed split: the side bits change with s, reaching every stage
-        thr = 0.45 + 0.1 * (s - jnp.floor(s / 2) * 2) / 2
+        # s advances by whole units per rep (dead-input trap note
+        # in CLAUDE.md): thr alternates between reps
+        thr = 0.45 + 0.05 * (s - jnp.floor(s / 2) * 2)
         side = jnp.where(row_seg_d >= 0,
                          (u < thr).astype(jnp.int32), 2)
-        real = row_seg_d >= 0
-        segs = jnp.where(real, row_seg_d, 0)
-        cl = jnp.zeros((P,), jnp.int32).at[segs].add(
-            jnp.where(real & (side == 0), 1, 0))
-        cr = jnp.zeros((P,), jnp.int32).at[segs].add(
-            jnp.where(real & (side == 1), 1, 0))
         pos, dstl, dstr, _, _, _ = leafperm.level_moves(
-            tile_slot_d, side, cl, cr)
+            tile_slot_d, side, P)
         out = leafperm.permute_records(rec_d, pos, dstl, dstr, bound)
-        return s + out[0, 0].astype(jnp.float32) * 1e-9
+        return s + 1.0 + out[0, 0].astype(jnp.float32) * 1e-20
 
     t_perm = loop_time(perm_step, rec_d, tile_slot_d, row_seg_d, u, K=3)
     print(f"leafperm (bookkeeping + move, full N): {t_perm:8.1f} ms/level",
@@ -129,7 +122,7 @@ def main():
         key = ((selp.astype(jnp.uint32) << jnp.uint32(24))
                | jnp.arange(N, dtype=jnp.uint32))
         srt = jnp.sort(key)
-        return s + srt[0].astype(jnp.float32) * 1e-9
+        return s + 1.0 + srt[0].astype(jnp.float32) * 1e-20
 
     t_sort = loop_time(sort_step, sel_d, K=3)
 
@@ -143,7 +136,7 @@ def main():
     def gather_step(s, records, perm_idx):
         idx = (perm_idx + s.astype(jnp.int32)) % N    # perturb the INDEX
         r = records[idx]
-        return s + r[0, 0].astype(jnp.float32) * 1e-9
+        return s + 1.0 + r[0, 0].astype(jnp.float32) * 1e-20
 
     t_gath = loop_time(gather_step, records, perm_idx, K=3)
     print(f"current  packed sort(full N) {t_sort:8.1f} ms   "

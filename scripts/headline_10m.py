@@ -79,8 +79,13 @@ def main():
                     checkpoint_every=50, resume=True)
     wall = time.perf_counter() - t0
     if not fresh:
+        # a resumed run's wall covers only the REMAINDER: writing
+        # trees/wall would inflate the headline metric — refuse
         print("NOTE: resumed from a prior crash — wall covers the "
-              "remainder only", flush=True)
+              "remainder only; NOT writing the headline iters/s "
+              f"(remainder wall {wall:.1f}s). Clear {main_ck} and rerun "
+              "for a clean artifact.", flush=True)
+        return 1
     iters_per_sec = args.trees / wall
     hist = b.train_state["eval_history"]["valid_auc"]
     valid_auc = hist[-1][1]

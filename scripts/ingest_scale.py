@@ -80,9 +80,7 @@ def main():
     # fixed nnz per row makes the row index a repeat)
     dense = np.zeros((sub, F), np.float32)
     rows_idx = np.repeat(np.arange(sub), nnz_per_row)
-    m = rows_idx < sub
-    dense[rows_idx[m], cols[: sub * nnz_per_row][m]] = \
-        vals[: sub * nnz_per_row][m]
+    dense[rows_idx, cols[: sub * nnz_per_row]] = vals[: sub * nnz_per_row]
     Xb_ref = ds.mapper.transform(dense)
     np.testing.assert_array_equal(np.asarray(ds.X_binned[:sub]), Xb_ref)
     print("stream == in-memory bins on 500k-row subsample: EXACT",

@@ -1,6 +1,7 @@
 """Leaf-ordered permutation kernel (engine/leafperm.py): bitwise equality
 with the numpy oracle in interpret mode, layout invariants, and the
-multi-level refinement chain."""
+multi-level refinement chain — with the _ALIGN-rounded per-tile
+contributions Mosaic's HBM slicing requires."""
 
 import numpy as np
 import pytest
@@ -13,42 +14,37 @@ T = leafperm._TILE_ROWS
 
 
 def _mk_layout(rng, seg_counts, WB=64):
-    """Build a tile-aligned layout: records with distinctive bytes,
-    sentinel rows zero.  Returns (rec, tile_slot, row_seg)."""
+    """Tile-aligned layout with contiguous-prefix segments (the level-0
+    shape): distinctive record bytes, zero sentinels."""
     lt = np.maximum(-(-np.asarray(seg_counts) // T), 1)
     n_tiles = int(lt.sum())
     rec = np.zeros((n_tiles * T, WB), np.uint8)
     tile_slot = np.repeat(np.arange(len(seg_counts)), lt).astype(np.int32)
     row_seg = np.full(n_tiles * T, -1, np.int32)
     base = np.concatenate([[0], np.cumsum(lt)])
-    rid = 0
     for s, cnt in enumerate(seg_counts):
         r0 = base[s] * T
-        for j in range(cnt):
-            rec[r0 + j] = rng.integers(1, 255, WB, dtype=np.uint8)
-            row_seg[r0 + j] = s
-            rid += 1
+        rec[r0:r0 + cnt] = rng.integers(1, 255, (cnt, WB), dtype=np.uint8)
+        row_seg[r0:r0 + cnt] = s
     return rec, tile_slot, row_seg
 
 
 def _sides(rng, row_seg, p_right=0.5):
-    """Random left/right per real row; sentinel rows get 2."""
-    side = np.where(row_seg >= 0,
+    return np.where(row_seg >= 0,
                     (rng.random(row_seg.size) < p_right).astype(np.int32),
                     2).astype(np.int32)
-    return side
 
 
-def _counts(row_seg, side, n_seg):
-    cl = np.zeros(n_seg, np.int32)
-    cr = np.zeros(n_seg, np.int32)
-    for s, sd in zip(row_seg, side):
-        if s >= 0:
-            if sd == 0:
-                cl[s] += 1
-            elif sd == 1:
-                cr[s] += 1
-    return cl, cr
+def _run_level(rec, tile_slot, side, n_seg):
+    pos, dstl, dstr, base_l, base_r, n_out = leafperm.level_moves(
+        jnp.asarray(tile_slot), jnp.asarray(side), n_seg)
+    bound = leafperm.tiles_bound(rec.shape[0], n_seg)
+    assert int(n_out) <= bound, (int(n_out), bound)
+    got = np.asarray(leafperm.permute_records(
+        jnp.asarray(rec), pos, dstl, dstr, bound))
+    want, ts_new, rs_new = leafperm.permute_records_np(
+        rec, tile_slot, side, n_seg, bound)
+    return got, want, ts_new, rs_new, int(n_out)
 
 
 @pytest.mark.parametrize("seg_counts,p_right", [
@@ -61,85 +57,63 @@ def test_permute_matches_oracle(seg_counts, p_right):
     rng = np.random.default_rng(hash((tuple(seg_counts), p_right)) % 2**31)
     rec, tile_slot, row_seg = _mk_layout(rng, seg_counts)
     side = _sides(rng, row_seg, p_right)
-    cl, cr = _counts(row_seg, side, len(seg_counts))
-
-    pos, dstl, dstr, base_l, base_r, n_out = leafperm.level_moves(
-        jnp.asarray(tile_slot), jnp.asarray(side),
-        jnp.asarray(cl), jnp.asarray(cr))
-    bound = leafperm.tiles_bound(rec.shape[0], len(seg_counts))
-    assert int(n_out) <= bound
-    got = np.asarray(leafperm.permute_records(
-        jnp.asarray(rec), pos, dstl, dstr, bound))
-    want = leafperm.permute_records_np(rec, tile_slot, side, cl, cr, bound)
-    np.testing.assert_array_equal(got[: int(n_out) * T],
-                                  want[: int(n_out) * T])
+    got, want, _, _, n_out = _run_level(rec, tile_slot, side,
+                                        len(seg_counts))
+    np.testing.assert_array_equal(got[: n_out * T], want[: n_out * T])
 
 
 def test_multi_level_chain():
-    """Three refinement levels keep every real record exactly once and
-    all pads zero — the invariant the grower integration relies on."""
+    """Three refinement levels keep every real record exactly once, all
+    pads zero, and the kernel bitwise-equal to the oracle at each level
+    (the oracle's returned tile/segment maps drive the next level — the
+    exact bookkeeping a grower integration would)."""
     rng = np.random.default_rng(7)
-    seg_counts = [5000, 2000]
-    rec, tile_slot, row_seg = _mk_layout(rng, seg_counts)
+    rec, tile_slot, row_seg = _mk_layout(rng, [5000, 2000])
     orig = {bytes(r) for r in rec if r.any()}
+    n_seg = 2
     for level in range(3):
-        n_seg = int(tile_slot.max()) + 1
         side = _sides(rng, row_seg, 0.4)
-        cl, cr = _counts(row_seg, side, n_seg)
-        pos, dstl, dstr, base_l, base_r, n_out = leafperm.level_moves(
-            jnp.asarray(tile_slot), jnp.asarray(side),
-            jnp.asarray(cl), jnp.asarray(cr))
-        bound = leafperm.tiles_bound(rec.shape[0], n_seg)
-        rec = np.asarray(leafperm.permute_records(
-            jnp.asarray(rec), pos, dstl, dstr, bound))[: int(n_out) * T]
-        # rebuild bookkeeping for the next level from the returned bases:
-        # every child AND each slack tile becomes its own segment (slack
-        # = an empty segment: its rows are all sentinels), in LAYOUT order
-        base_l, base_r = np.asarray(base_l), np.asarray(base_r)
-        n_tiles = rec.shape[0] // T
-        seg_list = (
-            [(int(base_l[k]), int(cl[k])) for k in range(n_seg)]
-            + [(int(base_l[-1]), 0)]                     # left slack
-            + [(int(base_r[k]), int(cr[k])) for k in range(n_seg)]
-            + [(int(base_r[-1]), 0)]                     # right slack
-        )
-        seg_list.sort(key=lambda t: t[0])
-        tile_slot = np.zeros(n_tiles, np.int32)
-        row_seg = np.full(n_tiles * T, -1, np.int32)
-        for newid, (b, c) in enumerate(seg_list):
-            lt = max(-(-c // T), 1)
-            tile_slot[b:b + lt] = newid
-            row_seg[b * T: b * T + c] = newid
-        got = {bytes(r) for r in rec if r.any()}
-        assert got == orig, f"level {level}: record set changed"
-        # every row outside a segment's count range is a zero sentinel
-        live = np.zeros(rec.shape[0], bool)
-        for b, c in seg_list:
-            live[b * T: b * T + c] = True
-        assert not rec[~live].any(), f"level {level}: nonzero pad rows"
+        got, want, ts_new, rs_new, n_out = _run_level(
+            rec, tile_slot, side, n_seg)
+        np.testing.assert_array_equal(got[: n_out * T], want[: n_out * T])
+        rec = want[: n_out * T]
+        tile_slot = ts_new[: n_out].astype(np.int32)
+        row_seg = rs_new[: n_out * T].astype(np.int32)
+        n_seg = 2 * n_seg
+        assert {bytes(r) for r in rec if r.any()} == orig, \
+            f"level {level}: record set changed"
+        assert not rec[row_seg < 0].any(), f"level {level}: nonzero pads"
 
 
 def test_stability_within_side():
-    """Rows keep their source order within (segment, side) — the grower's
-    determinism (and CPU parity) depends on stable partition."""
+    """Real rows keep their source order within (segment, side) — the
+    grower's determinism (and CPU parity) rides on stable partition."""
     rng = np.random.default_rng(3)
     cnt = 1500
     rec, tile_slot, row_seg = _mk_layout(rng, [cnt])
-    # tag rows with their index in bytes 0..3 to check ordering
-    idx = np.arange(cnt, dtype=np.uint32)
+    idx = np.arange(1, cnt + 1, dtype=np.uint32)     # nonzero tags
     rec[:cnt, :4] = idx.view(np.uint8).reshape(cnt, 4)
     side = _sides(rng, row_seg, 0.5)
-    cl, cr = _counts(row_seg, side, 1)
-    pos, dstl, dstr, base_l, base_r, n_out = leafperm.level_moves(
-        jnp.asarray(tile_slot), jnp.asarray(side),
-        jnp.asarray(cl), jnp.asarray(cr))
-    bound = leafperm.tiles_bound(rec.shape[0], 1)
-    out = np.asarray(leafperm.permute_records(
-        jnp.asarray(rec), pos, dstl, dstr, bound))
-    lrows = out[: int(cl[0])]
-    rrows = out[int(base_r[0]) * T: int(base_r[0]) * T + int(cr[0])]
-    lidx = lrows[:, :4].copy().view(np.uint32).ravel()
-    ridx = rrows[:, :4].copy().view(np.uint32).ravel()
-    assert (np.diff(lidx) > 0).all()
-    assert (np.diff(ridx) > 0).all()
-    np.testing.assert_array_equal(np.sort(np.concatenate([lidx, ridx])), idx)
+    got, want, ts_new, rs_new, n_out = _run_level(rec, tile_slot, side, 1)
+    np.testing.assert_array_equal(got[: n_out * T], want[: n_out * T])
+    out = got[: n_out * T]
+    rs = rs_new[: n_out * T]
+    for seg in (0, 1):                               # left child, right child
+        rows = out[rs == seg]
+        tags = rows[:, :4].copy().view(np.uint32).ravel()
+        assert (np.diff(tags) > 0).all(), f"segment {seg} order broken"
+    all_tags = out[rs >= 0][:, :4].copy().view(np.uint32).ravel()
+    np.testing.assert_array_equal(np.sort(all_tags), idx)
+
+
+def test_alignment_of_all_writes():
+    """Every destination offset is _ALIGN-divisible — the Mosaic HBM
+    slicing constraint that forced the rounded layout (an arbitrary
+    offset fails to lower: 'not divisible by the tiling (8)')."""
+    rng = np.random.default_rng(9)
+    rec, tile_slot, row_seg = _mk_layout(rng, [700, 3, 900])
+    side = _sides(rng, row_seg, 0.37)
+    pos, dstl, dstr, _, _, _ = leafperm.level_moves(
+        jnp.asarray(tile_slot), jnp.asarray(side), 3)
+    assert (np.asarray(dstl) % leafperm._ALIGN == 0).all()
+    assert (np.asarray(dstr) % leafperm._ALIGN == 0).all()
