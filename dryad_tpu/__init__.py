@@ -18,10 +18,12 @@ import numpy as np
 
 from dryad_tpu.booster import Booster
 from dryad_tpu.config import Params, make_params
+from dryad_tpu.cv import cv
 from dryad_tpu.dataset import Dataset
 
 __version__ = "0.1.0"
-__all__ = ["train", "predict", "Dataset", "Booster", "Params", "__version__"]
+__all__ = ["train", "predict", "cv", "Dataset", "Booster", "Params",
+           "__version__"]
 
 
 def train(

@@ -448,6 +448,10 @@ def train_cpu(
         for vXb in vXbs
     ]
     best_iteration, best_value, stale = -1, None, 0
+    # full per-set metric history, mirrored onto the booster exactly like
+    # the device trainer's train_state["eval_history"] (same keys), so
+    # cross-backend consumers (dryad.cv, callbacks) see one surface
+    eval_history: dict[str, list] = {}
     if init_booster is not None:
         # resume continues the eval/early-stop state exactly where it stopped
         for vXb, vscore in zip(vXbs, vscores):
@@ -464,6 +468,11 @@ def train_cpu(
         # inherit it — the coming drops rescale trees inside that prefix,
         # so truncating predict there would score a model that never
         # existed (ADVICE r4); DART's own checkpoints always carry -1
+        if init_booster.train_state.get("eval_history"):
+            # resume carries the prior segment's history (device-trainer
+            # convention) so the merged run matches the uninterrupted one
+            eval_history = {k: list(v) for k, v in
+                            init_booster.train_state["eval_history"].items()}
 
     def _grad_hess(sc):
         if p.objective == "lambdarank":
@@ -584,6 +593,8 @@ def train_cpu(
                     vds.query_offsets, p.ndcg_at,
                 )
                 info[f"{vname}_{name}"] = value
+                eval_history.setdefault(f"{vname}_{name}", []).append(
+                    [it, float(value)])
                 if vi > 0:
                     continue  # early stopping watches the first set only
                 best_iteration, best_value, stale = update_best(
@@ -596,16 +607,20 @@ def train_cpu(
         if callback is not None:
             callback(it, info)
         if checkpointer is not None and checkpointer.due(it + 1):
-            checkpointer.save(
-                _make_booster(p, data.mapper, out, (it + 1) * K, init,
-                              max_depth_seen, best_iteration, best_value, stale),
-                it + 1,
-            )
+            ckpt = _make_booster(p, data.mapper, out, (it + 1) * K, init,
+                                 max_depth_seen, best_iteration, best_value,
+                                 stale)
+            if eval_history:
+                ckpt.train_state["eval_history"] = eval_history
+            checkpointer.save(ckpt, it + 1)
         if stop:
             break
 
-    return _make_booster(p, data.mapper, out, T, init, max_depth_seen,
-                         best_iteration, best_value, stale)
+    booster = _make_booster(p, data.mapper, out, T, init, max_depth_seen,
+                            best_iteration, best_value, stale)
+    if eval_history:
+        booster.train_state["eval_history"] = eval_history
+    return booster
 
 
 def _make_booster(p, mapper, out, T, init, max_depth_seen, best_iteration,

@@ -1,0 +1,101 @@
+"""k-fold cross-validation — the LightGBM ``cv()`` entry point of the
+de-facto GBDT surface (SURVEY.md §2 #9's API family).
+
+Rows are binned ONCE (the input Dataset's frozen mapper is shared by
+every fold — fold matrices are row slices of the already-binned table),
+then each fold trains with its holdout as the validation set and the
+per-iteration metric values aggregate to mean/std curves.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from dryad_tpu.config import make_params
+from dryad_tpu.dataset import Dataset
+
+
+def _fold_indices(y: np.ndarray, nfold: int, stratified: bool,
+                  shuffle: bool, seed: int) -> list[np.ndarray]:
+    """Per-fold holdout row ids; stratified keeps label proportions by
+    interleaving each class's (optionally shuffled) rows round-robin."""
+    N = y.shape[0]
+    rng = np.random.default_rng(seed)
+    if stratified:
+        order = np.empty(N, np.int64)
+        pos = 0
+        classes = np.unique(y)
+        buckets: list[np.ndarray] = [[] for _ in range(nfold)]
+        for c in classes:
+            rows = np.flatnonzero(y == c)
+            if shuffle:
+                rows = rng.permutation(rows)
+            for k in range(nfold):
+                buckets[k].append(rows[k::nfold])
+        return [np.sort(np.concatenate(b)) for b in buckets]
+    rows = rng.permutation(N) if shuffle else np.arange(N)
+    return [np.sort(rows[k::nfold]) for k in range(nfold)]
+
+
+def cv(params, train_set: Dataset, nfold: int = 5, *,
+       stratified: Optional[bool] = None, shuffle: bool = True,
+       seed: int = 0, backend: str = "auto",
+       return_boosters: bool = False) -> dict:
+    """k-fold CV: returns ``{"valid_<metric>-mean": [...],
+    "valid_<metric>-stdv": [...]}`` per-iteration curves (the -mean/-stdv
+    suffix convention of LightGBM's cv, on THIS library's underscore
+    eval-history keys, e.g. ``valid_auc-mean``), truncated to the
+    shortest fold when early stopping ends folds at different lengths;
+    ``return_boosters=True`` adds the per-fold boosters under
+    ``"boosters"``.
+
+    ``stratified`` defaults to True for binary/multiclass and False
+    otherwise.  Ranking data (query groups) is rejected — row-level folds
+    would split queries."""
+    import dryad_tpu as dryad
+
+    p = make_params(params)
+    if train_set.group is not None:
+        raise ValueError("cv does not support ranking data: row-level "
+                         "folds would split query groups")
+    if nfold < 2:
+        raise ValueError("nfold must be >= 2")
+    y = train_set.y
+    if y is None:
+        raise ValueError("cv needs labels on the Dataset")
+    if stratified is None:
+        stratified = p.objective in ("binary", "multiclass")
+
+    folds = _fold_indices(y, nfold, stratified, shuffle, seed)
+    all_rows = np.arange(train_set.num_rows)
+    Xb = train_set.X_binned
+    w = train_set.weight
+    curves: list[dict[str, np.ndarray]] = []
+    boosters = []
+    for hold in folds:
+        tr = np.setdiff1d(all_rows, hold, assume_unique=True)
+        ds_tr = Dataset.from_binned(
+            Xb[tr], train_set.mapper, y[tr],
+            weight=None if w is None else w[tr],
+            categorical_features=train_set.categorical_features)
+        ds_va = Dataset.from_binned(
+            Xb[hold], train_set.mapper, y[hold],
+            weight=None if w is None else w[hold],
+            categorical_features=train_set.categorical_features)
+        b = dryad.train(p, ds_tr, [ds_va], backend=backend)
+        hist = b.train_state.get("eval_history", {})
+        curves.append({name: np.asarray([v for _, v in rows], np.float64)
+                       for name, rows in hist.items()})
+        boosters.append(b)
+
+    out: dict = {}
+    for name in curves[0]:
+        L = min(c[name].shape[0] for c in curves)
+        stack = np.stack([c[name][:L] for c in curves])
+        out[f"{name}-mean"] = stack.mean(axis=0).tolist()
+        out[f"{name}-stdv"] = stack.std(axis=0).tolist()
+    if return_boosters:
+        out["boosters"] = boosters
+    return out

@@ -1,0 +1,68 @@
+"""dryad.cv — k-fold cross-validation (LightGBM cv() surface)."""
+
+import numpy as np
+import pytest
+
+import dryad_tpu as dryad
+from dryad_tpu.cv import _fold_indices
+from dryad_tpu.datasets import higgs_like
+
+
+@pytest.fixture(scope="module")
+def data():
+    X, y = higgs_like(6000, seed=13)
+    return X, y, dryad.Dataset(X, y, max_bins=32)
+
+
+def test_fold_indices_partition_and_stratify():
+    y = np.array([0] * 80 + [1] * 20, np.float32)
+    folds = _fold_indices(y, 4, stratified=True, shuffle=True, seed=3)
+    allr = np.sort(np.concatenate(folds))
+    np.testing.assert_array_equal(allr, np.arange(100))     # exact partition
+    for f in folds:
+        assert abs((y[f] == 1).mean() - 0.2) < 0.05         # proportions kept
+
+
+def test_cv_curves_and_quality(data):
+    X, y, ds = data
+    res = dryad.cv(dict(objective="binary", num_trees=12, num_leaves=15,
+                        max_bins=32), ds, nfold=3, seed=5, backend="cpu")
+    mean = res["valid_auc-mean"]
+    stdv = res["valid_auc-stdv"]
+    assert len(mean) == 12 and len(stdv) == 12
+    assert mean[-1] > 0.70                    # learns on held-out rows
+    assert mean[-1] > mean[0]                 # improves over iterations
+    assert all(s >= 0 for s in stdv)
+
+
+def test_cv_return_boosters_and_determinism(data):
+    X, y, ds = data
+    kw = dict(nfold=3, seed=9, backend="cpu", return_boosters=True)
+    p = dict(objective="binary", num_trees=5, num_leaves=7, max_bins=32)
+    r1 = dryad.cv(p, ds, **kw)
+    r2 = dryad.cv(p, ds, **kw)
+    assert len(r1["boosters"]) == 3
+    np.testing.assert_array_equal(r1["valid_auc-mean"], r2["valid_auc-mean"])
+
+
+def test_cv_early_stopping_truncates_to_shortest(data):
+    X, y, ds = data
+    res = dryad.cv(dict(objective="binary", num_trees=40, num_leaves=7,
+                        max_bins=32, learning_rate=1.5,
+                        early_stopping_rounds=2), ds, nfold=3, seed=2,
+                   backend="cpu", return_boosters=True)
+    shortest = min(len(b.train_state["eval_history"]["valid_auc"])
+                   for b in res["boosters"])
+    assert len(res["valid_auc-mean"]) == shortest
+
+
+def test_cv_rejects_ranking_and_unlabeled():
+    from dryad_tpu.datasets import mslr_like
+
+    X, y, group = mslr_like(num_queries=20, seed=3)
+    ds = dryad.Dataset(X, y, group=group, max_bins=32)
+    with pytest.raises(ValueError, match="ranking"):
+        dryad.cv(dict(objective="lambdarank", num_trees=2), ds)
+    unlabeled = dryad.Dataset.from_binned(ds.X_binned, ds.mapper, None)
+    with pytest.raises(ValueError, match="labels"):
+        dryad.cv(dict(objective="binary", num_trees=2), unlabeled)
