@@ -253,6 +253,117 @@ def tiles_bound(n_rows: int, n_parents: int, T: int = _TILE_ROWS) -> int:
 
 
 # ---------------------------------------------------------------------------
+# layout records + histograms straight from the layout
+# ---------------------------------------------------------------------------
+# Layout record byte format (WB = 128):
+#   [ g f32 (4) | h f32 (4) | valid u8 (1) | X bins u8/u16 (F·itemsize) ]
+# padded with zeros to WB.  The valid flag distinguishes real rows from
+# sentinels without assuming anything about g/h values; zero rows decode
+# to valid=0, g=h=0, bin 0 — inert in every consumer by construction.
+_REC_WB = 128
+
+
+def make_layout_records(Xb: jnp.ndarray, g: jnp.ndarray,
+                        h: jnp.ndarray) -> jnp.ndarray:
+    """(N, _REC_WB) uint8 layout records in natural row order — the
+    root-segment initial layout (pad to tile multiples before use)."""
+    N, F = Xb.shape
+    nbytes = F * Xb.dtype.itemsize
+    assert 9 + nbytes <= _REC_WB, "feature bytes exceed the record"
+    gb = jax.lax.bitcast_convert_type(
+        g.astype(jnp.float32), jnp.uint8).reshape(N, 4)
+    hb = jax.lax.bitcast_convert_type(
+        h.astype(jnp.float32), jnp.uint8).reshape(N, 4)
+    xb = (jax.lax.bitcast_convert_type(Xb, jnp.uint8).reshape(N, nbytes)
+          if Xb.dtype != jnp.uint8 else Xb)
+    flag = jnp.ones((N, 1), jnp.uint8)
+    rec = jnp.concatenate([gb, hb, flag, xb], axis=1)
+    return jnp.pad(rec, ((0, 0), (0, _REC_WB - rec.shape[1])))
+
+
+def unpack_layout_records(rec: jnp.ndarray, num_features: int,
+                          bin_dtype) -> tuple:
+    """(g, h, valid, X_rows) views of a layout record buffer."""
+    n = rec.shape[0]
+    g = jax.lax.bitcast_convert_type(
+        rec[:, 0:4].reshape(n, 1, 4), jnp.float32)[:, 0]
+    h = jax.lax.bitcast_convert_type(
+        rec[:, 4:8].reshape(n, 1, 4), jnp.float32)[:, 0]
+    valid = rec[:, 8] == 1
+    itemsize = jnp.dtype(bin_dtype).itemsize
+    xb = rec[:, 9:9 + num_features * itemsize]
+    if itemsize != 1:
+        xb = jax.lax.bitcast_convert_type(
+            xb.reshape(n, num_features, itemsize), bin_dtype)
+    return g, h, valid, xb
+
+
+def hist_from_layout(rec: jnp.ndarray, seg_first: jnp.ndarray,
+                     seg_ntiles: jnp.ndarray, num_cols: int,
+                     total_bins: int, num_features: int, bin_dtype,
+                     n_sel_tiles: int, *,
+                     platform: str | None = None) -> jnp.ndarray:
+    """(P, 3, F, B) histograms for P selected segments of a leaf-ordered
+    layout — NO sort, NO per-row gather: each segment is a CONTIGUOUS
+    tile run, so the only data movement is a tile-granular gather
+    (~_TILE_ROWS·_REC_WB = 64 KB per access — bandwidth-bound, unlike
+    the per-access-bound row gather it replaces).
+
+    seg_first/seg_ntiles (P,) int32: each selected segment's first tile
+    and tile count in ``rec``.  ``n_sel_tiles`` MUST bound
+    ``sum(max(seg_ntiles, 1))`` — every selection reserves at least one
+    plan slot (an empty selection's mandatory slot zero-initializes its
+    output block, tile_plan contract), so a bound on the raw tile sum
+    alone would shift later segments past the end and silently truncate
+    their histograms (caught in review; test-pinned).
+
+    Parity note (test_hist_from_layout_bitwise_vs_plan): on a PAD-FREE
+    layout (contiguous per-segment rows — the per-tree initial layout)
+    this is BITWISE equal to the tile-plan path.  Post-permute layouts
+    carry _ALIGN interior sentinels that shift rows across tile
+    boundaries, regrouping the kernel's per-tile partial sums — an
+    ulp-class difference (the chunked-vs-dispatch tolerance class in
+    CLAUDE.md), so a wired grower must use ONE histogram path per config,
+    never mix them mid-tree."""
+    from dryad_tpu.engine import pallas_hist
+
+    T = _TILE_ROWS
+    P = int(num_cols)
+    n_tiles_in = rec.shape[0] // T
+    # dense plan: positions of each segment's tiles in the packed prefix
+    base = jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                            jnp.cumsum(jnp.maximum(seg_ntiles, 1))
+                            .astype(jnp.int32)])
+    idx = jnp.arange(n_sel_tiles, dtype=jnp.int32)
+    tile_leaf = jnp.searchsorted(base[1:], idx, side="right").astype(
+        jnp.int32)
+    lc = jnp.minimum(tile_leaf, P - 1)
+    off = idx - base[lc]
+    live = (tile_leaf < P) & (off < seg_ntiles[lc])
+    src = jnp.where(live, seg_first[lc] + off, 0)
+    src = jnp.clip(src, 0, n_tiles_in - 1)
+    # ONE tile-granular gather of the selected runs
+    sel_rec = rec.reshape(n_tiles_in, T * _REC_WB)[src].reshape(
+        n_sel_tiles * T, _REC_WB)
+    g, h, valid, X_rows = unpack_layout_records(sel_rec, num_features,
+                                                bin_dtype)
+    valid &= jnp.repeat(live, T)
+    Xt = pallas_hist._tiles_from_rows(X_rows, n_sel_tiles, T, total_bins)
+    Wt = pallas_hist._pack_weights(g.reshape(n_sel_tiles, T),
+                                   h.reshape(n_sel_tiles, T),
+                                   valid.reshape(n_sel_tiles, T))
+    tile_first = jnp.concatenate([
+        jnp.ones((1,), jnp.int32),
+        (lc[1:] != lc[:-1]).astype(jnp.int32)])
+    tile_skip = 1 - jnp.any(valid.reshape(n_sel_tiles, T),
+                            axis=1).astype(jnp.int32)
+    return pallas_hist._hist_tiles(
+        Xt, Wt, lc, tile_first, tile_skip, num_cols=P,
+        total_bins=int(total_bins), num_features=int(num_features),
+        platform=platform)
+
+
+# ---------------------------------------------------------------------------
 # numpy reference (the bitwise oracle for tests)
 # ---------------------------------------------------------------------------
 

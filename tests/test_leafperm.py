@@ -117,3 +117,51 @@ def test_alignment_of_all_writes():
         jnp.asarray(tile_slot), jnp.asarray(side), 3)
     assert (np.asarray(dstl) % leafperm._ALIGN == 0).all()
     assert (np.asarray(dstr) % leafperm._ALIGN == 0).all()
+
+
+def test_hist_from_layout_bitwise_vs_plan():
+    """Histograms straight from a leaf-ordered layout (contiguous tile
+    runs, no sort/row-gather) are BITWISE equal to the tile-plan path on
+    the same selection — the integration's parity anchor."""
+    from dryad_tpu.engine.histogram import build_hist_segmented
+
+    rng = np.random.default_rng(21)
+    N, F, B, S = 6000, 12, 64, 4
+    Xb = rng.integers(1, B, size=(N, F), dtype=np.uint8)
+    g = rng.normal(size=N).astype(np.float32)
+    h = rng.uniform(0.1, 1, N).astype(np.float32)
+    seg_of = rng.integers(0, S, N).astype(np.int32)   # 4 segments
+
+    rec_nat = np.asarray(leafperm.make_layout_records(
+        jnp.asarray(Xb), jnp.asarray(g), jnp.asarray(h)))
+    # build the layout: rows grouped by segment in ORIGINAL row order
+    # (the plan path's stable sort produces the same per-slot order)
+    lt = np.maximum(-(-np.bincount(seg_of, minlength=S) // T), 1)
+    base = np.concatenate([[0], np.cumsum(lt)])
+    rec = np.zeros(((base[-1]) * T, leafperm._REC_WB), np.uint8)
+    fill = np.zeros(S, np.int64)
+    for r in range(N):
+        s = seg_of[r]
+        rec[base[s] * T + fill[s]] = rec_nat[r]
+        fill[s] += 1
+
+    # select segments 2 and 0 out of order, PLUS a genuinely EMPTY
+    # selection in the middle (its mandatory slot must zero-init its
+    # output block and must NOT shift segment 0's tiles past the bound —
+    # the review-caught truncation bug)
+    sel_segs = [2, None, 0]
+    seg_first = jnp.asarray(
+        [int(base[s]) if s is not None else 0 for s in sel_segs], jnp.int32)
+    seg_nt = jnp.asarray(
+        [int(lt[s]) if s is not None else 0 for s in sel_segs], jnp.int32)
+    bound = int(np.maximum(np.asarray(seg_nt), 1).sum())  # documented bound
+    got = np.asarray(leafperm.hist_from_layout(
+        jnp.asarray(rec), seg_first, seg_nt, 3, B, F, np.uint8, bound))
+
+    colof = {2: 0, 0: 2}
+    sel = np.asarray([colof.get(int(s), 3) for s in seg_of], np.int32)
+    want = np.asarray(build_hist_segmented(
+        jnp.asarray(Xb), jnp.asarray(g), jnp.asarray(h),
+        jnp.asarray(sel), 3, B, backend="pallas"))
+    np.testing.assert_array_equal(got, want)
+    assert not got[1].any()                       # empty slot zero-inited
