@@ -6,6 +6,9 @@
         [--log-jsonl metrics.jsonl] [--backend auto|tpu|cpu] [--quiet]
     python -m dryad_tpu predict --model m.dryad --data X.npy --out preds.npy [--raw]
     python -m dryad_tpu dump    --model m.dryad [--out model.json]
+    python -m dryad_tpu serve   --model m.dryad [--host H --port P] \
+        [--backend auto|tpu|cpu] [--max-batch-rows N --max-wait-ms F] \
+        [--request X.npy --out p.npy]   # one-shot through the full stack
 
 Data formats: ``.npy`` (dense float matrix), ``.npz`` with keys
 ``indptr/indices/values/num_features`` (CSR sparse), or ``.csv``
@@ -138,6 +141,62 @@ def cmd_dump(args) -> int:
     return 0
 
 
+def cmd_serve(args) -> int:
+    from dryad_tpu.serve import PredictServer
+
+    if args.request and not args.out:
+        raise SystemExit("--request requires --out")
+    server = PredictServer(
+        backend=args.backend,
+        max_batch_rows=args.max_batch_rows,
+        max_wait_ms=args.max_wait_ms,
+        queue_size=args.queue_size,
+    )
+    for path in args.model:
+        version = server.load_model(path)
+        if not args.quiet:
+            print(f"loaded {path} -> version {version}")
+
+    if args.request:
+        # one-shot mode: run a single request through the FULL serving
+        # stack (bucketed compiled predict + micro-batcher) and exit —
+        # a smoke/deployment check with no long-lived process
+        X = _load_matrix(args.request)
+        with server:
+            if isinstance(X, tuple) and X[0] == "csr":
+                from dryad_tpu.data.binning import bin_csr
+
+                indptr, indices, values, nf = X[1]
+                entry = server.registry.get()
+                Xb = bin_csr(indptr, indices, values, nf, entry.booster.mapper)
+                preds = server.predict(Xb, raw_score=args.raw, binned=True)
+            else:
+                preds = server.predict(np.asarray(X, np.float32),
+                                       raw_score=args.raw)
+        np.save(args.out, preds)
+        if not args.quiet:
+            print(f"wrote predictions {preds.shape} -> {args.out}")
+            print(json.dumps(server.stats(), indent=1))
+        return 0
+
+    from dryad_tpu.serve.http import make_http_server
+
+    httpd = make_http_server(server, args.host, args.port,
+                             verbose=not args.quiet)
+    host, port = httpd.server_address[:2]
+    print(f"dryad serving on http://{host}:{port}  "
+          f"(backend={server.backend}; POST /predict, GET /stats)")
+    try:
+        httpd.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        httpd.server_close()
+        server.stop()
+        print(json.dumps(server.stats(), indent=1))
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="dryad_tpu")
     sub = ap.add_subparsers(dest="cmd", required=True)
@@ -176,6 +235,26 @@ def main(argv=None) -> int:
                    help="versioned round-trippable text format "
                         "(Booster.load_text)")
     d.set_defaults(fn=cmd_dump)
+
+    s = sub.add_parser("serve", help="online inference service")
+    s.add_argument("--model", required=True, action="append",
+                   help="model path (.dryad binary or text dump); repeat to "
+                        "load several versions — the last one is active")
+    s.add_argument("--backend", default="auto", choices=["auto", "tpu", "cpu"])
+    s.add_argument("--host", default="127.0.0.1")
+    s.add_argument("--port", type=int, default=8000)
+    s.add_argument("--max-batch-rows", type=int, default=4096,
+                   help="micro-batch row cap (also the largest predict bucket)")
+    s.add_argument("--max-wait-ms", type=float, default=2.0,
+                   help="batch coalescing deadline")
+    s.add_argument("--queue-size", type=int, default=256,
+                   help="bounded request queue (backpressure)")
+    s.add_argument("--request", help="one-shot mode: predict this matrix "
+                                     "through the serving stack and exit")
+    s.add_argument("--out", help="one-shot mode: output .npy path")
+    s.add_argument("--raw", action="store_true", help="raw scores (no link)")
+    s.add_argument("--quiet", action="store_true")
+    s.set_defaults(fn=cmd_serve)
 
     args = ap.parse_args(argv)
     return args.fn(args)

@@ -171,6 +171,14 @@ class Booster:
             raw = np.asarray(predict_binned_device(self, X_binned, num_iteration=num_iteration))
         else:
             raise ValueError(f"unknown backend {backend!r}")
+        return self.transform_raw(raw, raw_score=raw_score)
+
+    def transform_raw(self, raw: np.ndarray, *, raw_score: bool = False) -> np.ndarray:
+        """Final output shaping shared by every predict path: (N, K) raw
+        scores → the objective's link transform (or raw), squeezing the
+        single-output column.  The serving layer applies this to slices of
+        a coalesced batch; every transform is per-row, so slice-then-
+        transform is bitwise equal to transform-then-slice."""
         if raw_score:
             return raw if self.num_outputs > 1 else raw[:, 0]
         from dryad_tpu.objectives import get_objective
@@ -427,6 +435,17 @@ class Booster:
     def load_text(cls, path: str) -> "Booster":
         with open(path) as f:
             return cls.from_text(f.read())
+
+    @classmethod
+    def load_any(cls, path: str) -> "Booster":
+        """Load a model from either on-disk format, sniffing the content:
+        the binary ``save`` format is an npz (a zip — magic ``PK``),
+        anything else is parsed as the versioned text dump."""
+        with open(path, "rb") as f:
+            magic = f.read(2)
+        if magic == b"PK":
+            return cls.load(path)
+        return cls.load_text(path)
 
     @classmethod
     def from_bytes(cls, data: bytes) -> "Booster":

@@ -69,14 +69,14 @@ def _accumulate(trees: dict, Xb: jnp.ndarray, init: jnp.ndarray, depth_bound: in
     return score
 
 
-def predict_binned_device(
-    booster, Xb, num_iteration: Optional[int] = None
-):
-    """``dryad.predict`` device backend on pre-binned rows → raw scores
-    (N, K).  Returns a device array — except under ``boosting='rf'``,
-    where the final averaging transform runs on host (see below) and a
-    numpy array comes back; the sole caller (Booster.predict_binned) ends
-    in ``np.asarray`` either way."""
+def stage_trees(booster, num_iteration: Optional[int] = None):
+    """Slice + reshape the tree tables for the device scan: (n_iter, K, M, ...)
+    numpy arrays, the ``num_iteration``/``best_iteration`` semantics of
+    ``predict_binned_cpu``.  Traversal-irrelevant tables (gain, cover) are
+    dropped — they never feed an op, so removing them from the scan carry
+    cannot change a bit of the result.  Shared by the one-shot device
+    predict below and by the serving layer's model registry, which keeps
+    the staged arrays device-resident across requests."""
     K = booster.num_outputs
     if num_iteration is None:
         n_iter = booster.best_iteration if booster.best_iteration > 0 else booster.num_iterations
@@ -85,12 +85,24 @@ def predict_binned_device(
     ta = booster.tree_arrays()
     T = n_iter * K
     trees = {
-        k: jnp.asarray(v[:T]).reshape((n_iter, K) + v.shape[1:])
-        for k, v in ta.items()
+        k: v[:T].reshape((n_iter, K) + v.shape[1:])
+        for k, v in ta.items() if k not in ("gain", "cover")
     }
+    return trees, np.asarray(booster.init_score, np.float32), n_iter
+
+
+def predict_binned_device(
+    booster, Xb, num_iteration: Optional[int] = None
+):
+    """``dryad.predict`` device backend on pre-binned rows → raw scores
+    (N, K).  Returns a device array — except under ``boosting='rf'``,
+    where the final averaging transform runs on host (see below) and a
+    numpy array comes back; the sole caller (Booster.predict_binned) ends
+    in ``np.asarray`` either way."""
+    trees_np, init, n_iter = stage_trees(booster, num_iteration)
+    trees = {k: jnp.asarray(v) for k, v in trees_np.items()}
     Xb = jnp.asarray(Xb)
-    init = jnp.asarray(booster.init_score)
-    raw = _accumulate(trees, Xb, init, max(booster.max_depth_seen, 1))
+    raw = _accumulate(trees, Xb, jnp.asarray(init), max(booster.max_depth_seen, 1))
     if booster.params.boosting == "rf" and n_iter > 0:
         # rf averaging runs ON HOST via the ONE shared transform (device
         # FMA fusion is 1 ulp off — see cpu/predict.rf_average); the

@@ -1,0 +1,34 @@
+"""dryad_tpu.serve — online inference on top of the bitwise-pinned predict.
+
+    from dryad_tpu.serve import PredictServer
+
+    server = PredictServer(backend="auto")      # CPU fallback if no device
+    server.load_model("model.dryad")            # or the text dump
+    preds = server.predict(X_rows)              # == Booster.predict, bitwise
+    server.stats()                              # latency/batching/cache snapshot
+
+Layers (each its own module):
+
+* registry.py — versioned models, hot-swap + rollback, device-resident trees
+* cache.py    — shape-bucketed compiled-predict cache (pow2 row padding)
+* batcher.py  — micro-batching queue: deadline coalescing, backpressure,
+                per-request timeouts
+* metrics.py  — counters + latency reservoir behind ``stats()``
+* server.py   — PredictServer tying the above together
+* http.py     — stdlib HTTP front end (``python -m dryad_tpu serve``)
+* bench.py    — closed-loop concurrency benchmark (scripts/bench_serve.py)
+"""
+
+from dryad_tpu.serve.batcher import (MicroBatcher, Request, ServeOverloaded,
+                                     ServeTimeout)
+from dryad_tpu.serve.bench import run_bench
+from dryad_tpu.serve.cache import CompiledPredictCache, bucket_rows
+from dryad_tpu.serve.metrics import ServeMetrics
+from dryad_tpu.serve.registry import ModelEntry, ModelRegistry
+from dryad_tpu.serve.server import PredictServer
+
+__all__ = [
+    "CompiledPredictCache", "MicroBatcher", "ModelEntry", "ModelRegistry",
+    "PredictServer", "Request", "ServeMetrics", "ServeOverloaded",
+    "ServeTimeout", "bucket_rows", "run_bench",
+]

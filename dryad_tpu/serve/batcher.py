@@ -1,0 +1,191 @@
+"""Micro-batching queue: coalesce concurrent requests into one dispatch.
+
+A single worker thread drains a bounded queue.  The first dequeued
+request opens a batch and starts a max-wait deadline clock; requests
+keep joining until the row cap is reached or the deadline expires, then
+the whole batch goes to the device in one dispatch.  Under load batches
+fill instantly (the deadline never waits); when idle a lone request pays
+at most ``max_wait_ms`` of extra latency.
+
+Backpressure is the bounded queue itself: when it is full, ``submit``
+fails fast with ``ServeOverloaded`` instead of letting latency grow
+without bound.  Each caller may also bound its own wait with a
+per-request timeout (``ServeTimeout``); an abandoned request's result is
+simply dropped when the batch completes.
+
+Results come back bitwise equal to solo predicts: the dispatch function
+slices the coalesced output per request, and every predict stage is
+per-row (see cache.py).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Optional
+
+import numpy as np
+
+
+class ServeOverloaded(RuntimeError):
+    """The request queue is full — shed load upstream."""
+
+
+class ServeTimeout(TimeoutError):
+    """The per-request timeout expired before the batch completed."""
+
+
+class Request:
+    """One submitted predict request; ``rows`` is the pre-binned matrix."""
+
+    __slots__ = ("rows", "version", "raw_score", "event", "result", "error",
+                 "abandoned")
+
+    def __init__(self, rows: np.ndarray, version: Optional[int] = None,
+                 raw_score: bool = False):
+        self.rows = rows
+        self.version = version
+        self.raw_score = raw_score
+        self.event = threading.Event()
+        self.result = None
+        self.error: Optional[BaseException] = None
+        self.abandoned = False
+
+
+_STOP = object()
+
+
+class MicroBatcher:
+    """Bounded-queue request coalescer around a batch dispatch function.
+
+    ``dispatch(batch)`` receives the list of coalesced ``Request``s and
+    returns one result per request, in order.
+    """
+
+    def __init__(self, dispatch, *, max_batch_rows: int = 4096,
+                 max_wait_ms: float = 2.0, queue_size: int = 256,
+                 metrics=None):
+        self._dispatch = dispatch
+        self.max_batch_rows = int(max_batch_rows)
+        self.max_wait_s = float(max_wait_ms) / 1e3
+        self.metrics = metrics
+        self._q: queue.Queue = queue.Queue(maxsize=int(queue_size))
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+
+    # ---- lifecycle ---------------------------------------------------------
+    def start(self) -> None:
+        with self._lock:
+            if self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(
+                    target=self._run, daemon=True, name="dryad-serve-batcher")
+                self._thread.start()
+
+    def stop(self, timeout: float = 5.0) -> None:
+        # keep _thread set until the worker is joined: clearing it first
+        # would let a concurrent submit's start() spawn a SECOND worker
+        # (two dispatchers racing on the cache) while this one drains
+        with self._lock:
+            thread = self._thread
+        if thread is None:
+            return
+        if thread.is_alive():
+            self._q.put(_STOP)
+            thread.join(timeout)
+        with self._lock:
+            if self._thread is thread:
+                self._thread = None
+
+    # ---- request path ------------------------------------------------------
+    def submit(self, request: Request,
+               timeout: Optional[float] = None) -> np.ndarray:
+        """Enqueue, wait for the coalesced dispatch, return this request's
+        slice of the results.  Raises ServeOverloaded / ServeTimeout, or
+        re-raises the dispatch error."""
+        t0 = time.perf_counter()
+        try:
+            self._q.put_nowait(request)
+        except queue.Full:
+            if self.metrics is not None:
+                self.metrics.record_rejected()
+            raise ServeOverloaded(
+                f"request queue full ({self._q.maxsize} waiting)") from None
+        if self.metrics is not None:
+            self.metrics.sample_queue_depth(self._q.qsize())
+        if not request.event.wait(timeout):
+            request.abandoned = True
+            if self.metrics is not None:
+                self.metrics.record_timeout()
+            raise ServeTimeout(f"request timed out after {timeout}s")
+        if request.error is not None:
+            if self.metrics is not None:
+                self.metrics.record_error()
+            raise request.error
+        if self.metrics is not None:
+            self.metrics.record_request(request.rows.shape[0],
+                                        time.perf_counter() - t0)
+        return request.result
+
+    # ---- worker ------------------------------------------------------------
+    def _collect(self, first: Request) -> tuple[list[Request], bool]:
+        """Coalesce until the row cap or the max-wait deadline."""
+        batch, rows = [first], first.rows.shape[0]
+        deadline = time.perf_counter() + self.max_wait_s
+        stopping = False
+        while rows < self.max_batch_rows:
+            remaining = deadline - time.perf_counter()
+            if remaining <= 0:
+                break
+            try:
+                nxt = self._q.get(timeout=remaining)
+            except queue.Empty:
+                break
+            if nxt is _STOP:
+                stopping = True
+                break
+            batch.append(nxt)
+            rows += nxt.rows.shape[0]
+        if self.metrics is not None:
+            self.metrics.record_batch(rows, self.max_batch_rows)
+            self.metrics.sample_queue_depth(self._q.qsize())
+        return batch, stopping
+
+    def _run(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is _STOP:
+                self._drain()
+                return
+            batch, stopping = self._collect(item)
+            try:
+                results = self._dispatch(batch)
+                for req, out in zip(batch, results):
+                    # the dispatch may fail requests individually (e.g. one
+                    # group's model version was unloaded mid-queue) without
+                    # poisoning the rest of the batch
+                    if isinstance(out, BaseException):
+                        req.error = out
+                    else:
+                        req.result = out
+                    req.event.set()
+            except BaseException as e:  # noqa: BLE001 — delivered to callers
+                for req in batch:
+                    req.error = e
+                    req.event.set()
+            if stopping:
+                self._drain()
+                return
+
+    def _drain(self) -> None:
+        """Fail anything enqueued behind the stop sentinel — a caller with
+        no timeout would otherwise wait forever on a dead worker."""
+        while True:
+            try:
+                req = self._q.get_nowait()
+            except queue.Empty:
+                return
+            if req is _STOP:
+                continue
+            req.error = ServeOverloaded("batcher stopped")
+            req.event.set()

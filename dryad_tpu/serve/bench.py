@@ -1,0 +1,97 @@
+"""Closed-loop serving benchmark (the engine behind scripts/bench_serve.py).
+
+``clients`` threads each run a closed loop — submit a request of a
+random size, wait for the answer, repeat — against one PredictServer,
+so concurrency (and therefore batch fill) is controlled exactly.
+
+Warmup touches EVERY bucket the cache can ever produce (cache.buckets()),
+not just the request sizes: coalescing means batch totals land on
+arbitrary buckets up to the row cap, so warming only the request sizes
+would leave cold buckets for the measured phase.  After that structural
+warmup, a warm cache can never compile again — ``recompiles_after_warmup``
+must be 0, and tests/test_serve.py asserts it on a forced-CPU run.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional, Sequence
+
+import numpy as np
+
+from dryad_tpu.booster import Booster
+from dryad_tpu.serve.server import PredictServer
+
+
+def run_bench(model, *, backend: str = "cpu", clients: int = 4,
+              duration_s: float = 2.0, sizes: Sequence[int] = (1, 3, 9, 17, 40),
+              max_batch_rows: int = 256, max_wait_ms: float = 1.0,
+              queue_size: int = 1024, min_bucket: int = 8, seed: int = 0,
+              feature_pool: Optional[np.ndarray] = None,
+              verbose: bool = False) -> dict:
+    """Run the closed loop; returns the stats snapshot plus bench fields
+    (throughput, recompiles_after_warmup).  ``model`` is a Booster or a
+    model path (binary or text)."""
+    booster = model if isinstance(model, Booster) else Booster.load_any(model)
+    server = PredictServer(backend=backend, max_batch_rows=max_batch_rows,
+                           max_wait_ms=max_wait_ms, queue_size=queue_size,
+                           min_bucket=min_bucket)
+    server.registry.add(booster)
+    rng = np.random.default_rng(seed)
+    if feature_pool is None:
+        feature_pool = rng.standard_normal(
+            (max(int(max_batch_rows), 512), booster.mapper.num_features)
+        ).astype(np.float32)
+    pool_n = feature_pool.shape[0]
+    sizes = [int(s) for s in sizes if 0 < int(s) <= pool_n]
+
+    with server:
+        # ---- structural warmup: one request per possible bucket ------------
+        for b in server.cache.buckets():
+            server.predict(feature_pool[:min(b, pool_n)])
+        warm = server.stats()
+        compiles_at_warmup = warm["cache_compiles"]
+        if verbose:
+            print(f"warmed {warm['compiled_buckets']} buckets "
+                  f"({compiles_at_warmup} compiles)")
+
+        # ---- measured closed loop ------------------------------------------
+        counts = [0] * clients
+        row_counts = [0] * clients
+        barrier = threading.Barrier(clients + 1)
+        # the deadline must be set BEFORE the barrier releases anyone, or a
+        # fast client could read it unset and exit with zero requests
+        stop_at = [float("inf")]
+
+        def client(ci: int) -> None:
+            crng = np.random.default_rng(seed + 1000 + ci)
+            barrier.wait()
+            while time.perf_counter() < stop_at[0]:
+                n = int(crng.choice(sizes))
+                start = int(crng.integers(0, pool_n - n + 1))
+                server.predict(feature_pool[start:start + n])
+                counts[ci] += 1
+                row_counts[ci] += n
+
+        threads = [threading.Thread(target=client, args=(ci,), daemon=True)
+                   for ci in range(clients)]
+        for t in threads:
+            t.start()
+        stop_at[0] = time.perf_counter() + float(duration_s)
+        barrier.wait()
+        t0 = time.perf_counter()
+        for t in threads:
+            t.join()
+        elapsed = time.perf_counter() - t0
+        snap = server.stats()
+
+    snap["bench_clients"] = clients
+    snap["bench_elapsed_s"] = elapsed
+    snap["bench_requests"] = sum(counts)
+    snap["bench_rows"] = sum(row_counts)
+    snap["requests_per_s"] = sum(counts) / elapsed if elapsed > 0 else 0.0
+    snap["rows_per_s"] = sum(row_counts) / elapsed if elapsed > 0 else 0.0
+    snap["recompiles_after_warmup"] = (snap["cache_compiles"]
+                                       - compiles_at_warmup)
+    return snap

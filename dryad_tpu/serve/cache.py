@@ -1,0 +1,144 @@
+"""Shape-bucketed compiled-predict cache.
+
+jit specializes on array shapes, so every distinct request size would
+compile (and through a remote-TPU tunnel, compile *slowly*).  Instead,
+batches are padded up to the next power-of-two row bucket and predicted
+at the bucket shape; warm traffic then touches a small fixed set of
+programs — at most log2(max_bucket / min_bucket) + 1 per model version —
+and never recompiles.  Batches larger than ``max_bucket`` are predicted
+in ``max_bucket``-row chunks.
+
+Bitwise contract: padding rows (bin 0 everywhere) and chunking cannot
+change the real rows' scores.  Tree traversal and fp32 leaf accumulation
+are strictly per-row (one scan carry element per row, no cross-row
+reduction anywhere in predict), so a padded program computes exactly the
+same per-row arithmetic as an unpadded one — the parity is structural,
+not approximate, and tests/test_serve.py pins it across bucket
+boundaries.
+
+The cache also serves the no-device fallback: with ``backend='cpu'`` the
+per-bucket entry wraps the canonical numpy predict instead of a jitted
+program.  Bucketing is kept there too so batching behavior, metrics, and
+the warmup discipline are identical on both backends.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+
+def bucket_rows(n: int, min_bucket: int = 8,
+                max_bucket: Optional[int] = None) -> int:
+    """Smallest power of two >= n, floored at min_bucket, capped at
+    max_bucket (itself rounded up to a power of two by the server)."""
+    if n < 1:
+        raise ValueError("bucket_rows needs n >= 1")
+    b = max(int(min_bucket), 1 << (int(n) - 1).bit_length())
+    if max_bucket is not None:
+        b = min(b, int(max_bucket))
+    return b
+
+
+class CompiledPredictCache:
+    """(version, bucket) → prepared predict callable, with hit/compile
+    accounting.  ``backend`` is 'jax' (device-resident jitted accumulate)
+    or 'cpu' (canonical numpy predict)."""
+
+    def __init__(self, backend: str = "cpu", metrics=None, *,
+                 min_bucket: int = 8, max_bucket: int = 4096):
+        if backend not in ("jax", "cpu"):
+            raise ValueError(f"unknown cache backend {backend!r}")
+        self.backend = backend
+        self.metrics = metrics
+        self.min_bucket = int(min_bucket)
+        # cap must be a power of two so chunk remainders re-bucket cleanly
+        self.max_bucket = 1 << (int(max_bucket) - 1).bit_length()
+        # one prepared callable per VERSION (the callable is shape-
+        # agnostic; on the jax path the per-shape specialization lives in
+        # jit's own cache) + per-(version, bucket) warmth accounting: the
+        # first call at a bucket shape is what triggers an XLA compile
+        self._fns: dict[int, object] = {}
+        self._warm: set[tuple[int, int]] = set()
+
+    @property
+    def num_entries(self) -> int:
+        """Warm (version, bucket) pairs — compiled shapes, not closures."""
+        return len(self._warm)
+
+    def buckets(self) -> list[int]:
+        """Every bucket size this cache can ever produce — the warmup set."""
+        out, b = [], self.min_bucket
+        while b <= self.max_bucket:
+            out.append(b)
+            b <<= 1
+        return out
+
+    # ---- prediction --------------------------------------------------------
+    def predict_raw(self, entry, Xb: np.ndarray) -> np.ndarray:
+        """Raw scores (n, K) fp32 for pre-binned rows, through the bucketed
+        compiled program; bitwise equal to the direct unpadded predict."""
+        n = int(Xb.shape[0])
+        K = entry.num_outputs
+        if n == 0:
+            return np.zeros((0, K), np.float32)
+        out = np.empty((n, K), np.float32)
+        for start in range(0, n, self.max_bucket):
+            chunk = Xb[start:start + self.max_bucket]
+            m = int(chunk.shape[0])
+            b = bucket_rows(m, self.min_bucket, self.max_bucket)
+            fn = self._get(entry, b)
+            if m < b:
+                pad = np.zeros((b - m,) + chunk.shape[1:], chunk.dtype)
+                chunk = np.concatenate([np.ascontiguousarray(chunk), pad])
+            out[start:start + m] = fn(chunk)[:m]
+        return out
+
+    # ---- entry construction ------------------------------------------------
+    def _get(self, entry, bucket: int):
+        key = (entry.version, bucket)
+        hit = key in self._warm
+        if not hit:
+            self._warm.add(key)
+        if self.metrics is not None:
+            self.metrics.record_cache(hit)
+        fn = self._fns.get(entry.version)
+        if fn is None:
+            fn = (self._build_jax(entry) if self.backend == "jax"
+                  else self._build_cpu(entry))
+            self._fns[entry.version] = fn
+        return fn
+
+    def _build_cpu(self, entry):
+        from dryad_tpu.cpu.predict import predict_binned_cpu
+
+        booster, num_iteration = entry.booster, entry.num_iteration
+
+        def fn(Xp):
+            return predict_binned_cpu(booster, Xp, num_iteration=num_iteration)
+
+        return fn
+
+    def _build_jax(self, entry):
+        import jax.numpy as jnp
+
+        from dryad_tpu.cpu.predict import rf_average
+        from dryad_tpu.engine.predict import _accumulate
+
+        trees_dev, init_dev = entry.device_state()
+        _, _, n_iter = entry.staged()
+        booster = entry.booster
+        depth = max(booster.max_depth_seen, 1)
+        is_rf = booster.params.boosting == "rf" and n_iter > 0
+
+        def fn(Xp):
+            # trees/init are device-resident arguments; jit specializes on
+            # the (bucket, F) shape of Xp — one XLA program per bucket
+            raw = np.asarray(_accumulate(trees_dev, jnp.asarray(Xp),
+                                         init_dev, depth))
+            if is_rf:
+                raw = rf_average(raw, booster.init_score, n_iter)
+            return raw
+
+        return fn

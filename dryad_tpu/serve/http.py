@@ -1,0 +1,114 @@
+"""Stdlib HTTP front end for PredictServer (no extra dependencies).
+
+Endpoints (JSON in/out):
+
+    POST /predict            {"rows": [[...], ...], "raw": false,
+                              "version": null, "binned": false}
+                             → {"predictions": [...], "version": v}
+    GET  /stats              → PredictServer.stats() snapshot
+    GET  /models             → {"active": v, "versions": [...]}
+    POST /models/load        {"path": "...", "activate": true} → {"version": v}
+    POST /models/activate    {"version": v}
+    POST /models/rollback    → {"version": v}
+
+This is an operational front door, not a wire-speed RPC layer: requests
+ride the same micro-batcher as in-process callers (ThreadingHTTPServer
+gives one thread per connection, so concurrent POSTs coalesce into one
+device dispatch), and numbers round-trip through JSON.  Bitwise-exact
+transport belongs to the in-process API / npy files.
+"""
+
+from __future__ import annotations
+
+import json
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+from dryad_tpu.serve.batcher import ServeOverloaded, ServeTimeout
+
+
+class _Handler(BaseHTTPRequestHandler):
+    # the PredictServer rides on the HTTP server object (see make_http_server)
+    def _send(self, code: int, payload: dict) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _read_json(self) -> dict:
+        length = int(self.headers.get("Content-Length") or 0)
+        if length == 0:
+            return {}
+        return json.loads(self.rfile.read(length).decode())
+
+    def log_message(self, fmt, *args):  # quiet by default
+        if getattr(self.server, "verbose", False):
+            super().log_message(fmt, *args)
+
+    def do_GET(self):  # noqa: N802 — stdlib handler API
+        server = self.server.predict_server
+        if self.path == "/stats":
+            self._send(200, server.stats())
+        elif self.path == "/models":
+            self._send(200, {"active": server.registry.active_version,
+                             "versions": server.registry.versions()})
+        else:
+            self._send(404, {"error": f"unknown path {self.path}"})
+
+    def do_POST(self):  # noqa: N802 — stdlib handler API
+        server = self.server.predict_server
+        try:
+            body = self._read_json()
+            if self.path == "/predict":
+                # resolve the entry up front: pre-binned rows must arrive in
+                # the model's bin dtype (not float), and the response must
+                # name the version that actually served — not whatever is
+                # active by the time the batch returns
+                entry = server.registry.get(body.get("version"))
+                binned = bool(body.get("binned", False))
+                rows = np.asarray(body["rows"],
+                                  entry.booster.mapper.bin_dtype if binned
+                                  else np.float32)
+                preds = server.predict(
+                    rows,
+                    version=entry.version,
+                    raw_score=bool(body.get("raw", False)),
+                    binned=binned,
+                    timeout=body.get("timeout"),
+                )
+                self._send(200, {"predictions": np.asarray(preds).tolist(),
+                                 "version": entry.version})
+            elif self.path == "/models/load":
+                version = server.load_model(
+                    body["path"], activate=bool(body.get("activate", True)))
+                self._send(200, {"version": version})
+            elif self.path == "/models/activate":
+                server.activate(int(body["version"]))
+                self._send(200, {"version": int(body["version"])})
+            elif self.path == "/models/rollback":
+                self._send(200, {"version": server.rollback()})
+            else:
+                self._send(404, {"error": f"unknown path {self.path}"})
+        except ServeOverloaded as e:
+            self._send(503, {"error": str(e)})
+        except ServeTimeout as e:
+            self._send(504, {"error": str(e)})
+        except (KeyError, LookupError, ValueError) as e:
+            self._send(400, {"error": repr(e)})
+        except Exception as e:  # noqa: BLE001 — surface, don't kill the server
+            self._send(500, {"error": repr(e)})
+
+
+def make_http_server(predict_server, host: str = "127.0.0.1",
+                     port: int = 8000, *,
+                     verbose: bool = False) -> ThreadingHTTPServer:
+    """Bind (port 0 picks a free one: ``httpd.server_address``); caller
+    runs ``serve_forever()`` / ``shutdown()``."""
+    httpd = ThreadingHTTPServer((host, port), _Handler)
+    httpd.predict_server = predict_server
+    httpd.verbose = verbose
+    predict_server.start()
+    return httpd

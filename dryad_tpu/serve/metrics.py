@@ -1,0 +1,102 @@
+"""Serving metrics: thread-safe counters + a bounded latency reservoir.
+
+One ``ServeMetrics`` instance is shared by the server, the micro-batcher,
+and the compiled-predict cache; ``snapshot()`` is the stats API the CLI
+and the HTTP ``/stats`` endpoint expose.  Latency percentiles come from a
+fixed-size reservoir of the most recent request latencies (a deque, not a
+histogram) — exact over the window, O(window) only at snapshot time, and
+free of bucket-boundary error at the tails we care about (p99).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+
+
+class ServeMetrics:
+    def __init__(self, latency_window: int = 4096):
+        self._lock = threading.Lock()
+        self._latencies = deque(maxlen=int(latency_window))
+        self.requests = 0          # completed requests (incl. empty)
+        self.rows = 0              # rows predicted across completed requests
+        self.batches = 0           # device dispatches by the micro-batcher
+        self.batch_rows = 0        # rows across those dispatches
+        self.batch_capacity = 0    # Σ max_batch_rows across dispatches
+        self.cache_hits = 0        # bucket already compiled/prepared
+        self.cache_compiles = 0    # new (version, bucket) entries built
+        self.timeouts = 0          # requests that gave up waiting
+        self.rejected = 0          # requests refused by the bounded queue
+        self.errors = 0            # requests that raised in dispatch
+        self.queue_depth = 0       # last sampled queue depth
+        self.queue_depth_peak = 0
+
+    # ---- recording ---------------------------------------------------------
+    def record_request(self, n_rows: int, latency_s: float) -> None:
+        with self._lock:
+            self.requests += 1
+            self.rows += int(n_rows)
+            self._latencies.append(float(latency_s))
+
+    def record_batch(self, rows: int, capacity: int) -> None:
+        with self._lock:
+            self.batches += 1
+            self.batch_rows += int(rows)
+            self.batch_capacity += int(capacity)
+
+    def record_cache(self, hit: bool) -> None:
+        with self._lock:
+            if hit:
+                self.cache_hits += 1
+            else:
+                self.cache_compiles += 1
+
+    def record_timeout(self) -> None:
+        with self._lock:
+            self.timeouts += 1
+
+    def record_rejected(self) -> None:
+        with self._lock:
+            self.rejected += 1
+
+    def record_error(self) -> None:
+        with self._lock:
+            self.errors += 1
+
+    def sample_queue_depth(self, depth: int) -> None:
+        with self._lock:
+            self.queue_depth = int(depth)
+            self.queue_depth_peak = max(self.queue_depth_peak, int(depth))
+
+    # ---- snapshot ----------------------------------------------------------
+    def snapshot(self) -> dict:
+        """One consistent dict of everything — counters plus derived rates.
+        Latency keys are milliseconds."""
+        with self._lock:
+            lat = sorted(self._latencies)
+
+            def pct(p: float) -> float:
+                if not lat:
+                    return 0.0
+                # nearest-rank on the reservoir
+                idx = min(len(lat) - 1, max(0, int(round(p * (len(lat) - 1)))))
+                return lat[idx] * 1e3
+
+            return {
+                "requests": self.requests,
+                "rows": self.rows,
+                "batches": self.batches,
+                "batch_rows": self.batch_rows,
+                "batch_fill_ratio": (self.batch_rows / self.batch_capacity
+                                     if self.batch_capacity else 0.0),
+                "p50_ms": pct(0.50),
+                "p99_ms": pct(0.99),
+                "mean_ms": (sum(lat) / len(lat) * 1e3 if lat else 0.0),
+                "cache_hits": self.cache_hits,
+                "cache_compiles": self.cache_compiles,
+                "timeouts": self.timeouts,
+                "rejected": self.rejected,
+                "errors": self.errors,
+                "queue_depth": self.queue_depth,
+                "queue_depth_peak": self.queue_depth_peak,
+            }

@@ -1,0 +1,158 @@
+"""Model registry: versioned boosters with hot-swap and rollback.
+
+Every loaded model gets a monotonically increasing integer version.  One
+version is *active* (the default for requests that don't pin a version);
+``activate`` hot-swaps it and records the previous active version on a
+history stack so ``rollback`` is one call.  In-flight requests resolve
+their version at submit time, so a swap never changes a request that is
+already queued.
+
+An entry lazily stages its tree tables for the device predict path
+(``engine.predict.stage_trees``) and keeps them device-resident across
+requests — the staged arrays are uploaded once per (version, process),
+then passed as *arguments* to the jitted accumulate (never closed over:
+remote compile rejects large jit constants, see CLAUDE.md).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from dryad_tpu.booster import Booster
+
+
+class ModelEntry:
+    """A registered model plus its lazily staged predict state."""
+
+    def __init__(self, version: int, booster: Booster, path: Optional[str] = None,
+                 num_iteration: Optional[int] = None):
+        self.version = int(version)
+        self.booster = booster
+        self.path = path
+        self.num_iteration = num_iteration
+        self._lock = threading.Lock()
+        self._staged = None      # (trees_np, init_np, n_iter)
+        self._device = None      # (trees_dev, init_dev)
+
+    @property
+    def num_outputs(self) -> int:
+        return self.booster.num_outputs
+
+    def staged(self):
+        """(trees, init, n_iter) reshaped numpy tables, built once."""
+        with self._lock:
+            if self._staged is None:
+                from dryad_tpu.engine.predict import stage_trees
+
+                self._staged = stage_trees(self.booster, self.num_iteration)
+            return self._staged
+
+    def device_state(self):
+        """Device-resident (trees, init) for the jit predict path; uploaded
+        once and reused by every bucket's compiled program."""
+        trees_np, init_np, _ = self.staged()
+        with self._lock:
+            if self._device is None:
+                import jax
+
+                self._device = (
+                    {k: jax.device_put(v) for k, v in trees_np.items()},
+                    jax.device_put(init_np),
+                )
+            return self._device
+
+
+class ModelRegistry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._models: dict[int, ModelEntry] = {}
+        self._active: Optional[int] = None
+        self._history: list[int] = []   # previously active versions (for rollback)
+        self._next_version = 1
+
+    # ---- loading -----------------------------------------------------------
+    def load(self, path: str, *, activate: bool = True,
+             num_iteration: Optional[int] = None) -> int:
+        """Register a model from disk — binary checkpoint or text dump,
+        sniffed by content (Booster.load_any).  Returns its version."""
+        return self.add(Booster.load_any(path), path=path, activate=activate,
+                        num_iteration=num_iteration)
+
+    def load_latest_checkpoint(self, directory: str, *, activate: bool = True,
+                               num_iteration: Optional[int] = None) -> int:
+        """Register the newest checkpoint a ``Checkpointer`` left in
+        ``directory`` (serving straight off a training run's snapshots)."""
+        from dryad_tpu.checkpoint import Checkpointer
+
+        latest = Checkpointer(directory).latest()
+        if latest is None:
+            raise FileNotFoundError(f"no checkpoints in {directory!r}")
+        booster, it = latest
+        return self.add(booster, path=f"{directory}@{it}", activate=activate,
+                        num_iteration=num_iteration)
+
+    def add(self, booster: Booster, *, path: Optional[str] = None,
+            activate: bool = True, num_iteration: Optional[int] = None) -> int:
+        with self._lock:
+            version = self._next_version
+            self._next_version += 1
+            self._models[version] = ModelEntry(version, booster, path,
+                                               num_iteration)
+            if activate or self._active is None:
+                if self._active is not None:
+                    self._history.append(self._active)
+                self._active = version
+            return version
+
+    # ---- lifecycle ---------------------------------------------------------
+    def activate(self, version: int) -> None:
+        """Hot-swap the active version (must already be loaded)."""
+        with self._lock:
+            version = int(version)
+            if version not in self._models:
+                raise KeyError(f"model version {version} is not loaded")
+            if version == self._active:
+                return
+            if self._active is not None:
+                self._history.append(self._active)
+            self._active = version
+
+    def rollback(self) -> int:
+        """Re-activate the previously active version; returns it."""
+        with self._lock:
+            while self._history:
+                prev = self._history.pop()
+                if prev in self._models:      # skip versions unloaded since
+                    self._active = prev
+                    return prev
+            raise LookupError("no previous version to roll back to")
+
+    def unload(self, version: int) -> None:
+        with self._lock:
+            version = int(version)
+            if version == self._active:
+                raise ValueError("cannot unload the active version; "
+                                 "activate or rollback first")
+            self._models.pop(version, None)
+
+    # ---- lookup ------------------------------------------------------------
+    def get(self, version: Optional[int] = None) -> ModelEntry:
+        with self._lock:
+            if version is None:
+                version = self._active
+            if version is None:
+                raise LookupError("registry has no models loaded")
+            entry = self._models.get(int(version))
+            if entry is None:
+                raise KeyError(f"model version {version} is not loaded")
+            return entry
+
+    @property
+    def active_version(self) -> Optional[int]:
+        with self._lock:
+            return self._active
+
+    def versions(self) -> list[int]:
+        with self._lock:
+            return sorted(self._models)

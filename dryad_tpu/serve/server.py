@@ -1,0 +1,158 @@
+"""PredictServer: the long-lived online-inference front object.
+
+Composes the registry (versioned, hot-swappable, device-resident
+models), the shape-bucketed compiled-predict cache, and the
+micro-batching queue behind one thread-safe ``predict`` call, with a
+``stats()`` snapshot for observability.  ``python -m dryad_tpu serve``
+wraps this in an HTTP front end (serve/http.py).
+
+Backend resolution ('auto') prefers the device path when an accelerator
+is attached and falls back gracefully to the canonical numpy predict
+when no device can be initialized — the serving semantics (bucketing,
+batching, metrics, bitwise parity with ``Booster.predict``) are
+identical on both paths.
+"""
+
+from __future__ import annotations
+
+import time
+import warnings
+from typing import Optional
+
+import numpy as np
+
+from dryad_tpu.serve.batcher import MicroBatcher, Request
+from dryad_tpu.serve.cache import CompiledPredictCache
+from dryad_tpu.serve.metrics import ServeMetrics
+from dryad_tpu.serve.registry import ModelRegistry
+
+
+def _resolve_backend(backend: str) -> str:
+    """'auto'|'tpu'|'cpu' → 'jax' (device predict) or 'cpu' (numpy).
+
+    'tpu' runs the jit path on whatever platform jax initializes (the
+    test mesh is 8 virtual CPU devices); 'auto' takes the jit path only
+    when a real accelerator is attached.  Device-init failure degrades to
+    the numpy path with a warning instead of killing the server.
+    """
+    if backend == "cpu":
+        return "cpu"
+    if backend not in ("auto", "tpu"):
+        raise ValueError(f"unknown backend {backend!r}")
+    try:
+        import jax
+
+        devices = jax.devices()
+    except Exception as e:  # noqa: BLE001 — any device-init failure degrades
+        warnings.warn(f"device init failed ({e!r}); serving on CPU")
+        return "cpu"
+    if backend == "tpu":
+        return "jax"
+    return "jax" if any(d.platform != "cpu" for d in devices) else "cpu"
+
+
+class PredictServer:
+    def __init__(self, registry: Optional[ModelRegistry] = None, *,
+                 backend: str = "auto", max_batch_rows: int = 4096,
+                 max_wait_ms: float = 2.0, queue_size: int = 256,
+                 min_bucket: int = 8, latency_window: int = 4096):
+        self.registry = registry if registry is not None else ModelRegistry()
+        self.backend = _resolve_backend(backend)
+        self.metrics = ServeMetrics(latency_window=latency_window)
+        self.cache = CompiledPredictCache(
+            self.backend, self.metrics,
+            min_bucket=min_bucket, max_bucket=max_batch_rows)
+        self.batcher = MicroBatcher(
+            self._dispatch, max_batch_rows=max_batch_rows,
+            max_wait_ms=max_wait_ms, queue_size=queue_size,
+            metrics=self.metrics)
+
+    # ---- lifecycle ---------------------------------------------------------
+    def start(self) -> "PredictServer":
+        self.batcher.start()
+        return self
+
+    def stop(self) -> None:
+        self.batcher.stop()
+
+    def __enter__(self) -> "PredictServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # ---- model lifecycle (thin registry passthroughs) ----------------------
+    def load_model(self, path: str, *, activate: bool = True,
+                   num_iteration: Optional[int] = None) -> int:
+        return self.registry.load(path, activate=activate,
+                                  num_iteration=num_iteration)
+
+    def activate(self, version: int) -> None:
+        self.registry.activate(version)
+
+    def rollback(self) -> int:
+        return self.registry.rollback()
+
+    # ---- request path ------------------------------------------------------
+    def predict(self, X: np.ndarray, *, version: Optional[int] = None,
+                raw_score: bool = False, binned: bool = False,
+                timeout: Optional[float] = None) -> np.ndarray:
+        """Predict through the full serving stack (bin → bucket → batch →
+        compiled predict → link transform); bitwise equal to the direct
+        ``Booster.predict`` / ``predict_binned`` on the same rows."""
+        self.start()
+        entry = self.registry.get(version)   # pin the version at submit time
+        X = np.asarray(X)
+        if X.ndim == 1:
+            X = X[None, :]
+        if binned:
+            Xb = np.ascontiguousarray(X)
+        else:
+            Xb = entry.booster.mapper.transform(np.asarray(X, np.float32))
+        if Xb.shape[0] == 0:
+            # empty request: no dispatch, same output shape/dtype contract
+            t0 = time.perf_counter()
+            raw = np.zeros((0, entry.num_outputs), np.float32)
+            out = entry.booster.transform_raw(raw, raw_score=raw_score)
+            self.metrics.record_request(0, time.perf_counter() - t0)
+            return out
+        req = Request(Xb, version=entry.version, raw_score=raw_score)
+        return self.batcher.submit(req, timeout=timeout)
+
+    def _dispatch(self, batch: list[Request]) -> list[np.ndarray]:
+        """Coalesced batch → per-request outputs.  Requests are grouped by
+        model version (a hot-swap mid-queue may interleave versions); each
+        group is one concatenated bucketed predict, sliced back per
+        request.  Per-row arithmetic makes the slicing bitwise-exact."""
+        results: list = [None] * len(batch)
+        groups: dict[int, list[int]] = {}
+        for i, req in enumerate(batch):
+            groups.setdefault(req.version, []).append(i)
+        for version, idxs in groups.items():
+            try:
+                entry = self.registry.get(version)
+                if len(idxs) == 1:
+                    X = batch[idxs[0]].rows
+                else:
+                    X = np.concatenate([batch[i].rows for i in idxs], axis=0)
+                raw = self.cache.predict_raw(entry, X)
+                offset = 0
+                for i in idxs:
+                    n = batch[i].rows.shape[0]
+                    results[i] = entry.booster.transform_raw(
+                        raw[offset:offset + n], raw_score=batch[i].raw_score)
+                    offset += n
+            except Exception as e:  # noqa: BLE001 — e.g. a version unloaded
+                # mid-queue; fail only this group's requests, not the batch
+                for i in idxs:
+                    results[i] = e
+        return results
+
+    # ---- observability -----------------------------------------------------
+    def stats(self) -> dict:
+        snap = self.metrics.snapshot()
+        snap["backend"] = self.backend
+        snap["active_version"] = self.registry.active_version
+        snap["versions"] = self.registry.versions()
+        snap["compiled_buckets"] = self.cache.num_entries
+        return snap

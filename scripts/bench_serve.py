@@ -1,0 +1,66 @@
+"""Closed-loop serving benchmark CLI (engine: dryad_tpu/serve/bench.py).
+
+    PYTHONPATH=/root/.axon_site:/root/repo python scripts/bench_serve.py \
+        [--model m.dryad] [--backend auto|tpu|cpu] [--clients 8] \
+        [--duration 5] [--max-batch-rows 256] [--max-wait-ms 1.0] \
+        [--sizes 1,3,9,17,40] [--json report.json]
+
+Without --model it trains a small throwaway booster first.  Acceptance
+gate: a forced-CPU run must report ``recompiles_after_warmup: 0`` — the
+shape-bucketed cache makes warm traffic structurally recompile-free
+(bench.py warms every reachable bucket before measuring).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _train_throwaway(n_rows: int = 4000):
+    import dryad_tpu as dryad
+    from dryad_tpu.datasets import higgs_like
+
+    X, y = higgs_like(n_rows, seed=11)
+    ds = dryad.Dataset(X, y, max_bins=64)
+    return dryad.train(dict(objective="binary", num_trees=50, num_leaves=31,
+                            max_bins=64), ds, backend="cpu")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="bench_serve")
+    ap.add_argument("--model", help="model path; trains a throwaway if absent")
+    ap.add_argument("--backend", default="cpu",
+                    choices=["auto", "tpu", "cpu"])
+    ap.add_argument("--clients", type=int, default=8)
+    ap.add_argument("--duration", type=float, default=5.0)
+    ap.add_argument("--max-batch-rows", type=int, default=256)
+    ap.add_argument("--max-wait-ms", type=float, default=1.0)
+    ap.add_argument("--sizes", default="1,3,9,17,40",
+                    help="comma-separated request row sizes")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--json", help="also write the report here")
+    args = ap.parse_args(argv)
+
+    from dryad_tpu.serve.bench import run_bench
+
+    model = args.model if args.model else _train_throwaway()
+    report = run_bench(
+        model, backend=args.backend, clients=args.clients,
+        duration_s=args.duration,
+        sizes=[int(s) for s in args.sizes.split(",")],
+        max_batch_rows=args.max_batch_rows, max_wait_ms=args.max_wait_ms,
+        seed=args.seed, verbose=True)
+    print(json.dumps(report, indent=1))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(report, f, indent=1)
+    if report["recompiles_after_warmup"] != 0:
+        print("WARNING: cache recompiled after warmup", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

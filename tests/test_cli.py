@@ -70,6 +70,39 @@ def test_cli_csr_npz_train_predict(tmp_path):
     assert preds.shape == (2000,) and auc(y, preds) > 0.55
 
 
+def test_cli_serve_one_shot_smoke(paths):
+    """serve --request: one request through the full serving stack (bucketed
+    compiled predict + micro-batcher), bitwise equal to the predict CLI."""
+    model = str(paths / "m.dryad")
+    rc = main([
+        "train", "--config", str(paths / "cfg.json"),
+        "--data", str(paths / "X.npy"), "--label", str(paths / "y.npy"),
+        "--model", model, "--backend", "cpu", "--quiet",
+    ])
+    assert rc == 0
+    rc = main(["serve", "--model", model, "--backend", "cpu",
+               "--max-batch-rows", "64", "--request", str(paths / "X.npy"),
+               "--out", str(paths / "served.npy"), "--quiet"])
+    assert rc == 0
+    rc = main(["predict", "--model", model, "--data", str(paths / "X.npy"),
+               "--out", str(paths / "direct.npy")])
+    assert rc == 0
+    served = np.load(paths / "served.npy")
+    direct = np.load(paths / "direct.npy")
+    assert served.dtype == direct.dtype and np.array_equal(served, direct)
+
+
+def test_cli_serve_arg_parsing(paths, capsys):
+    with pytest.raises(SystemExit):                # --model is required
+        main(["serve"])
+    with pytest.raises(SystemExit):                # bad backend choice
+        main(["serve", "--model", "m.dryad", "--backend", "gpu"])
+    capsys.readouterr()
+    with pytest.raises(SystemExit, match="--request requires --out"):
+        main(["serve", "--model", str(paths / "nope.dryad"),
+              "--request", str(paths / "X.npy")])
+
+
 def test_profile_dir_captures_trace(tmp_path):
     import dryad_tpu as dryad
 

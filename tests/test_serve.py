@@ -1,0 +1,311 @@
+"""Online inference subsystem (dryad_tpu/serve/).
+
+The keystone invariant: a served prediction is BITWISE equal to the
+direct ``Booster.predict`` on the same rows, no matter how the serving
+layer buckets, pads, chunks, or coalesces the request — predict is
+per-row arithmetic end to end, so shape games cannot change a bit.
+Everything runs forced-CPU (tests/conftest.py) and stays tier-1 fast.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import dryad_tpu as dryad
+from dryad_tpu.datasets import higgs_like
+from dryad_tpu.serve import (MicroBatcher, ModelRegistry, PredictServer,
+                             Request, ServeOverloaded, ServeTimeout,
+                             bucket_rows, run_bench)
+
+
+@pytest.fixture(scope="module")
+def model():
+    X, y = higgs_like(600, seed=7)
+    ds = dryad.Dataset(X, y, max_bins=32)
+    booster = dryad.train(dict(objective="binary", num_trees=8, num_leaves=7,
+                               max_bins=32), ds, backend="cpu")
+    return booster, X
+
+
+@pytest.fixture(scope="module")
+def model_multiclass():
+    rng = np.random.default_rng(3)
+    X = rng.standard_normal((500, 8)).astype(np.float32)
+    y = (X[:, 0] + X[:, 1] > 0).astype(np.float32) + (X[:, 2] > 0.5)
+    ds = dryad.Dataset(X, y, max_bins=32)
+    booster = dryad.train(dict(objective="multiclass", num_class=3,
+                               num_trees=4, num_leaves=7, max_bins=32),
+                          ds, backend="cpu")
+    return booster, X
+
+
+def test_bucket_rows():
+    assert [bucket_rows(n) for n in (1, 7, 8, 9, 16, 17)] == [8, 8, 8, 16, 16, 32]
+    assert bucket_rows(100, 8, 64) == 64           # capped at max bucket
+    with pytest.raises(ValueError):
+        bucket_rows(0)
+
+
+@pytest.mark.parametrize("backend", ["cpu", "tpu"])
+def test_served_predict_bitwise_parity(model, backend):
+    """ISSUE satellite: padded/bucketed serve == direct predict, bitwise —
+    empty batch, 1-row, bucket boundaries (8|9, 16|17), and a request
+    bigger than the largest bucket (33 > 16 → chunked)."""
+    booster, X = model
+    server = PredictServer(backend=backend, max_batch_rows=16,
+                           max_wait_ms=0.5, min_bucket=8)
+    server.registry.add(booster)
+    with server:
+        for n in (0, 1, 7, 8, 9, 15, 16, 17, 33):
+            for raw in (False, True):
+                direct = booster.predict(X[:n], raw_score=raw)
+                served = server.predict(X[:n], raw_score=raw)
+                assert served.dtype == direct.dtype
+                assert served.shape == direct.shape
+                assert np.array_equal(served, direct), (backend, n, raw)
+    snap = server.stats()
+    assert snap["cache_compiles"] <= 2          # buckets {8, 16} only
+    assert snap["cache_hits"] > 0
+
+
+def test_served_binned_and_multiclass_parity(model_multiclass):
+    booster, X = model_multiclass
+    Xb = booster.mapper.transform(X)
+    server = PredictServer(backend="cpu", max_batch_rows=64, max_wait_ms=0.5)
+    server.registry.add(booster)
+    with server:
+        for n in (1, 9, 33):
+            direct = booster.predict_binned(Xb[:n])
+            served = server.predict(Xb[:n], binned=True)
+            assert direct.shape == (n, 3) and np.array_equal(served, direct)
+
+
+def test_registry_hot_swap_and_rollback(model, model_multiclass):
+    booster_a, X = model
+    booster_b, _ = model_multiclass
+    reg = ModelRegistry()
+    v1 = reg.add(booster_a)                             # v1 active
+    v2 = reg.add(booster_b, activate=False)
+    assert (reg.active_version, reg.versions()) == (v1, [v1, v2])
+    reg.activate(v2)
+    assert reg.active_version == v2
+    assert reg.rollback() == v1 and reg.active_version == v1
+    with pytest.raises(ValueError):
+        reg.unload(v1)                                  # active is protected
+    reg.unload(v2)
+    assert reg.versions() == [v1]
+    with pytest.raises(KeyError):
+        reg.get(v2)
+    with pytest.raises(LookupError):
+        ModelRegistry().get()
+
+
+def test_hot_swap_changes_served_model(model, model_multiclass):
+    booster_a, X = model
+    booster_b, Xm = model_multiclass
+    server = PredictServer(backend="cpu", max_wait_ms=0.2)
+    v1 = server.registry.add(booster_a)
+    v2 = server.registry.add(booster_b, activate=False)
+    with server:
+        assert np.array_equal(server.predict(X[:5]), booster_a.predict(X[:5]))
+        server.activate(v2)
+        assert np.array_equal(server.predict(Xm[:5]), booster_b.predict(Xm[:5]))
+        # pinned versions still address the inactive model
+        assert np.array_equal(server.predict(X[:5], version=v1),
+                              booster_a.predict(X[:5]))
+        assert server.rollback() == v1
+        assert np.array_equal(server.predict(X[:5]), booster_a.predict(X[:5]))
+
+
+def test_registry_loads_text_binary_checkpoint(model, tmp_path):
+    booster, X = model
+    booster.save(str(tmp_path / "m.dryad"))
+    booster.save_text(str(tmp_path / "m.txt"))
+    from dryad_tpu.checkpoint import Checkpointer
+
+    Checkpointer(str(tmp_path / "ck")).save(booster, 8)
+    reg = ModelRegistry()
+    v_bin = reg.load(str(tmp_path / "m.dryad"))
+    v_txt = reg.load(str(tmp_path / "m.txt"))
+    v_ck = reg.load_latest_checkpoint(str(tmp_path / "ck"))
+    ref = booster.predict(X[:10])
+    for v in (v_bin, v_txt, v_ck):
+        got = reg.get(v).booster.predict(X[:10])
+        assert np.array_equal(got, ref)
+    with pytest.raises(FileNotFoundError):
+        reg.load_latest_checkpoint(str(tmp_path / "empty_ck"))
+
+
+def test_concurrent_requests_coalesce_bitwise(model):
+    """Many threads in flight at once: answers stay request-exact, and the
+    deadline coalescer folds them into fewer dispatches."""
+    booster, X = model
+    server = PredictServer(backend="cpu", max_batch_rows=128,
+                           max_wait_ms=20.0, queue_size=64)
+    server.registry.add(booster)
+    sizes = [1, 3, 5, 8, 13]
+    outs: dict[int, np.ndarray] = {}
+    start = threading.Barrier(len(sizes))
+
+    def worker(i, n):
+        start.wait()
+        outs[i] = server.predict(X[i:i + n])
+
+    with server:
+        threads = [threading.Thread(target=worker, args=(i, n))
+                   for i, n in enumerate(sizes)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    for i, n in enumerate(sizes):
+        assert np.array_equal(outs[i], booster.predict(X[i:i + n]))
+    snap = server.stats()
+    assert snap["requests"] == len(sizes)
+    assert snap["batches"] < len(sizes)          # coalescing actually happened
+    assert 0 < snap["batch_fill_ratio"] <= 1
+
+
+def test_batcher_backpressure_and_timeout():
+    """Bounded queue rejects excess load; a per-request timeout abandons a
+    stuck request instead of hanging the caller."""
+    release = threading.Event()
+
+    def slow_dispatch(batch):
+        release.wait(5.0)
+        return [np.zeros(r.rows.shape[0], np.float32) for r in batch]
+
+    from dryad_tpu.serve import ServeMetrics
+
+    metrics = ServeMetrics()
+    batcher = MicroBatcher(slow_dispatch, max_batch_rows=4, max_wait_ms=1.0,
+                           queue_size=1, metrics=metrics)
+    batcher.start()
+    rows = np.zeros((2, 3), np.uint8)
+    errs: list[BaseException] = []
+
+    def blocked():
+        try:
+            batcher.submit(Request(rows), timeout=0.05)
+        except BaseException as e:  # noqa: BLE001
+            errs.append(e)
+
+    t = threading.Thread(target=blocked)
+    t.start()
+    time.sleep(0.2)       # worker is now stuck inside slow_dispatch
+    # worker busy: the next submit queues then times out (and stays queued,
+    # abandoned), so the one after bounces off the full queue
+    with pytest.raises(ServeTimeout):
+        batcher.submit(Request(rows), timeout=0.01)
+    with pytest.raises(ServeOverloaded):
+        batcher.submit(Request(rows), timeout=0.01)
+    release.set()
+    t.join(5.0)
+    assert errs and isinstance(errs[0], ServeTimeout)
+    assert metrics.timeouts >= 1 and metrics.rejected >= 1
+    batcher.stop()
+
+
+def test_stop_drains_stranded_requests():
+    """A request enqueued behind the stop sentinel must be failed, not left
+    waiting forever on a dead worker."""
+    from dryad_tpu.serve.batcher import _STOP
+
+    batcher = MicroBatcher(lambda b: [None] * len(b), queue_size=4)
+    stranded = Request(np.zeros((1, 2), np.uint8))
+    batcher._q.put(_STOP)
+    batcher._q.put(stranded)
+    batcher.start()
+    assert stranded.event.wait(5.0)
+    assert isinstance(stranded.error, ServeOverloaded)
+    batcher.stop()
+
+
+def test_unloaded_version_fails_only_its_group(model):
+    """A batch mixing a dead pinned version with live requests fails only
+    the dead group's requests."""
+    booster, X = model
+    server = PredictServer(backend="cpu", max_wait_ms=0.2)
+    server.registry.add(booster)
+    Xb = booster.mapper.transform(X[:4])
+    good = Request(Xb, version=server.registry.active_version)
+    dead = Request(Xb, version=99)
+    results = server._dispatch([good, dead])
+    assert isinstance(results[1], KeyError)
+    assert np.array_equal(results[0], booster.predict(X[:4]))
+
+
+def test_dispatch_error_propagates():
+    def bad_dispatch(batch):
+        raise RuntimeError("boom")
+
+    batcher = MicroBatcher(bad_dispatch, max_wait_ms=0.1, queue_size=4)
+    batcher.start()
+    with pytest.raises(RuntimeError, match="boom"):
+        batcher.submit(Request(np.zeros((1, 2), np.uint8)), timeout=5.0)
+    batcher.stop()
+
+
+def test_bench_serve_zero_recompiles_after_warmup(model):
+    """Acceptance gate: the closed-loop bench on forced CPU reports zero
+    recompiles after warmup — warm traffic only ever hits warm buckets."""
+    booster, X = model
+    report = run_bench(booster, backend="cpu", clients=3, duration_s=0.5,
+                       sizes=(1, 5, 9, 17), max_batch_rows=32,
+                       max_wait_ms=1.0, seed=0, feature_pool=X)
+    assert report["recompiles_after_warmup"] == 0
+    assert report["cache_hits"] > 0
+    assert report["bench_requests"] > 0
+    assert report["cache_compiles"] == 3         # buckets {8, 16, 32}, once
+
+
+def test_http_round_trip(model):
+    """Loopback smoke of the HTTP front end: /predict parity (through JSON
+    — exact, since Python floats widen f32 losslessly), /stats, /models,
+    and error mapping for an unknown version."""
+    import json
+    import urllib.error
+    import urllib.request
+
+    from dryad_tpu.serve.http import make_http_server
+
+    booster, X = model
+    server = PredictServer(backend="cpu", max_wait_ms=0.5)
+    server.registry.add(booster)
+    httpd = make_http_server(server, port=0)
+    port = httpd.server_address[1]
+    thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+    thread.start()
+
+    def post(path, body):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}{path}", data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"})
+        return json.loads(urllib.request.urlopen(req, timeout=10).read())
+
+    try:
+        out = post("/predict", {"rows": X[:5].tolist()})
+        assert np.array_equal(np.asarray(out["predictions"], np.float32),
+                              booster.predict(X[:5]))
+        stats = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/stats", timeout=10).read())
+        assert stats["requests"] >= 1 and stats["backend"] == "cpu"
+        models = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/models", timeout=10).read())
+        assert models["active"] in models["versions"]
+        assert out["version"] == models["active"]
+        # pre-binned rows arrive as JSON ints and must be cast to the
+        # model's bin dtype, not float32
+        Xb = booster.mapper.transform(X[:3])
+        binned_out = post("/predict", {"rows": Xb.tolist(), "binned": True})
+        assert np.array_equal(np.asarray(binned_out["predictions"], np.float32),
+                              booster.predict_binned(Xb))
+        with pytest.raises(urllib.error.HTTPError) as err:
+            post("/predict", {"rows": X[:2].tolist(), "version": 99})
+        assert err.value.code == 400
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        server.stop()
