@@ -31,6 +31,121 @@ import time
 import numpy as np
 
 
+def deep_level_probe(rows: int, P: int = 64, B: int = 256,
+                     F: int = 28, K: int = 3) -> dict | None:
+    """Per-arm wall of ONE deep level's data movement + smaller-children
+    histogram: the wired leaf-ordered-layout pipeline (level_moves ->
+    permute_records -> hist_from_layout) vs the legacy plan pipeline
+    (packed aligned sort -> record gather -> hist_from_plan).  Both arms
+    exclude the natural-order partition the two paths share, so the
+    numbers isolate exactly the stage the r6 wiring replaced.
+
+    CLAUDE.md methodology: K dependent reps inside ONE jit; the
+    perturbation reaches every stage (the wired arm's SIDE threshold and
+    the legacy arm's SORT KEY rotate with the carried scalar, advanced by
+    whole units); ends with a REAL host fetch (block_until_ready returns
+    instantly through this tunnel).  Returns None on CPU — interpret-mode
+    kernel walls are meaningless.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    if jax.devices()[0].platform == "cpu":
+        return None
+    from dryad_tpu.engine import leafperm, pallas_hist
+    from dryad_tpu.engine.histogram import build_hist_segmented
+
+    T = leafperm._TILE_ROWS
+    rng = np.random.default_rng(5)
+    Xb = jnp.asarray(rng.integers(0, B, (rows, F), dtype=np.uint8))
+    g_np = rng.normal(size=rows).astype(np.float32)
+    g = jnp.asarray(g_np)
+    h = jnp.asarray(rng.uniform(0.1, 1, rows).astype(np.float32))
+    slot_np = rng.integers(0, P, rows).astype(np.int32)
+    half_np = rng.random(rows) < 0.5
+
+    def loop_time(fn, *args):
+        def prog(s0, *a):
+            return jax.lax.fori_loop(0, K, lambda i, s: fn(s, *a), s0)
+
+        f = jax.jit(prog)
+        float(f(jnp.float32(0), *args))          # compile + warm, real fetch
+        t0 = time.perf_counter()
+        float(f(jnp.float32(1), *args))
+        return (time.perf_counter() - t0) / K * 1000
+
+    # ---- wired arm --------------------------------------------------------
+    rec_nat = leafperm.make_layout_records(Xb, g, h)
+    n_buf = leafperm.wired_tiles_bound(-(-rows // T), P)
+    # the histogrammed selection (all LEFT children below) must provably
+    # cover < half the rows for the shared half-bound: thresholds stay
+    # strictly negative so P(g <= thr) < 0.5 with ~sqrt(N) margin
+    n_sel = leafperm.wired_sel_tiles_bound(-(-rows // T), n_buf, P,
+                                           half=True)
+    rec_lay, tile_run, run_slot = leafperm.initial_layout(
+        rec_nat, jnp.asarray(slot_np), jnp.ones((P,), bool), P, n_buf)
+
+    def wired_step(s, rec_lay, tile_run, run_slot):
+        g_l, _, valid, _ = leafperm.unpack_layout_records(
+            rec_lay, F, jnp.uint8)
+        smod = s - jnp.floor(s / 2) * 2          # live: threshold alternates
+        # the grower's full per-level route rides in the arm: the
+        # run->packed-word compose + ONE per-row small-table gather (the
+        # dominant wired-only bookkeeping cost) and advance_runs — the
+        # probe must price the level the GROWER pays, not just the kernel
+        w0 = ((jnp.uint32(1) << 31)
+              | jnp.arange(P, dtype=jnp.uint32))   # per-run packed words
+        tab = jnp.concatenate([w0, jnp.zeros((1,), jnp.uint32)])
+        rr = tab[jnp.minimum(run_slot, P)][
+            jnp.repeat(tile_run, T)]               # composed row gather
+        live_bit = (rr >> 31) != 0
+        side = jnp.where(valid & live_bit,
+                         (g_l > -0.15 + 0.1 * smod).astype(jnp.int32), 2)
+        pos, dstl, dstr, base_l, base_r, _ = leafperm.level_moves(
+            tile_run, side, P)
+        out = leafperm.permute_records(rec_lay, pos, dstl, dstr, n_buf)
+        run_do = (rr[:: leafperm._TILE_ROWS][:P] & 1) == 0  # ~half split
+        tr2, rs2 = leafperm.advance_runs(run_slot, run_do[:P],
+                                         jnp.arange(P, dtype=jnp.int32),
+                                         base_l, base_r, n_buf)
+        hist = leafperm.hist_from_layout(
+            out, base_l[:P], base_l[1:] - base_l[:-1], P, B, F,
+            jnp.uint8, n_sel)
+        return (s + 1.0 + out[0, 0].astype(jnp.float32) * 1e-20
+                + hist[0, 0, 0, 0] * 1e-20
+                + (tr2[0] + rs2[0]).astype(jnp.float32) * 1e-20)
+
+    t_wired = loop_time(wired_step, rec_lay, tile_run, run_slot)
+
+    # ---- legacy arm -------------------------------------------------------
+    records = pallas_hist.make_records(Xb, g, h)
+    cnt0 = np.bincount(slot_np[half_np], minlength=P).astype(np.int32)
+    sel0 = jnp.asarray(np.where(half_np, slot_np, P).astype(np.int32))
+    cnt0_d = jnp.asarray(cnt0)
+
+    # rows_bound must be MATHEMATICALLY guaranteed (tile_plan contract —
+    # rows beyond it drop silently): the perturbation below only rotates
+    # slot ids, never the selected SET, so the exact draw count is the
+    # bound (a binomial ~N/2 draw can exceed N//2 itself)
+    sel_rows = int(cnt0.sum())
+
+    def legacy_step(s, sel0, cnt0_d, records):
+        si = s.astype(jnp.int32)
+        sel = jnp.where(sel0 < P, (sel0 + si) % P, P)  # perturb the SORT KEY
+        cnt = jnp.roll(cnt0_d, si)               # exact counts, rotated too
+        hist = build_hist_segmented(
+            Xb, g, h, sel, P, B, backend="pallas",
+            rows_bound=sel_rows, records=records, sel_counts=cnt)
+        return s + 1.0 + hist[0, 0, 0, 0] * 1e-20
+
+    t_legacy = loop_time(legacy_step, sel0, cnt0_d, records)
+    return {
+        "deep_level_ms_wired": round(t_wired, 1),
+        "deep_level_ms_legacy": round(t_legacy, 1),
+        "deep_level_rows": rows,
+    }
+
+
 def main() -> None:
     # Pin the device-resident chunked boosting path: the bench estimates the
     # LONG-run (500-tree-scale) steady state from short timed runs, and the
@@ -141,6 +256,16 @@ def main() -> None:
         out["spread_2tree_10m"] = round(max(walls2) / min(walls2) - 1, 3)
         out["spread_8tree_10m"] = round(max(walls8) / min(walls8) - 1, 3)
         out["rows_10m"] = 10_000_000
+        del ds10                       # free HBM before the level probe
+
+    # ---- wired-vs-legacy deep-level walls (the r6 trajectory field) ---------
+    # Recorded per arm next to the spread fields so the wiring shows up as
+    # a TREND across BENCH_*.json rounds, not a point.  BENCH_DEEP=0 skips.
+    if os.environ.get("BENCH_DEEP", "1") != "0":
+        probe_rows = out.get("rows_10m", rows)
+        probe = deep_level_probe(probe_rows)
+        if probe:
+            out.update(probe)
 
     print(json.dumps(out))
 

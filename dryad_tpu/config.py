@@ -153,6 +153,16 @@ class Params:
     lambdarank_truncation: int = 30
     # Engine knobs (TPU path)
     hist_backend: str = "auto"   # auto | xla | pallas
+    # Deep-phase data movement for the level-wise grower: "auto" carries
+    # the leaf-ordered record layout through deep levels (no per-level
+    # sort / record gather) whenever the config admits it
+    # (engine/levelwise.deep_layout_supported); "legacy" forces the
+    # plan-based sort+gather path — the comparison arm for the on-device
+    # parity gate and benches.  Switching arms changes program/fusion
+    # shapes, so fp32 near-tie argmaxes may flip between them (the
+    # documented chunked-vs-dispatch tolerance class in engine/train.py);
+    # model quality is unaffected.
+    deep_layout: str = "auto"    # auto | legacy
     hist_subtraction: bool = True
     rows_per_chunk: int = 65536  # row-tile for the chunked histogram scan
     deterministic: bool = True
@@ -259,6 +269,8 @@ class Params:
             raise ValueError("unbounded_depth must be auto|exact")
         if self.hist_backend not in ("auto", "xla", "pallas"):
             raise ValueError("hist_backend must be auto|xla|pallas")
+        if self.deep_layout not in ("auto", "legacy"):
+            raise ValueError("deep_layout must be auto|legacy")
         if self.hist_precision not in ("exact", "fast"):
             raise ValueError("hist_precision must be exact|fast")
         return self

@@ -32,6 +32,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from dryad_tpu.config import Params
 from dryad_tpu.engine.grower import grow_any
+from dryad_tpu.engine.jax_compat import shard_map
 
 AXIS = "data"
 
@@ -90,7 +91,7 @@ def grow_sharded(params: Params, total_bins: int, has_cat: bool,
     }
     extra = () if bundled_mask is None else (bundled_mask,)
     extra += () if root_hist is None else (root_hist,)
-    return jax.shard_map(
+    return shard_map(
         run, mesh=mesh,
         in_specs=(row2, row, row, row, rep, rep) + (rep,) * len(extra),
         out_specs=(tree_specs, row),
@@ -114,6 +115,6 @@ def roots_sharded(mesh: Mesh, Xb, g_all, h_all, bag, total_bins,
 
     row = P(AXIS)
     row2 = P(AXIS, None)
-    return jax.shard_map(
+    return shard_map(
         run, mesh=mesh, in_specs=(row2, row2, row2, row), out_specs=P(),
     )(Xb, g_all, h_all, bag)

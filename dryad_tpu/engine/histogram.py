@@ -29,6 +29,8 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
+from dryad_tpu.engine.jax_compat import pcast_varying
+
 
 _PALLAS_PLATFORMS = ("tpu", "axon")  # axon: the tunneled-TPU plugin platform
 
@@ -147,7 +149,7 @@ def build_hist(
     if axis_name is not None:
         # under shard_map the carry must be marked device-varying to match
         # the varying per-chunk partials (JAX vma tracking)
-        acc0 = jax.lax.pcast(acc0, axis_name, to="varying")
+        acc0 = pcast_varying(acc0, axis_name)
     acc, _ = jax.lax.scan(body, acc0, (Xc, w))
     hist = acc.reshape(3, F, B)
     if axis_name is not None:
@@ -226,7 +228,7 @@ def build_hist_classes(
     if axis_name is not None:
         # under shard_map the carry must be marked device-varying to match
         # the varying per-chunk partials (JAX vma tracking)
-        acc0 = jax.lax.pcast(acc0, axis_name, to="varying")
+        acc0 = pcast_varying(acc0, axis_name)
     acc, _ = jax.lax.scan(body, acc0, (Xc, gc, hc, m))
     gs = acc[:K].reshape(K, 1, F, B)
     hs = acc[K: 2 * K].reshape(K, 1, F, B)
@@ -295,7 +297,7 @@ def build_hist_multi(
 
     acc0 = jnp.zeros((3 * P, F * B), jnp.float32)
     if axis_name is not None:
-        acc0 = jax.lax.pcast(acc0, axis_name, to="varying")
+        acc0 = pcast_varying(acc0, axis_name)
     acc, _ = jax.lax.scan(body, acc0, (Xc, gc, hc, sc))
     hist = acc.reshape(3, P, F, B).transpose(1, 0, 2, 3)
     if axis_name is not None:

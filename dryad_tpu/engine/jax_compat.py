@@ -1,0 +1,96 @@
+"""Version shims for the narrow set of JAX APIs that moved between the
+releases this repo runs on (the TPU-attached environment's newer jax vs
+the 0.4.x CI containers).
+
+Every shim resolves the NEW spelling first so behavior on the tunneled
+TPU is unchanged; the fallbacks are semantically equivalent on the old
+release:
+
+* ``shard_map`` — ``jax.shard_map`` vs ``jax.experimental.shard_map``.
+* ``pcast`` — varying-manual-axes marking.  Old releases have no vma
+  tracking at all, so the identity is the correct degenerate form.
+* ``shape_dtype_struct`` — the ``vma`` kwarg on ``ShapeDtypeStruct``
+  (pallas_call under shard_map).  Without vma tracking the plain struct
+  is what old pallas expects.
+* ``tpu_any_space`` — ``pltpu.MemorySpace.ANY`` vs the old
+  ``pltpu.TPUMemorySpace.ANY``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.experimental.pallas import tpu as pltpu
+
+if hasattr(jax, "shard_map"):
+    shard_map = jax.shard_map
+else:                                                 # pragma: no cover
+    from jax.experimental.shard_map import shard_map as _shard_map_old
+
+    def shard_map(f, **kw):
+        # the old replication checker mis-types scan carries fed by psum
+        # results (its own error message recommends exactly this flag);
+        # semantics are unchanged — the repo's collectives are all
+        # explicit psums
+        return _shard_map_old(f, check_rep=False, **kw)
+
+_HAS_PCAST = hasattr(jax.lax, "pcast")
+
+
+def pcast_varying(x, axis_name):
+    """Mark ``x`` device-varying over ``axis_name`` where the release
+    tracks varying manual axes; identity elsewhere."""
+    if _HAS_PCAST:
+        return jax.lax.pcast(x, axis_name, to="varying")
+    return x
+
+
+def _vma_supported() -> bool:
+    try:
+        jax.ShapeDtypeStruct((1,), "float32", vma=frozenset())
+        return True
+    except TypeError:
+        return False
+
+
+_HAS_VMA = _vma_supported()
+
+
+def shape_dtype_struct(shape, dtype, axis_name=None):
+    """ShapeDtypeStruct carrying vma over ``axis_name`` when both are
+    available (pallas_call outputs under shard_map need it there)."""
+    if axis_name is not None and _HAS_VMA:
+        return jax.ShapeDtypeStruct(shape, dtype,
+                                    vma=frozenset({axis_name}))
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def tpu_any_space():
+    if hasattr(pltpu, "MemorySpace"):
+        return pltpu.MemorySpace.ANY
+    return pltpu.TPUMemorySpace.ANY                   # pragma: no cover
+
+
+# Whether ``pltpu.repeat`` TILES (concatenates whole copies — the
+# semantics the histogram kernels' one-hot layout is built on).  The old
+# releases' interpret path elementwise-repeats instead, silently wrecking
+# every one-hot built on it.  Keyed off the SAME API-generation signal as
+# the other shims (``MemorySpace`` arrived with the tiling repeat): a
+# runtime pallas probe was tried first, but a probe fired inside a jit or
+# kernel trace silently takes its exception fallback and picks the wrong
+# semantics, and an import-time probe taxes every ``import dryad_tpu``
+# ~0.2 s — the API signal is free and its fallback below is semantically
+# correct on ANY release (concatenate always tiles).
+_REPEAT_TILES = hasattr(pltpu, "MemorySpace")
+
+
+def tile_repeat(x, n: int, axis: int = 0):
+    """``pltpu.repeat`` with guaranteed TILE semantics: the output is n
+    whole copies of ``x`` concatenated along ``axis`` (row r of the
+    result holds x[r mod x.shape[axis]]).  On the release generation the
+    kernels were measured with this IS pltpu.repeat (the Mosaic-native
+    lowering); on older releases an explicit concatenate — always
+    correct, at worst slower inside a compiled kernel."""
+    if _REPEAT_TILES:
+        return pltpu.repeat(x, n, axis)
+    return jnp.concatenate([x] * n, axis=axis)        # pragma: no cover

@@ -40,8 +40,12 @@ bandwidth-bound, not access-bound), and no per-level sort exists at all.
 
 Self-contained and bitwise-tested in interpret mode
 (tests/test_leafperm.py); ``scripts/exp_r5_perm.py`` measures it
-on-device against the sort+gather pair it replaces.  Wiring into
-``levelwise.py``'s deep phase is gated on that measurement (STATUS.md).
+on-device against the sort+gather pair it replaces (51.4 vs
+164.1 ms/level at 10M).  WIRED into ``levelwise.py``'s deep phase in r6:
+the grower carries (rec, tile_run, run_slot) through its level fori
+state via ``initial_layout``/``advance_runs`` below, and
+``scripts/smoke_tpu.py --gate`` pins wired-vs-legacy tree equality on
+device.
 """
 
 from __future__ import annotations
@@ -53,6 +57,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+
+from dryad_tpu.engine import jax_compat
 
 _TILE_ROWS = 512     # must match pallas_hist._TILE_ROWS (shared layouts)
 # Destination-row granule: Mosaic can only slice an HBM uint8 memref at
@@ -119,10 +125,12 @@ def _perm_kernel(dstl_ref, dstr_ref, pos_ref, rec_ref, init_ref, out_ref,
     cr.wait()
 
 
-@functools.partial(jax.jit, static_argnames=("n_out_tiles", "platform"))
+@functools.partial(jax.jit, static_argnames=("n_out_tiles", "platform",
+                                             "axis_name"))
 def permute_records(rec: jnp.ndarray, pos: jnp.ndarray, dstl: jnp.ndarray,
                     dstr: jnp.ndarray, n_out_tiles: int,
-                    platform: str | None = None) -> jnp.ndarray:
+                    platform: str | None = None,
+                    axis_name: str | None = None) -> jnp.ndarray:
     """Apply one level's movement.
 
     rec (n_tiles*T, WB) uint8; pos (n_tiles, 2, T) int32 in-tile ranks
@@ -130,6 +138,10 @@ def permute_records(rec: jnp.ndarray, pos: jnp.ndarray, dstl: jnp.ndarray,
     destination ROW offsets.  ``n_out_tiles`` MUST include the two slack
     tiles ``level_moves`` accounts for.  Returns the new (n_out_tiles*T,
     WB) uint8 leaf-ordered buffer.
+
+    ``axis_name`` marks the output device-varying when tracing under
+    ``shard_map`` (each shard permutes its own local layout; no
+    collective here — the histogram psum stays the growers' only one).
 
     The output is ALIASED to a zero buffer: rows no DMA write covers
     (inner pad rows of multi-tile segments with uneven source fill,
@@ -139,15 +151,21 @@ def permute_records(rec: jnp.ndarray, pos: jnp.ndarray, dstl: jnp.ndarray,
     n_rows, WB = rec.shape
     T = _TILE_ROWS
     n_tiles = n_rows // T
+    # memory-safety clamp (tile_plan's "safety squeeze" precedent): a
+    # violated caller bound must misplace rows DETERMINISTICALLY inside
+    # the buffer, never DMA past it (granule writes cover T rows from dst)
+    dst_cap = jnp.int32((n_out_tiles - 1) * T)
+    dstl = jnp.minimum(dstl, dst_cap)
+    dstr = jnp.minimum(dstr, dst_cap)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(n_tiles,),
         in_specs=[
             pl.BlockSpec((1, 2, T), lambda i, dl, dr: (i, 0, 0)),
             pl.BlockSpec((1, T, WB), lambda i, dl, dr: (i, 0, 0)),
-            pl.BlockSpec(memory_space=pltpu.MemorySpace.ANY),
+            pl.BlockSpec(memory_space=jax_compat.tpu_any_space()),
         ],
-        out_specs=pl.BlockSpec(memory_space=pltpu.MemorySpace.ANY),
+        out_specs=pl.BlockSpec(memory_space=jax_compat.tpu_any_space()),
         scratch_shapes=[
             pltpu.VMEM((T // _ALIGN, _ALIGN, WB), jnp.uint8),
             pltpu.VMEM((T // _ALIGN, _ALIGN, WB), jnp.uint8),
@@ -157,10 +175,15 @@ def permute_records(rec: jnp.ndarray, pos: jnp.ndarray, dstl: jnp.ndarray,
     )
     G = n_out_tiles * T // _ALIGN
     zeros = jnp.zeros((G, _ALIGN, WB), jnp.uint8)
+    if axis_name is not None:
+        # the aliased zero init must carry the same varying-manual-axes
+        # as the (shard-local) output it becomes
+        zeros = jax_compat.pcast_varying(zeros, axis_name)
     out = pl.pallas_call(
         functools.partial(_perm_kernel, T=T, WB=WB),
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((G, _ALIGN, WB), jnp.uint8),
+        out_shape=jax_compat.shape_dtype_struct((G, _ALIGN, WB),
+                                                jnp.uint8, axis_name),
         # operand index counts the 2 prefetched scalars first: 2=pos,
         # 3=rec, 4=zeros -> alias the zero buffer to the output
         input_output_aliases={4: 0},
@@ -302,6 +325,7 @@ def hist_from_layout(rec: jnp.ndarray, seg_first: jnp.ndarray,
                      seg_ntiles: jnp.ndarray, num_cols: int,
                      total_bins: int, num_features: int, bin_dtype,
                      n_sel_tiles: int, *,
+                     axis_name: str | None = None,
                      platform: str | None = None) -> jnp.ndarray:
     """(P, 3, F, B) histograms for P selected segments of a leaf-ordered
     layout — NO sort, NO per-row gather: each segment is a CONTIGUOUS
@@ -357,10 +381,134 @@ def hist_from_layout(rec: jnp.ndarray, seg_first: jnp.ndarray,
         (lc[1:] != lc[:-1]).astype(jnp.int32)])
     tile_skip = 1 - jnp.any(valid.reshape(n_sel_tiles, T),
                             axis=1).astype(jnp.int32)
-    return pallas_hist._hist_tiles(
+    hist = pallas_hist._hist_tiles(
         Xt, Wt, lc, tile_first, tile_skip, num_cols=P,
         total_bins=int(total_bins), num_features=int(num_features),
-        platform=platform)
+        axis_name=axis_name, platform=platform)
+    if axis_name is not None:
+        # the same fused grad/hess/count psum every histogram builder
+        # issues — still the growers' ONLY collective
+        hist = jax.lax.psum(hist, axis_name)
+    return hist
+
+
+# ---------------------------------------------------------------------------
+# levelwise deep-phase wiring (slot-keyed run bookkeeping)
+# ---------------------------------------------------------------------------
+# The wired grower (levelwise.py deep phase) carries the layout through its
+# level fori state as (rec, tile_run, run_slot):
+#
+# * ``tile_run`` (n_buf_tiles,) int32 — per-tile RUN index, ascending in
+#   layout order (the write-ordering safety of permute_records requires
+#   destination order == source processing order, which holds exactly when
+#   run ids ascend with tile position — the oracle's implicit invariant).
+# * ``run_slot`` (L,) int32 — run index -> grower leaf-slot id (sentinel L
+#   for unused run indices).  Runs <-> live leaf slots stay bijective:
+#   every level keeps all left/pass-through segments as their old runs
+#   (left children keep the parent's slot — the levelwise convention) and
+#   appends one new run per executed split (the right child's slot), so
+#   the run count is 1 + total splits <= L and the (L,)-dense bookkeeping
+#   never overflows.  Empty segments level_moves mandates (non-splitting
+#   parents' right segments, unused run indices) are ABSORBED into the
+#   preceding run: their tiles hold only zero sentinels, which contribute
+#   nothing to any move or histogram (the oracle's slack-absorption rule).
+
+
+def wired_tiles_bound(n_row_tiles: int, num_slots: int) -> int:
+    """Static FIXED-POINT tile bound for the carried layout buffer.
+
+    One level maps an n_buf-tile layout holding <= n_row_tiles*T real rows
+    to <= (rows + 2*_ALIGN*n_buf)/T + 2*L + 2 tiles (each source tile adds
+    < _ALIGN pad per side; every one of the L dense run indices gets a
+    mandatory tile per region plus the two slack tiles).  Solving
+    out <= n_buf for the stationary buffer gives n_buf >= 8/7 * (rows/T +
+    2L + 2) at _ALIGN/T = 1/16 — pads do NOT compound (the next level's
+    compaction drops them), so the same buffer carries every level."""
+    base = n_row_tiles + 2 * num_slots + 2
+    assert 2 * _ALIGN * 8 <= _TILE_ROWS, "fixed point needs 2A/T <= 1/8"
+    return -(-8 * base // 7) + 2
+
+
+def wired_sel_tiles_bound(n_row_tiles: int, n_buf_tiles: int,
+                          num_cols: int, half: bool) -> int:
+    """Static bound on ``hist_from_layout``'s ``n_sel_tiles`` for a
+    smaller-children selection out of a ``n_buf_tiles`` layout — the ONE
+    definition shared by the wired grower and the bench probe (an
+    insufficient bound silently truncates later segments' histograms, so
+    the two callers must never drift).  ``half=True`` when the caller can
+    PROVE the selection covers at most half the real rows (single device
+    below 2^24 rows, where the fp32 counts backing the smaller-child
+    choice are exact); the n_buf/16 term covers the _ALIGN interior
+    sentinels, 2*num_cols the per-segment ceil and the empty selections'
+    mandatory plan slots."""
+    if half:
+        return n_row_tiles // 2 + n_buf_tiles // 16 + 2 * num_cols + 8
+    return n_buf_tiles + 2 * num_cols
+
+
+def initial_layout(rec_nat: jnp.ndarray, sel: jnp.ndarray,
+                   live: jnp.ndarray, num_slots: int, n_buf_tiles: int):
+    """The ONE per-tree handoff: group natural-order layout records by
+    leaf slot into the tile-aligned leaf-ordered layout.
+
+    ``sel`` (N,) int32 in [0, L]; L drops the row (out-of-bag rows never
+    enter the layout — their records would only ride dead weight through
+    every level's move).  ``live`` (L,) bool marks slots that exist at the
+    handoff depth; dead slots' mandatory plan tiles are absorbed into the
+    preceding run.  Returns (rec_lay, tile_run, run_slot).
+
+    Per-slot row order is the plan paths' STABLE row-id order (tile_plan's
+    stable sort), and permute_records preserves source order within
+    (segment, side) — so every later level's per-slot order matches what
+    tile_plan_aligned would produce for the same selection, by
+    construction (the integration contract test_leafperm pins)."""
+    from dryad_tpu.engine.pallas_hist import tile_plan
+
+    N = rec_nat.shape[0]
+    L = int(num_slots)
+    T = _TILE_ROWS
+    buf, tile_leaf, _ = tile_plan(sel, N, L, T)
+    nh = buf.shape[0] // T
+    assert nh <= n_buf_tiles, (nh, n_buf_tiles)
+    rec_lay = jnp.where((buf < N)[:, None],
+                        rec_nat[jnp.minimum(buf, N - 1)], jnp.uint8(0))
+    rec_lay = jnp.pad(rec_lay, ((0, (n_buf_tiles - nh) * T), (0, 0)))
+    livec = jnp.cumsum(live.astype(jnp.int32))
+    tl_full = jnp.concatenate([
+        tile_leaf, jnp.full((n_buf_tiles - nh,), L - 1, jnp.int32)])
+    tile_run = jnp.maximum(livec[tl_full] - 1, 0).astype(jnp.int32)
+    run_slot = jnp.full((L,), L, jnp.int32).at[
+        jnp.where(live, livec - 1, L)].set(
+            jnp.arange(L, dtype=jnp.int32), mode="drop")
+    return rec_lay, tile_run, run_slot
+
+
+def advance_runs(run_slot: jnp.ndarray, run_do: jnp.ndarray,
+                 run_right: jnp.ndarray, base_l: jnp.ndarray,
+                 base_r: jnp.ndarray, n_buf_tiles: int):
+    """Next level's (tile_run, run_slot) after ``level_moves``.
+
+    ``run_do`` (L,) marks runs whose slot split this level; ``run_right``
+    their right child's slot id.  Kept segments: every left segment of a
+    live run (new run index = OLD index — left children keep the parent's
+    slot) and the right segment of each splitting run (new runs R..R+S-1
+    in run order).  Marking each kept segment's first tile and counting
+    marks per tile yields the ascending tile->run map; everything between
+    kept starts (empty mandatory segments, slack, the trailing buffer) is
+    absorbed into the preceding run."""
+    L = run_slot.shape[0]
+    R = jnp.sum((run_slot < L).astype(jnp.int32))
+    ridx = jnp.arange(L, dtype=jnp.int32)
+    marks = jnp.zeros((n_buf_tiles,), jnp.int32)
+    marks = marks.at[jnp.where(ridx < R, base_l[:L], n_buf_tiles)].add(
+        1, mode="drop")
+    marks = marks.at[jnp.where(run_do, base_r[:L], n_buf_tiles)].add(
+        1, mode="drop")
+    tile_run = jnp.maximum(jnp.cumsum(marks) - 1, 0).astype(jnp.int32)
+    rank = jnp.cumsum(run_do.astype(jnp.int32)) - run_do.astype(jnp.int32)
+    run_slot = run_slot.at[jnp.where(run_do, R + rank, L)].set(
+        run_right.astype(jnp.int32), mode="drop")
+    return tile_run, run_slot
 
 
 # ---------------------------------------------------------------------------

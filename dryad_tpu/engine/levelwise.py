@@ -19,6 +19,18 @@ is histogrammed directly, the larger derived by subtraction.
 Distribution: identical contract to grower.py — call under ``shard_map``
 with rows sharded; the single per-level fused psum inside
 ``build_hist_multi`` is the only collective.
+
+Deep phase (r6, wired): levels past the shallow/deep switch carry the
+leaf-ordered record layout (engine/leafperm.py) through the level
+fori_loop state — sides derive from the layout records, one stable
+per-tile MXU compaction moves every row to its child segment, and the
+smaller children's histograms read the new layout as CONTIGUOUS tile
+runs.  The per-level packed ``(slot<<24 | row)`` sort and the full-N
+record gather are GONE from this path (measured 51.4 vs 164 ms/level at
+10M for the data movement they replaced); the plan-based path below
+remains only for configs the layout cannot take (see
+``deep_layout_supported``) and as the explicitly requested
+``deep_layout="legacy"`` comparison arm.
 """
 
 from __future__ import annotations
@@ -78,6 +90,56 @@ def select_bins(Xb: jnp.ndarray, rf: jnp.ndarray) -> jnp.ndarray:
             axis=1).astype(jnp.int32)
     return jnp.take_along_axis(Xb, rf[:, None], axis=1)[:, 0].astype(
         jnp.int32)
+
+
+def deep_layout_supported(p: Params, num_features: int, total_bins: int,
+                          bin_itemsize: int,
+                          platform: str | None = None) -> bool:
+    """Static gate for the wired (leaf-ordered layout) deep phase.
+
+    A pure function of (params, feature/bin shape, platform) — NEVER of
+    the row count, which under ``shard_map`` is the local shard and would
+    let 1-shard and N-shard runs of the same data choose different
+    histogram programs (the CLAUDE.md same-program rule).  Configs outside
+    the gate keep the legacy plan path (sort + record gather), which is
+    the layout path's retirement condition: the legacy deep path can only
+    be deleted once every exclusion below is lifted or measured
+    irrelevant.  Exclusions:
+
+    * non-Pallas histogram backends (the layout feeds the tile kernel);
+    * bins past the Pallas cap (``pallas_hist.supports``);
+    * ``hist_subtraction=False`` (the wired level histograms only the
+      smaller children; the dense both-children pass stays legacy);
+    * records wider than the 128-byte layout record
+      (9 + F*itemsize > _REC_WB — Epsilon-shaped data stays legacy);
+    * the exotic partition shapes that fall off the packed-word route
+      (bins > 8192 / leaves >= 65536 — the side derivation rides the same
+      packed per-slot table as the natural-order partition);
+    * leaf budgets past 512 (the dense run bookkeeping mandates 2L tiles
+      per level — past that the empty-segment overhead stops being noise);
+    * ``deep_layout="legacy"`` (explicit opt-out: smoke gate + bench
+      comparison arms, and the escape hatch if wired drifts on device).
+    """
+    from dryad_tpu.engine import leafperm, pallas_hist
+    from dryad_tpu.engine.histogram import resolve_backend
+
+    if p.deep_layout == "legacy":
+        return False
+    if resolve_backend(p.hist_backend, segmented=True,
+                       platform=platform) != "pallas":
+        return False
+    if not pallas_hist.supports(total_bins):
+        return False
+    if not p.hist_subtraction:
+        return False
+    L = p.effective_num_leaves
+    if not (total_bins <= (1 << 13) and L < (1 << 16)):
+        return False
+    if L > 512:
+        return False
+    if 9 + num_features * bin_itemsize > leafperm._REC_WB:
+        return False
+    return True
 
 
 def phase_plan(depth_cap: int, num_leaves: int, nat_live: bool):
@@ -225,6 +287,31 @@ def grow_tree_levelwise(
     d_switch, P_narrow, P_full = phase_plan(depth_cap, L,
                                             nat_tiles is not None)
 
+    # ---- wired deep phase (leaf-ordered layout) static plan ------------------
+    # The gate is row-count free (same program at every shard count); the
+    # SHAPES below come from the local row count, as every shard-local
+    # buffer's do.
+    from dryad_tpu.engine import leafperm
+
+    use_layout = (d_switch < depth_cap
+                  and deep_layout_supported(p, F, B, Xb.dtype.itemsize,
+                                            platform))
+    # the ONE exact-f32-counts / single-device predicate, shared by the
+    # wired plan's half bound and the legacy arm's bound_ok below — the
+    # two must never drift (an unsafe half-sized n_sel_tiles silently
+    # truncates histograms, hist_from_layout contract)
+    half_bound_ok = axis_name is None and N < (1 << 24)
+    n_buf_tiles = n_sel_tiles = 0
+    if use_layout:
+        Tl = leafperm._TILE_ROWS
+        n_buf_tiles = leafperm.wired_tiles_bound(-(-N // Tl), L)
+        # smaller children cover <= half the (in-bag) rows on a single
+        # device (same argument as bound_ok below); under shard_map or
+        # past 2^24 rows no bound applies and the whole-layout tile count
+        # is the only safe cap (shared bound helper — see its doc)
+        n_sel_tiles = leafperm.wired_sel_tiles_bound(
+            -(-N // Tl), n_buf_tiles, P_full, half=half_bound_ok)
+
     st = {
         "row_slot": row_slot, "slot_node": slot_node, "slot_gain": slot_gain,
         "slot_G": slot_G, "slot_H": slot_H, "slot_C": slot_C,
@@ -239,7 +326,7 @@ def grow_tree_levelwise(
         "num_nodes": num_nodes,
         "splits_done": splits_done, "max_depth": max_depth,
     }
-    def make_level_body(P, use_nat=False):
+    def make_level_body(P, use_nat=False, use_layout=False):
         def level_body(d, st):
             (row_slot, slot_node, slot_gain, slot_G, slot_H, slot_C, slot_depth,
              slot_lo, slot_hi,
@@ -311,6 +398,7 @@ def grow_tree_levelwise(
             # Integer/bool results are bit-identical to the gather
             # formulation, so every parity invariant is untouched.
             rs = jnp.minimum(row_slot, L - 1)
+            rec_t = None
             if B <= (1 << 13) and L < (1 << 16):
                 # cat_split above is already the per-candidate cat flag (its
                 # & do is a no-op here: records only scatter where do holds)
@@ -325,21 +413,39 @@ def grow_tree_levelwise(
                         jnp.stack([w0_c,
                                    jnp.maximum(sf, 0).astype(jnp.uint32)],
                                   axis=1), mode="drop")
-                rec_r = rec_t[rs]                      # ONE small-table gather
-                w0r = rec_r[:, 0]
-                rf = rec_r[:, 1].astype(jnp.int32)
-                row_do = ((w0r >> 31) != 0) & (row_slot < L)
-                bins_rf = select_bins(Xb, rf)
-                thr_r = ((w0r >> 16) & jnp.uint32(0x1FFF)).astype(jnp.int32)
-                go_left = bins_rf <= thr_r
-                if learn_missing:
-                    go_left &= ((w0r >> 30) & 1).astype(bool) | (bins_rf > 0)
-                if has_cat:
-                    cat_row = sp_catmask[rs, jnp.minimum(bins_rf, Bc - 1)]
-                    go_left = jnp.where(((w0r >> 29) & 1).astype(bool),
-                                        cat_row, go_left)
+
+                def packed_route(slot_idx, bins_of, rr=None):
+                    """Per-row split routing off the packed per-slot table:
+                    (splits?, goes-left?, packed word).  Shared by the
+                    natural-order partition and the layout side derivation
+                    so the two can never disagree on a row (identical
+                    integer/bool arithmetic).  ``rr`` lets the caller pass
+                    a pre-composed per-row record (one big gather instead
+                    of two chained ones — the CLAUDE.md pack-the-lookups
+                    rule); ``slot_idx`` is then only consulted for the
+                    categorical bitset row."""
+                    if rr is None:
+                        rr = rec_t[jnp.minimum(slot_idx, L)]  # ONE gather
+                    w0r = rr[:, 0]
+                    rf = rr[:, 1].astype(jnp.int32)
+                    bins_rf = bins_of(rf)
+                    thr_r = ((w0r >> 16)
+                             & jnp.uint32(0x1FFF)).astype(jnp.int32)
+                    gl = bins_rf <= thr_r
+                    if learn_missing:
+                        gl &= ((w0r >> 30) & 1).astype(bool) | (bins_rf > 0)
+                    if has_cat:
+                        cat_row = sp_catmask[jnp.minimum(slot_idx, L - 1),
+                                             jnp.minimum(bins_rf, Bc - 1)]
+                        gl = jnp.where(((w0r >> 29) & 1).astype(bool),
+                                       cat_row, gl)
+                    return ((w0r >> 31) != 0), gl, w0r
+
+                do_n, left_n, w0r = packed_route(
+                    rs, lambda rf: select_bins(Xb, rf))
+                row_do = do_n & (row_slot < L)
                 row_slot = jnp.where(
-                    row_do & ~go_left,
+                    row_do & ~left_n,
                     (w0r & jnp.uint32(0xFFFF)).astype(jnp.int32), row_slot)
             else:
                 # exotic shapes (bins > 8192 or leaves >= 65536) exceed the
@@ -367,24 +473,96 @@ def grow_tree_levelwise(
             left_smaller = CL <= CR
             small_slot = jnp.where(left_smaller, sj, right_slot)
             large_slot = jnp.where(left_smaller, right_slot, sj)
-            # non-do candidates scatter to L+1 (out of bounds, dropped);
-            # out-of-bag rows are excluded by the explicit bag_mask gate
-            # below — row_slot itself stays in [0, L-1] for every row now
-            # that the partition routes the whole dataset
-            colof = jnp.full((L + 1,), P, jnp.int32).at[
-                jnp.where(do, small_slot, L + 1)].set(
-                    jnp.arange(P, dtype=jnp.int32), mode="drop")
-            # bag gates the histogram selection; out-of-bag rows are
-            # partitioned but never accumulated
-            smallsel = jnp.where(bag_mask, colof[jnp.minimum(row_slot, L)], P)
+            if use_layout:
+                # WIRED deep level (r6): no per-level sort, no full-N
+                # record gather.  Sides come straight off the carried
+                # leaf-ordered layout's records via the SAME packed_route
+                # arithmetic the natural-order partition used above (the
+                # two agree on every row — identical integer/bool math),
+                # one stable per-tile MXU compaction moves the rows, and
+                # the smaller children read back as contiguous tile runs.
+                lay_rec = st["lay_rec"]
+                lay_tr = st["lay_tile_run"]
+                lay_rs = st["lay_run_slot"]
+                row_run = jnp.repeat(lay_tr, leafperm._TILE_ROWS)
+                # compose run -> packed record at the (L,) level, then pay
+                # ONE per-row small-table gather (two chained (n_buf*T,)
+                # gathers cost ~2x — the CLAUDE.md pack-the-lookups rule);
+                # dead runs (lay_rs = L) compose to rec_t[L] = zeros, so
+                # their rows route pass-through — and carry no valid rows
+                # anyway (absorbed segments hold only sentinels)
+                rr_lay = rec_t[jnp.minimum(lay_rs, L)][row_run]
+                slot_lay = lay_rs[row_run] if has_cat else None
+                _, _, valid_lay, xb_lay = leafperm.unpack_layout_records(
+                    lay_rec, F, Xb.dtype)
+                do_lay, left_lay, _ = packed_route(
+                    slot_lay, lambda rf: select_bins(xb_lay, rf),
+                    rr=rr_lay)
+                side = jnp.where(
+                    valid_lay,
+                    jnp.where(do_lay & ~left_lay, 1, 0),
+                    2).astype(jnp.int32)
+                pos, dstl, dstr, base_l, base_r, _ = leafperm.level_moves(
+                    lay_tr, side, L)
+                lay_rec = leafperm.permute_records(
+                    lay_rec, pos, dstl, dstr, lay_tr.shape[0],
+                    platform=platform, axis_name=axis_name)
+                # slot -> run inverse BEFORE advancing (candidates are
+                # parents of this level's move); dead runs scatter to
+                # L + 1 — OUT of the (L+1,) table so mode="drop" really
+                # drops them (index L is in range and would overwrite the
+                # sentinel cell the rj clamp below relies on)
+                slot_run = jnp.full((L + 1,), L, jnp.int32).at[
+                    jnp.where(lay_rs < L, lay_rs, L + 1)].set(
+                        jnp.arange(L, dtype=jnp.int32), mode="drop")
+                slot_do_t = (rec_t[:, 0] >> 31) != 0   # (L+1,) dense tables
+                slot_right_t = (rec_t[:, 0]
+                                & jnp.uint32(0xFFFF)).astype(jnp.int32)
+                lrs_c = jnp.minimum(lay_rs, L)
+                run_do = slot_do_t[lrs_c] & (lay_rs < L)
+                run_right = slot_right_t[lrs_c]
+                lay_tr_new, lay_rs_new = leafperm.advance_runs(
+                    lay_rs, run_do, run_right, base_l, base_r,
+                    lay_tr.shape[0])
+                # smaller children = contiguous segments of the NEW layout
+                rj = slot_run[jnp.minimum(sj, L)]
+                rjc = jnp.minimum(rj, L - 1)
+                lt_l = base_l[1:] - base_l[:-1]
+                lt_r = base_r[1:] - base_r[:-1]
+                sel_ok = do & (rj < L)
+                seg_first = jnp.where(
+                    sel_ok,
+                    jnp.where(left_smaller, base_l[rjc], base_r[rjc]), 0)
+                seg_nt = jnp.where(
+                    sel_ok,
+                    jnp.where(left_smaller, lt_l[rjc], lt_r[rjc]), 0)
+                hist_small = leafperm.hist_from_layout(
+                    lay_rec, seg_first, seg_nt, P, B, F, Xb.dtype,
+                    n_sel_tiles, axis_name=axis_name, platform=platform)
+                st = dict(st, lay_rec=lay_rec, lay_tile_run=lay_tr_new,
+                          lay_run_slot=lay_rs_new)
+            else:
+                # non-do candidates scatter to L+1 (out of bounds, dropped);
+                # out-of-bag rows are excluded by the explicit bag_mask gate
+                # below — row_slot itself stays in [0, L-1] for every row
+                # now that the partition routes the whole dataset
+                colof = jnp.full((L + 1,), P, jnp.int32).at[
+                    jnp.where(do, small_slot, L + 1)].set(
+                        jnp.arange(P, dtype=jnp.int32), mode="drop")
+                # bag gates the histogram selection; out-of-bag rows are
+                # partitioned but never accumulated
+                smallsel = jnp.where(bag_mask,
+                                     colof[jnp.minimum(row_slot, L)], P)
             # Single device, smaller children cover at most half the rows
             # (min(left,right) <= parent/2, parents disjoint) -> half the tile
             # grid.  Under shard_map the smaller child is chosen on GLOBAL
             # counts and one shard's share of it may exceed half that shard, so
             # no bound applies there; ditto above 2^24 rows, where the fp32
             # histogram counts backing the smaller-child choice stop being exact.
-            bound_ok = axis_name is None and N < (1 << 24)
-            if use_nat:
+            bound_ok = half_bound_ok
+            if use_layout:
+                pass                                   # hist_small above
+            elif use_nat:
                 from dryad_tpu.engine import pallas_hist
 
                 hist_small = pallas_hist.build_hist_small(
@@ -393,9 +571,9 @@ def grow_tree_levelwise(
             else:
                 # exact per-column counts (smaller-child C off the parent
                 # histogram, integer-exact in f32 below 2**24) admit the
-                # pad-injected aligned sort — the plan's alignment gather
-                # drops out (tile_plan_aligned); single-device only, where
-                # the counts describe the whole selection
+                # pad-injected aligned sort inside build_hist_segmented —
+                # the plan's alignment gather drops out; single-device
+                # only, where the counts describe the whole selection
                 small_cnt = (jnp.where(do, jnp.where(left_smaller, CL, CR),
                                        0.0).astype(jnp.int32)
                              if bound_ok else None)
@@ -473,7 +651,7 @@ def grow_tree_levelwise(
             num_nodes = num_nodes + 2 * n_do
             max_depth = jnp.where(n_do > 0, (d + 1).astype(jnp.int32), max_depth)
 
-            return {
+            out = {
                 "row_slot": row_slot, "slot_node": slot_node,
                 "slot_gain": slot_gain, "slot_G": slot_G, "slot_H": slot_H,
                 "slot_C": slot_C, "slot_depth": slot_depth,
@@ -488,6 +666,11 @@ def grow_tree_levelwise(
                 "num_nodes": num_nodes, "splits_done": splits_done,
                 "max_depth": max_depth,
             }
+            if use_layout:
+                out["lay_rec"] = st["lay_rec"]
+                out["lay_tile_run"] = st["lay_tile_run"]
+                out["lay_run_slot"] = st["lay_run_slot"]
+            return out
         return level_body
 
     st = jax.lax.fori_loop(
@@ -497,11 +680,29 @@ def grow_tree_levelwise(
                         and P_narrow <= pallas_hist_NAT_SLOTS()),
         st)
     if d_switch < depth_cap:
+        if use_layout:
+            # ---- the ONE shallow->deep handoff conversion -------------------
+            # Group the (bag-gated) rows by their depth-d_switch slot into
+            # the tile-aligned leaf-ordered layout: one stable sort + one
+            # full-N record gather PER TREE, amortized over every deep
+            # level (the legacy path paid both per LEVEL).  Out-of-bag
+            # rows never enter the layout — the natural-order row_slot
+            # (still maintained above for the final row_leaf) keeps
+            # routing them.
+            rec_nat = leafperm.make_layout_records(Xb, g, h)
+            sel_h = jnp.where(bag_mask, st["row_slot"], L).astype(jnp.int32)
+            live = st["slot_node"] >= 0
+            lay_rec, lay_tr, lay_rs = leafperm.initial_layout(
+                rec_nat, sel_h, live, L, n_buf_tiles)
+            st = dict(st, lay_rec=lay_rec, lay_tile_run=lay_tr,
+                      lay_run_slot=lay_rs)
         st = jax.lax.fori_loop(
             d_switch, depth_cap,
             make_level_body(P_full,
-                            use_nat=nat_tiles is not None
-                            and P_full <= pallas_hist_NAT_SLOTS()),
+                            use_nat=not use_layout
+                            and nat_tiles is not None
+                            and P_full <= pallas_hist_NAT_SLOTS(),
+                            use_layout=use_layout),
             st)
 
     # ---- finalize leaf values + node bitsets (shared helpers) ----------------

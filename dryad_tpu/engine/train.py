@@ -795,6 +795,17 @@ def train_device(
     # never change the path mid-run — are unaffected; only configs that
     # *straddle* the condition may see ulp-level tree differences, with
     # model quality untouched.
+    # The same tolerance class covers the deep-phase data-movement choice
+    # (r6): ``deep_layout="auto"`` carries the leaf-ordered record layout
+    # through levelwise's deep levels (levelwise.deep_layout_supported
+    # gates it on params + feature/bin shape, never rows, so every shard
+    # and every run of one config picks the same path deterministically),
+    # while "legacy" keeps the per-level sort + record gather.  Post-
+    # permute layouts regroup per-tile f32 histogram partials at ulp
+    # level, so flipping the knob — like switching dispatch ↔ chunked —
+    # may flip a near-tie argmax on device; counts stay exact and the
+    # smoke gate (scripts/smoke_tpu.py) pins bitwise tree equality on the
+    # tie-free fixture.
     # Round 3: bagged/colsampled runs chunk too (host Philox masks upload
     # bit-packed per chunk), and validated runs evaluate INSIDE the chunk
     # program.  Round 4 (VERDICT r3 #4/#6): sharded bagged runs chunk as

@@ -7,4 +7,14 @@
 #
 cd "$(dirname "$0")/.." || exit 1
 
+# Wired-deep-phase lint (r6): engine/levelwise.py must never reach back to
+# the per-level sort helpers directly — the wired path's whole point is
+# that tile_plan/tile_plan_aligned are gone from the deep levels (the
+# legacy fallback reaches them only through build_hist_segmented).  A
+# direct reference here means the sort quietly re-grew; fail fast.
+if grep -nE 'tile_plan' dryad_tpu/engine/levelwise.py; then
+  echo "LINT FAIL: engine/levelwise.py references the per-level sort helper (tile_plan*)" >&2
+  exit 1
+fi
+
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc

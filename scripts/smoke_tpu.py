@@ -147,6 +147,50 @@ def smoke_pallas_natural_order():
     print("pallas natural-order multi-slot: lowers and agrees on device")
 
 
+def smoke_leafperm_wired_parity():
+    """Wired deep phase (leaf-ordered layout carried through levelwise's
+    level fori state) vs the legacy sort+gather path ON THE REAL DEVICE:
+    bitwise-identical tree structures on the tie-free gate fixture, leaf
+    values to fp32 tolerance (post-permute layouts regroup per-tile f32
+    histogram sums at ulp level — the documented tolerance class).  The
+    movement kernel's DMA layout is hardware-sensitive (granule-indexed
+    windowed writes, zero-aliased output), so interpret-mode CI cannot
+    stand in for this check; any drift here exits 1 like the other
+    kernel smokes."""
+    import jax
+    import numpy as np
+
+    import dryad_tpu as dryad
+    from dryad_tpu.config import make_params
+    from dryad_tpu.datasets import higgs_like
+    from dryad_tpu.engine.levelwise import deep_layout_supported, phase_plan
+    from dryad_tpu.engine.train import train_device
+
+    if jax.devices()[0].platform == "cpu":
+        print("leafperm wired parity: skipped (no accelerator attached)")
+        return
+    X, y = higgs_like(50_000, seed=43)
+    ds = dryad.Dataset(X, y, max_bins=64)
+    base = dict(objective="binary", num_trees=4, num_leaves=128,
+                max_bins=64, growth="depthwise", max_depth=8)
+    p_w = make_params(base)
+    B = int(ds.mapper.total_bins)
+    F = ds.X_binned.shape[1]
+    assert deep_layout_supported(p_w, F, B, ds.X_binned.dtype.itemsize), \
+        "gate fixture no longer admits the wired path"
+    d_switch, _, _ = phase_plan(p_w.max_depth, p_w.effective_num_leaves,
+                                True)
+    assert d_switch < p_w.max_depth, "fixture has no deep phase"
+    b_w = train_device(p_w, ds)
+    b_l = train_device(make_params(dict(base, deep_layout="legacy")), ds)
+    for k in ("feature", "threshold", "left", "right", "is_cat"):
+        np.testing.assert_array_equal(
+            b_w.tree_arrays()[k], b_l.tree_arrays()[k],
+            err_msg=f"wired vs legacy deep phase: {k!r}")
+    np.testing.assert_allclose(b_w.value, b_l.value, atol=1e-5)
+    print("leafperm wired deep phase: trees bitwise vs legacy on device")
+
+
 def smoke_train_parity():
     """Tiny end-to-end train on the ATTACHED device vs the CPU reference:
     identical tree structures and bitwise same-booster predict (the
@@ -188,6 +232,7 @@ _ALL_SMOKES = [
     smoke_pallas_u16_and_records,
     smoke_pallas_wide_segment_count,
     smoke_pallas_natural_order,
+    smoke_leafperm_wired_parity,
 ]
 
 

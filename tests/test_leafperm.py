@@ -119,6 +119,145 @@ def test_alignment_of_all_writes():
     assert (np.asarray(dstr) % leafperm._ALIGN == 0).all()
 
 
+def test_wired_level_preserves_plan_order():
+    """INTEGRATION contract (the wired deep phase rides on this, not just
+    the kernel): after the handoff conversion (initial_layout) and one
+    full wired level (level_moves -> permute_records -> advance_runs),
+    every child segment holds its rows in the SAME stable row-id order
+    the aligned tile plan would produce for that child's selection — the
+    per-slot order convention shared by every histogram path."""
+    rng = np.random.default_rng(33)
+    N, L = 5000, 8
+    WB = leafperm._REC_WB
+    slot_of = rng.integers(0, 4, N).astype(np.int32)   # slots 0..3 live
+    bag = rng.random(N) < 0.8
+    # records tagged with the row id so order is observable
+    rec_nat = np.zeros((N, WB), np.uint8)
+    rec_nat[:, :4] = np.arange(1, N + 1, dtype=np.uint32).view(
+        np.uint8).reshape(N, 4)
+    rec_nat[:, 8] = 1                                  # valid flag
+
+    import jax.numpy as jnp
+
+    n_buf = leafperm.wired_tiles_bound(-(-N // T), L)
+    sel = np.where(bag, slot_of, L).astype(np.int32)
+    live = np.zeros(L, bool)
+    live[:4] = True
+    rec_lay, tile_run, run_slot = leafperm.initial_layout(
+        jnp.asarray(rec_nat), jnp.asarray(sel), jnp.asarray(live), L, n_buf)
+    assert [int(run_slot[r]) for r in range(4)] == [0, 1, 2, 3]
+
+    # one level: slots 0 and 2 split (right children -> slots 4, 5)
+    thr = 0.5
+    u = rng.random(N)
+    go_right = {0: u < thr, 2: u < 0.3}
+    row_run = np.repeat(np.asarray(tile_run), T)
+    rs_lay = np.asarray(run_slot)[row_run]
+    tags_lay = np.asarray(rec_lay)[:, :4].copy().view(np.uint32).ravel()
+    valid_lay = np.asarray(rec_lay)[:, 8] == 1
+    side = np.full(n_buf * T, 2, np.int32)
+    for i in np.nonzero(valid_lay)[0]:
+        s = rs_lay[i]
+        rid = int(tags_lay[i]) - 1
+        if s in go_right:
+            side[i] = 1 if go_right[s][rid] else 0
+        else:
+            side[i] = 0
+    pos, dstl, dstr, base_l, base_r, _ = leafperm.level_moves(
+        jnp.asarray(tile_run), jnp.asarray(side), L)
+    out = np.asarray(leafperm.permute_records(
+        rec_lay, pos, dstl, dstr, n_buf))
+    run_do = np.zeros(L, bool)
+    run_do[[0, 2]] = True
+    run_right = np.zeros(L, np.int32)
+    run_right[0], run_right[2] = 4, 5
+    tile_run2, run_slot2 = leafperm.advance_runs(
+        run_slot, jnp.asarray(run_do), jnp.asarray(run_right),
+        base_l, base_r, n_buf)
+    # runs: old 0..3 keep slots 0..3 (left children / pass-through),
+    # new runs 4,5 carry the right-child slots in run order
+    assert [int(run_slot2[r]) for r in range(6)] == [0, 1, 2, 3, 4, 5]
+
+    # expected per-slot membership after the split
+    child_rows = {s: [] for s in range(6)}
+    for r in range(N):
+        if not bag[r]:
+            continue
+        s = slot_of[r]
+        if s in go_right and go_right[s][r]:
+            child_rows[{0: 4, 2: 5}[s]].append(r + 1)
+        else:
+            child_rows[s].append(r + 1)
+    row_run2 = np.repeat(np.asarray(tile_run2), T)
+    rs2 = np.asarray(run_slot2)[row_run2]
+    tags2 = out[:, :4].copy().view(np.uint32).ravel()
+    for s in range(6):
+        got = tags2[(rs2 == s) & (tags2 > 0)]
+        # stable row-id order per slot — exactly the aligned plan's order
+        np.testing.assert_array_equal(got, np.asarray(child_rows[s]),
+                                      err_msg=f"slot {s} order")
+
+
+def test_hist_from_layout_post_permute_vs_plan():
+    """Histograms off a POST-permute layout (interior _ALIGN sentinels
+    shift rows across tile boundaries) vs the tile-plan path: counts
+    EXACT (sums of 1.0), grad/hess to the documented ulp-class tolerance
+    — the wired grower's per-level histogram contract."""
+    from dryad_tpu.engine.histogram import build_hist_segmented
+
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(37)
+    N, F, B, L = 6000, 10, 64, 4
+    Xb = rng.integers(1, B, size=(N, F), dtype=np.uint8)
+    g = rng.normal(size=N).astype(np.float32)
+    h = rng.uniform(0.1, 1, N).astype(np.float32)
+    slot_of = rng.integers(0, 2, N).astype(np.int32)   # slots 0,1 live
+
+    rec_nat = leafperm.make_layout_records(
+        jnp.asarray(Xb), jnp.asarray(g), jnp.asarray(h))
+    n_buf = leafperm.wired_tiles_bound(-(-N // T), L)
+    live = np.zeros(L, bool)
+    live[:2] = True
+    rec_lay, tile_run, run_slot = leafperm.initial_layout(
+        rec_nat, jnp.asarray(slot_of), jnp.asarray(live), L, n_buf)
+
+    # split slot 0 -> (0, 2); slot 1 passes through
+    u = rng.random(N)
+    right = (slot_of == 0) & (u < 0.45)
+    row_run = np.repeat(np.asarray(tile_run), T)
+    rs_lay = np.asarray(run_slot)[row_run]
+    valid_lay = np.asarray(rec_lay)[:, 8] == 1
+    # recover row ids via the g bytes (unique floats) to map sides
+    gl = np.asarray(rec_lay)[:, 0:4].copy().view(np.float32).ravel()
+    order = {float(v): i for i, v in enumerate(g)}
+    side = np.full(n_buf * T, 2, np.int32)
+    for i in np.nonzero(valid_lay)[0]:
+        rid = order[float(gl[i])]
+        side[i] = 1 if (rs_lay[i] == 0 and right[rid]) else 0
+    pos, dstl, dstr, base_l, base_r, _ = leafperm.level_moves(
+        jnp.asarray(tile_run), jnp.asarray(side), L)
+    out = leafperm.permute_records(rec_lay, pos, dstl, dstr, n_buf)
+
+    # children: left of 0 (=slot 0), right of 0 (new), left of 1 (pass)
+    lt_l = np.asarray(base_l[1:] - base_l[:-1])
+    lt_r = np.asarray(base_r[1:] - base_r[:-1])
+    seg_first = jnp.asarray([int(base_l[0]), int(base_r[0]),
+                             int(base_l[1])], jnp.int32)
+    seg_nt = jnp.asarray([int(lt_l[0]), int(lt_r[0]), int(lt_l[1])],
+                         jnp.int32)
+    bound = int(np.asarray(seg_nt).sum()) + 2
+    got = np.asarray(leafperm.hist_from_layout(
+        out, seg_first, seg_nt, 3, B, F, np.uint8, bound))
+
+    sel = np.where(slot_of == 0, np.where(right, 1, 0), 2).astype(np.int32)
+    want = np.asarray(build_hist_segmented(
+        jnp.asarray(Xb), jnp.asarray(g), jnp.asarray(h),
+        jnp.asarray(sel), 3, B, backend="pallas"))
+    np.testing.assert_array_equal(got[:, 2], want[:, 2])  # counts exact
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-4)
+
+
 def test_hist_from_layout_bitwise_vs_plan():
     """Histograms straight from a leaf-ordered layout (contiguous tile
     runs, no sort/row-gather) are BITWISE equal to the tile-plan path on

@@ -1,0 +1,170 @@
+"""Wired deep phase (leaf-ordered layout) under shard_map: N-shard
+training must reproduce 1-shard training past the shallow/deep handoff.
+
+The wired path keeps every layout strictly shard-local (each shard
+permutes its own rows into its own tile-aligned buffer); the ONLY
+collective stays the fused grad/hess/count psum inside the histogram
+builders — so sharded trees must match single-device trees exactly on
+the tie-free fixtures tier-1 pins (CLAUDE.md invariant).
+
+CPU-forced like the rest of tier-1 (conftest pins 8 virtual devices);
+``hist_backend="pallas"`` routes through the interpret-mode kernels so
+the wired gate admits the config.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+
+import dryad_tpu as dryad
+from dryad_tpu.config import make_params
+from dryad_tpu.datasets import higgs_like
+
+# NOTE: only the mesh tests carry the `distributed` marker (it means
+# "multi-device shard_map/psum" per pytest.ini) — the wired-vs-legacy
+# parity pins below are single-device and must survive a
+# `-m 'not distributed'` run.
+
+# depth 6 > d_switch 5 (nat pass live at these sizes) with P_full = 32
+# candidates: the deep phase runs at least one wired level per tree
+_DEEP = dict(objective="binary", num_trees=3, num_leaves=64, max_bins=32,
+             growth="depthwise", max_depth=6, hist_backend="pallas")
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    from dryad_tpu.engine.distributed import make_mesh
+
+    assert len(jax.devices()) >= 8, "conftest must provide 8 virtual devices"
+    return make_mesh(jax.devices()[:8])
+
+
+def _gate_active(p, ds):
+    from dryad_tpu.engine.levelwise import deep_layout_supported, phase_plan
+
+    F = ds.X_binned.shape[1]
+    B = int(ds.mapper.total_bins)
+    d_switch, _, _ = phase_plan(p.max_depth, p.effective_num_leaves, True)
+    return (deep_layout_supported(p, F, B, ds.X_binned.dtype.itemsize, "cpu")
+            and d_switch < p.max_depth)
+
+
+def test_wired_gate_admits_fixture():
+    """The fixture must actually exercise the wired path — if the gate
+    stops admitting it, this file would silently test the legacy path."""
+    X, y = higgs_like(1024, seed=47)
+    ds = dryad.Dataset(X, y, max_bins=32)
+    assert _gate_active(make_params(_DEEP), ds)
+
+
+@pytest.mark.distributed
+def test_sharded_wired_deep_phase_parity(mesh):
+    """N-shard ≡ 1-shard through the wired deep phase."""
+    from dryad_tpu.engine.train import train_device
+
+    X, y = higgs_like(4096, seed=47)
+    ds = dryad.Dataset(X, y, max_bins=32)
+    p = make_params(_DEEP)
+    assert _gate_active(p, ds)
+    b1 = train_device(p, ds)
+    b8 = train_device(p, ds, mesh=mesh)
+    for k in ("feature", "threshold", "left", "right", "is_cat"):
+        np.testing.assert_array_equal(
+            b1.tree_arrays()[k], b8.tree_arrays()[k],
+            err_msg=f"wired deep phase: sharded vs single-device {k!r}")
+    np.testing.assert_allclose(b1.value, b8.value, atol=1e-3)
+
+
+@pytest.mark.distributed
+def test_sharded_wired_with_padding_and_bagging(mesh):
+    """Mesh-padded rows (N % 8 != 0) and out-of-bag rows must never enter
+    the layout (they are dropped at the handoff, not carried as dead
+    weight) — sharded trees still match single-device."""
+    from dryad_tpu.engine.train import train_device
+
+    # seed chosen tie-free: deep bagged levels on this shape carry a few
+    # fp32 near-tie gains whose argmax the psum reduction order can flip
+    # (documented tolerance class — seeds 31/53/61 flip ONE node in BOTH
+    # the wired and the legacy arm identically; not a layout property)
+    X, y = higgs_like(4001, seed=43)
+    ds = dryad.Dataset(X, y, max_bins=32)
+    p = make_params(dict(_DEEP, num_trees=2, subsample=0.7, seed=3,
+                         min_data_in_leaf=5))
+    assert _gate_active(p, ds)
+    b1 = train_device(p, ds)
+    b8 = train_device(p, ds, mesh=mesh)
+    np.testing.assert_array_equal(b1.feature, b8.feature)
+    np.testing.assert_array_equal(b1.threshold, b8.threshold)
+
+
+def test_wired_multi_level_chain_matches_legacy():
+    """Depth 7 = TWO chained wired levels: the run bookkeeping must
+    survive level-to-level advancement (advance_runs' renumbering, empty
+    mandatory segments absorbed, right children appended in run order) —
+    single-level fixtures cannot catch a chain bug.  min_data_in_leaf=2
+    keeps deep levels splitting under the 128-leaf budget."""
+    from dryad_tpu.engine.train import train_device
+
+    X, y = higgs_like(4000, seed=29)
+    ds = dryad.Dataset(X, y, max_bins=32)
+    base = dict(objective="binary", num_trees=1, num_leaves=128,
+                max_bins=32, growth="depthwise", max_depth=7,
+                hist_backend="pallas", min_data_in_leaf=2)
+    bw = train_device(make_params(base), ds)
+    bl = train_device(make_params(dict(base, deep_layout="legacy")), ds)
+    for k in ("feature", "threshold", "left", "right"):
+        np.testing.assert_array_equal(
+            bw.tree_arrays()[k], bl.tree_arrays()[k], err_msg=k)
+    np.testing.assert_allclose(bw.value, bl.value, atol=1e-5)
+    # deep levels actually split (the chain was exercised, not skipped)
+    assert int((np.asarray(bw.feature) >= 0).sum()) > 63
+
+
+def test_wired_cat_missing_multiclass_matches_legacy():
+    """The layout side derivation's categorical-bitset and learned-
+    missing branches (packed_route bits 29/30) plus multiclass trees:
+    wired and legacy deep phases must agree bitwise on structures."""
+    from dryad_tpu.engine.train import train_device
+
+    rng = np.random.default_rng(3)
+    N = 3000
+    X = rng.normal(size=(N, 8)).astype(np.float32)
+    X[:, 3] = rng.integers(0, 12, N)
+    X[rng.random((N, 8)) < 0.1] = np.nan       # learned default direction
+    y = (((X[:, 0] > 0) | (np.nan_to_num(X[:, 3]) > 6)).astype(np.float32)
+         + (X[:, 1] > 1))
+    ds = dryad.Dataset(X, y, max_bins=32, categorical_features=[3])
+    base = dict(objective="multiclass", num_class=3, num_trees=2,
+                num_leaves=64, max_bins=32, growth="depthwise", max_depth=6,
+                hist_backend="pallas", categorical_features=[3])
+    bw = train_device(make_params(base), ds)
+    bl = train_device(make_params(dict(base, deep_layout="legacy")), ds)
+    for k in ("feature", "threshold", "left", "right", "is_cat",
+              "cat_bitset", "default_left"):
+        np.testing.assert_array_equal(
+            bw.tree_arrays()[k], bl.tree_arrays()[k], err_msg=k)
+    np.testing.assert_allclose(bw.value, bl.value, atol=1e-5)
+
+
+def test_wired_matches_legacy_trees():
+    """Wired vs legacy deep phase on the tie-free fixture: identical
+    structures (the smoke gate's on-device assertion, pinned in CI too).
+    Histogram sums regroup at ulp level between the two paths (documented
+    tolerance class), so values compare to fp32 tolerance."""
+    from dryad_tpu.engine.train import train_device
+
+    X, y = higgs_like(4096, seed=59)
+    ds = dryad.Dataset(X, y, max_bins=32)
+    p_w = make_params(_DEEP)
+    p_l = make_params(dict(_DEEP, deep_layout="legacy"))
+    assert _gate_active(p_w, ds)
+    bw = train_device(p_w, ds)
+    bl = train_device(p_l, ds)
+    for k in ("feature", "threshold", "left", "right"):
+        np.testing.assert_array_equal(
+            bw.tree_arrays()[k], bl.tree_arrays()[k],
+            err_msg=f"wired vs legacy {k!r}")
+    np.testing.assert_allclose(bw.value, bl.value, atol=1e-5)

