@@ -6,8 +6,10 @@
         [--log-jsonl metrics.jsonl] [--backend auto|tpu|cpu] [--quiet]
     python -m dryad_tpu predict --model m.dryad --data X.npy --out preds.npy [--raw]
     python -m dryad_tpu dump    --model m.dryad [--out model.json]
-    python -m dryad_tpu serve   --model m.dryad [--host H --port P] \
-        [--backend auto|tpu|cpu] [--max-batch-rows N --max-wait-ms F] \
+    python -m dryad_tpu serve   --model m.dryad [--model fraud=m2.dryad ...] \
+        [--host H --port P] [--backend auto|tpu|cpu] \
+        [--max-batch-rows N --max-wait-ms F] [--pipeline-depth 2] \
+        [--sharded auto|on|off] [--device-budget-mb M] [--log-requests] \
         [--request X.npy --out p.npy]   # one-shot through the full stack
 
 Data formats: ``.npy`` (dense float matrix), ``.npz`` with keys
@@ -151,11 +153,26 @@ def cmd_serve(args) -> int:
         max_batch_rows=args.max_batch_rows,
         max_wait_ms=args.max_wait_ms,
         queue_size=args.queue_size,
+        pipeline_depth=args.pipeline_depth,
+        sharded={"auto": "auto", "on": True, "off": False}[args.sharded],
+        device_budget_bytes=(args.device_budget_mb * (1 << 20)
+                             if args.device_budget_mb else None),
     )
-    for path in args.model:
-        version = server.load_model(path)
+    import os.path
+
+    for spec in args.model:
+        # NAME=path registers a routing alias for multi-model co-serving;
+        # a spec that exists on disk, or whose left-of-'=' part looks like
+        # a path, is always a plain path (model paths may contain '=')
+        name, path = None, spec
+        if "=" in spec and not os.path.exists(spec):
+            cand, _, rest = spec.partition("=")
+            if cand and "/" not in cand and "\\" not in cand:
+                name, path = cand, rest
+        version = server.load_model(path, name=name)
         if not args.quiet:
-            print(f"loaded {path} -> version {version}")
+            alias = f" (name {name!r})" if name else ""
+            print(f"loaded {path} -> version {version}{alias}")
 
     if args.request:
         # one-shot mode: run a single request through the FULL serving
@@ -182,7 +199,8 @@ def cmd_serve(args) -> int:
     from dryad_tpu.serve.http import make_http_server
 
     httpd = make_http_server(server, args.host, args.port,
-                             verbose=not args.quiet)
+                             verbose=not args.quiet,
+                             log_requests=args.log_requests)
     host, port = httpd.server_address[:2]
     print(f"dryad serving on http://{host}:{port}  "
           f"(backend={server.backend}; POST /predict, GET /stats)")
@@ -238,8 +256,9 @@ def main(argv=None) -> int:
 
     s = sub.add_parser("serve", help="online inference service")
     s.add_argument("--model", required=True, action="append",
-                   help="model path (.dryad binary or text dump); repeat to "
-                        "load several versions — the last one is active")
+                   help="model path (.dryad binary or text dump), or "
+                        "NAME=path to register a routing alias; repeat to "
+                        "co-serve several models — the last one is active")
     s.add_argument("--backend", default="auto", choices=["auto", "tpu", "cpu"])
     s.add_argument("--host", default="127.0.0.1")
     s.add_argument("--port", type=int, default=8000)
@@ -249,6 +268,16 @@ def main(argv=None) -> int:
                    help="batch coalescing deadline")
     s.add_argument("--queue-size", type=int, default=256,
                    help="bounded request queue (backpressure)")
+    s.add_argument("--pipeline-depth", type=int, default=2,
+                   help="overlapped dispatch run-ahead (1 = serial loop)")
+    s.add_argument("--sharded", default="auto", choices=["auto", "on", "off"],
+                   help="shard big predict buckets over the device mesh "
+                        "(auto: rows×outputs threshold)")
+    s.add_argument("--device-budget-mb", type=int, default=0,
+                   help="staged-model memory budget; 0 = unlimited "
+                        "(LRU eviction, active version pinned)")
+    s.add_argument("--log-requests", action="store_true",
+                   help="structured JSON request log on stderr")
     s.add_argument("--request", help="one-shot mode: predict this matrix "
                                      "through the serving stack and exit")
     s.add_argument("--out", help="one-shot mode: output .npy path")

@@ -118,13 +118,14 @@ class Booster:
         num_iteration: Optional[int] = None,
         pred_leaf: bool = False,
         pred_contrib: bool = False,
+        sharded: bool = False,
     ) -> np.ndarray:
         """Predict on raw features: bin through the frozen mapper, traverse."""
         X_binned = self.mapper.transform(np.asarray(X, np.float32))
         return self.predict_binned(
             X_binned, raw_score=raw_score, backend=backend,
             num_iteration=num_iteration, pred_leaf=pred_leaf,
-            pred_contrib=pred_contrib,
+            pred_contrib=pred_contrib, sharded=sharded,
         )
 
     def predict_binned(
@@ -136,7 +137,17 @@ class Booster:
         num_iteration: Optional[int] = None,
         pred_leaf: bool = False,
         pred_contrib: bool = False,
+        sharded: bool = False,
     ) -> np.ndarray:
+        if sharded and backend != "tpu":
+            # silent fallback to the single-host numpy path would make a
+            # sharded benchmark measure the wrong thing entirely
+            raise ValueError("sharded=True requires backend='tpu'")
+        if sharded and (pred_leaf or pred_contrib):
+            # these run single-host CPU loops regardless of backend —
+            # same silent-fallback hazard as above
+            raise ValueError(
+                "sharded=True is not supported with pred_leaf/pred_contrib")
         if pred_contrib:
             # exact TreeSHAP on the recorded per-node covers -> (N, F+1)
             # per output (last column = bias); contributions sum to the raw
@@ -166,9 +177,18 @@ class Booster:
 
             raw = predict_binned_cpu(self, X_binned, num_iteration=num_iteration)
         elif backend == "tpu":
-            from dryad_tpu.engine.predict import predict_binned_device
+            if sharded:
+                # rows sharded over the whole mesh, trees replicated —
+                # bitwise equal to the single-device program (per-row
+                # arithmetic; test_serve_sharded.py pins it)
+                from dryad_tpu.engine.predict import predict_binned_sharded
 
-            raw = np.asarray(predict_binned_device(self, X_binned, num_iteration=num_iteration))
+                raw = np.asarray(predict_binned_sharded(
+                    self, X_binned, num_iteration=num_iteration))
+            else:
+                from dryad_tpu.engine.predict import predict_binned_device
+
+                raw = np.asarray(predict_binned_device(self, X_binned, num_iteration=num_iteration))
         else:
             raise ValueError(f"unknown backend {backend!r}")
         return self.transform_raw(raw, raw_score=raw_score)

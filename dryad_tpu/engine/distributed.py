@@ -46,6 +46,15 @@ def padded_rows(n: int, n_shards: int) -> int:
     return -(-n // n_shards) * n_shards
 
 
+def replicate(mesh: Mesh, tree):
+    """Place a pytree fully replicated over the mesh — one copy per device.
+
+    The serving registry uses this for predict's tree tables: replicating
+    once at stage time means every sharded predict dispatch finds its
+    operands already resident instead of re-transferring them per call."""
+    return jax.device_put(tree, NamedSharding(mesh, P()))
+
+
 def shard_rows(mesh: Mesh, *arrays):
     """Place row-indexed arrays with rows split over the mesh's data axis."""
     out = []

@@ -9,26 +9,33 @@
 
 Layers (each its own module):
 
-* registry.py — versioned models, hot-swap + rollback, device-resident trees
-* cache.py    — shape-bucketed compiled-predict cache (pow2 row padding)
+* registry.py — versioned + named models, hot-swap + rollback,
+                device-resident trees under an LRU memory budget
+* cache.py    — shape-bucketed compiled-predict cache (pow2 row padding;
+                single-device + sharded shard_map entry families)
 * batcher.py  — micro-batching queue: deadline coalescing, backpressure,
-                per-request timeouts
-* metrics.py  — counters + latency reservoir behind ``stats()``
+                per-request timeouts, two-deep overlapped dispatch pipeline
+* metrics.py  — counters + latency reservoir (global and per-model)
+                behind ``stats()``
 * server.py   — PredictServer tying the above together
-* http.py     — stdlib HTTP front end (``python -m dryad_tpu serve``)
-* bench.py    — closed-loop concurrency benchmark (scripts/bench_serve.py)
+* http.py     — stdlib HTTP front end (``python -m dryad_tpu serve``),
+                structured request logging behind a flag
+* bench.py    — closed-loop concurrency benchmark (scripts/bench_serve.py),
+                pipeline-vs-serial compare + per-arm spread
 """
 
 from dryad_tpu.serve.batcher import (MicroBatcher, Request, ServeOverloaded,
                                      ServeTimeout)
-from dryad_tpu.serve.bench import run_bench
-from dryad_tpu.serve.cache import CompiledPredictCache, bucket_rows
-from dryad_tpu.serve.metrics import ServeMetrics
+from dryad_tpu.serve.bench import run_bench, run_bench_compare
+from dryad_tpu.serve.cache import (CompiledPredictCache, PreparedPredict,
+                                   bucket_rows)
+from dryad_tpu.serve.metrics import ModelStats, ServeMetrics
 from dryad_tpu.serve.registry import ModelEntry, ModelRegistry
 from dryad_tpu.serve.server import PredictServer
 
 __all__ = [
     "CompiledPredictCache", "MicroBatcher", "ModelEntry", "ModelRegistry",
-    "PredictServer", "Request", "ServeMetrics", "ServeOverloaded",
-    "ServeTimeout", "bucket_rows", "run_bench",
+    "ModelStats", "PredictServer", "PreparedPredict", "Request",
+    "ServeMetrics", "ServeOverloaded", "ServeTimeout", "bucket_rows",
+    "run_bench", "run_bench_compare",
 ]

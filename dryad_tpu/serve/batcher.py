@@ -1,11 +1,30 @@
-"""Micro-batching queue: coalesce concurrent requests into one dispatch.
+"""Micro-batching queue: coalesce concurrent requests into one dispatch,
+with an optional two-deep overlapped dispatch pipeline.
 
-A single worker thread drains a bounded queue.  The first dequeued
+A single collector thread drains a bounded queue.  The first dequeued
 request opens a batch and starts a max-wait deadline clock; requests
 keep joining until the row cap is reached or the deadline expires, then
 the whole batch goes to the device in one dispatch.  Under load batches
 fill instantly (the deadline never waits); when idle a lone request pays
 at most ``max_wait_ms`` of extra latency.
+
+Serial mode (``pipeline_depth <= 1`` or no prepare/execute split): the
+collector also runs the dispatch, strictly one batch at a time.
+
+Pipeline mode (the default when the caller provides ``prepare`` +
+``execute``): the collector runs only the HOST side — coalescing plus
+``prepare(batch)`` (grouping, concatenation, bucket padding, compiled-
+entry resolution) — and hands the prepared batch to an executor thread
+over a bounded queue.  While the executor runs batch i's device predict
+and the single result host fetch, the collector is already coalescing
+and preparing batch i+1.  The handoff queue holds ``pipeline_depth - 1``
+prepared batches, capping run-ahead at ``pipeline_depth`` batches past
+delivery (depth 2 mirrors the trainer's tunnel-safe run-ahead cap: an
+unbounded pipeline queues unfetched device work until a >1-min fetch
+dies — STATUS r5).  The collector/executor threads never touch the
+device result themselves — the one real host fetch lives inside the
+execute callable (cache.execute_raw), and scripts/ci.sh lints this file
+against growing fetches.
 
 Backpressure is the bounded queue itself: when it is full, ``submit``
 fails fast with ``ServeOverloaded`` instead of letting latency grow
@@ -15,7 +34,8 @@ simply dropped when the batch completes.
 
 Results come back bitwise equal to solo predicts: the dispatch function
 slices the coalesced output per request, and every predict stage is
-per-row (see cache.py).
+per-row (see cache.py).  Pipelining changes only WHEN a batch runs, not
+what runs — batches stay FIFO through the handoff queue.
 """
 
 from __future__ import annotations
@@ -37,16 +57,20 @@ class ServeTimeout(TimeoutError):
 
 
 class Request:
-    """One submitted predict request; ``rows`` is the pre-binned matrix."""
+    """One submitted predict request.  ``rows`` is pre-binned when
+    ``binned`` is True, else raw float32 features — binning then happens
+    in the dispatch pipeline's host stage (server._prepare), overlapped
+    with the previous batch's device predict."""
 
-    __slots__ = ("rows", "version", "raw_score", "event", "result", "error",
-                 "abandoned")
+    __slots__ = ("rows", "version", "raw_score", "binned", "event", "result",
+                 "error", "abandoned")
 
     def __init__(self, rows: np.ndarray, version: Optional[int] = None,
-                 raw_score: bool = False):
+                 raw_score: bool = False, binned: bool = True):
         self.rows = rows
         self.version = version
         self.raw_score = raw_score
+        self.binned = binned
         self.event = threading.Event()
         self.result = None
         self.error: Optional[BaseException] = None
@@ -60,13 +84,22 @@ class MicroBatcher:
     """Bounded-queue request coalescer around a batch dispatch function.
 
     ``dispatch(batch)`` receives the list of coalesced ``Request``s and
-    returns one result per request, in order.
+    returns one result per request, in order.  When ``prepare`` and
+    ``execute`` are also given (``dispatch ≡ execute ∘ prepare``) and
+    ``pipeline_depth >= 2``, dispatch runs as the overlapped two-stage
+    pipeline described in the module docstring.
     """
 
-    def __init__(self, dispatch, *, max_batch_rows: int = 4096,
+    def __init__(self, dispatch, *, prepare=None, execute=None,
+                 pipeline_depth: int = 2, max_batch_rows: int = 4096,
                  max_wait_ms: float = 2.0, queue_size: int = 256,
                  metrics=None):
         self._dispatch = dispatch
+        self._prepare = prepare
+        self._execute = execute
+        self.pipeline_depth = int(pipeline_depth)
+        self.pipelined = (prepare is not None and execute is not None
+                         and self.pipeline_depth >= 2)
         self.max_batch_rows = int(max_batch_rows)
         self.max_wait_s = float(max_wait_ms) / 1e3
         self.metrics = metrics
@@ -120,26 +153,40 @@ class MicroBatcher:
             raise ServeTimeout(f"request timed out after {timeout}s")
         if request.error is not None:
             if self.metrics is not None:
-                self.metrics.record_error()
+                self.metrics.record_error(request.version)
             raise request.error
         if self.metrics is not None:
             self.metrics.record_request(request.rows.shape[0],
-                                        time.perf_counter() - t0)
+                                        time.perf_counter() - t0,
+                                        request.version)
         return request.result
 
     # ---- worker ------------------------------------------------------------
-    def _collect(self, first: Request) -> tuple[list[Request], bool]:
-        """Coalesce until the row cap or the max-wait deadline."""
+    def _collect(self, first: Request,
+                 downstream_full=None) -> tuple[list[Request], bool]:
+        """Coalesce until the row cap or the max-wait deadline.
+
+        ``downstream_full`` (pipeline mode) is demand-driven flow control:
+        while the executor is backed up, shipping another batch would only
+        park it in the handoff queue, so the deadline re-arms and the
+        batch keeps coalescing — without this, a run-ahead collector opens
+        batches into a momentarily empty queue and closes them on the
+        deadline instead of the row cap, and the pipeline measures SLOWER
+        than serial (observed; the bench compare pins the win now)."""
         batch, rows = [first], first.rows.shape[0]
         deadline = time.perf_counter() + self.max_wait_s
         stopping = False
         while rows < self.max_batch_rows:
             remaining = deadline - time.perf_counter()
             if remaining <= 0:
-                break
+                if downstream_full is None or not downstream_full():
+                    break
+                remaining = self.max_wait_s     # executor backed up: re-arm
             try:
                 nxt = self._q.get(timeout=remaining)
             except queue.Empty:
+                if downstream_full is not None and downstream_full():
+                    continue                    # still no demand downstream
                 break
             if nxt is _STOP:
                 stopping = True
@@ -147,11 +194,38 @@ class MicroBatcher:
             batch.append(nxt)
             rows += nxt.rows.shape[0]
         if self.metrics is not None:
-            self.metrics.record_batch(rows, self.max_batch_rows)
+            # the closing request may overshoot the cap (and one oversized
+            # request opens a batch unconditionally): count such batches
+            # as full rather than reporting a fill ratio above 1
+            self.metrics.record_batch(rows, max(rows, self.max_batch_rows))
             self.metrics.sample_queue_depth(self._q.qsize())
         return batch, stopping
 
+    @staticmethod
+    def _deliver(batch: list, results) -> None:
+        for req, out in zip(batch, results):
+            # the dispatch may fail requests individually (e.g. one
+            # group's model version was unloaded mid-queue) without
+            # poisoning the rest of the batch
+            if isinstance(out, BaseException):
+                req.error = out
+            else:
+                req.result = out
+            req.event.set()
+
+    @staticmethod
+    def _fail(batch: list, error: BaseException) -> None:
+        for req in batch:
+            req.error = error
+            req.event.set()
+
     def _run(self) -> None:
+        if self.pipelined:
+            self._run_pipeline()
+        else:
+            self._run_serial()
+
+    def _run_serial(self) -> None:
         while True:
             item = self._q.get()
             if item is _STOP:
@@ -159,23 +233,47 @@ class MicroBatcher:
                 return
             batch, stopping = self._collect(item)
             try:
-                results = self._dispatch(batch)
-                for req, out in zip(batch, results):
-                    # the dispatch may fail requests individually (e.g. one
-                    # group's model version was unloaded mid-queue) without
-                    # poisoning the rest of the batch
-                    if isinstance(out, BaseException):
-                        req.error = out
-                    else:
-                        req.result = out
-                    req.event.set()
+                self._deliver(batch, self._dispatch(batch))
             except BaseException as e:  # noqa: BLE001 — delivered to callers
-                for req in batch:
-                    req.error = e
-                    req.event.set()
+                self._fail(batch, e)
             if stopping:
                 self._drain()
                 return
+
+    def _run_pipeline(self) -> None:
+        # run-ahead cap: the executor holds one batch in flight and this
+        # queue holds pipeline_depth - 1 more; collector blocks beyond that
+        handoff: queue.Queue = queue.Queue(maxsize=self.pipeline_depth - 1)
+
+        def executor() -> None:
+            while True:
+                item = handoff.get()
+                if item is _STOP:
+                    return
+                batch, prepared = item
+                try:
+                    self._deliver(batch, self._execute(prepared))
+                except BaseException as e:  # noqa: BLE001 — to callers
+                    self._fail(batch, e)
+
+        ex = threading.Thread(target=executor, daemon=True,
+                              name="dryad-serve-executor")
+        ex.start()
+        stopping = False
+        while not stopping:
+            item = self._q.get()
+            if item is _STOP:
+                break
+            batch, stopping = self._collect(item, downstream_full=handoff.full)
+            try:
+                prepared = self._prepare(batch)
+            except BaseException as e:  # noqa: BLE001 — to callers
+                self._fail(batch, e)
+                continue
+            handoff.put((batch, prepared))
+        handoff.put(_STOP)
+        ex.join()
+        self._drain()
 
     def _drain(self) -> None:
         """Fail anything enqueued behind the stop sentinel — a caller with

@@ -1,20 +1,44 @@
-"""Shape-bucketed compiled-predict cache.
+"""Shape-bucketed compiled-predict cache, single-device AND sharded.
 
 jit specializes on array shapes, so every distinct request size would
 compile (and through a remote-TPU tunnel, compile *slowly*).  Instead,
 batches are padded up to the next power-of-two row bucket and predicted
 at the bucket shape; warm traffic then touches a small fixed set of
-programs — at most log2(max_bucket / min_bucket) + 1 per model version —
-and never recompiles.  Batches larger than ``max_bucket`` are predicted
-in ``max_bucket``-row chunks.
+programs — at most log2(max_bucket / min_bucket) + 1 per model version
+and shard arm — and never recompiles.  Batches larger than
+``max_bucket`` are predicted in ``max_bucket``-row chunks.
 
-Bitwise contract: padding rows (bin 0 everywhere) and chunking cannot
-change the real rows' scores.  Tree traversal and fp32 leaf accumulation
-are strictly per-row (one scan carry element per row, no cross-row
-reduction anywhere in predict), so a padded program computes exactly the
-same per-row arithmetic as an unpadded one — the parity is structural,
-not approximate, and tests/test_serve.py pins it across bucket
-boundaries.
+Entries come in two families keyed by (version, bucket, n_shards):
+
+* ``n_shards == 1`` — the single-device jitted accumulate (fast path for
+  small interactive batches).
+* ``n_shards == mesh size`` — ``engine.predict.sharded_accumulate_fn``:
+  the padded row bucket sharded over the mesh, trees replicated, no
+  collectives; one implicit gather at the result edge when the host
+  fetches.  Routing is deterministic per bucket (``bucket × num_outputs
+  >= sharded_threshold``), so warming every bucket warms exactly the arm
+  that bucket will use forever — warm traffic stays recompile-free
+  across BOTH families.
+
+The dispatch pipeline (batcher.py) needs host work separated from device
+work, so prediction is split: ``prepare_raw`` does the host-side
+chunk/bucket/pad and entry resolution, ``execute_raw`` runs the compiled
+programs and performs the ONE real host fetch per chunk (np.asarray on
+the raw result — never ``block_until_ready``, which lies on the tunnel).
+``predict_raw`` composes the two for serial callers.
+
+Bitwise contract: padding rows (bin 0 everywhere), chunking, and row
+sharding cannot change the real rows' scores.  Tree traversal and fp32
+leaf accumulation are strictly per-row (one scan carry element per row,
+no cross-row reduction anywhere in predict), so a padded or sharded
+program computes exactly the same per-row arithmetic as an unpadded
+single-device one — the parity is structural, not approximate, and
+tests/test_serve.py + tests/test_serve_sharded.py pin it.
+
+Compiled callables never close over device arrays: they re-resolve
+``entry.device_state()`` per call, so a registry eviction actually frees
+the buffers and a re-staged model is picked up transparently with no
+recompile (jit caches on shape, not array identity).
 
 The cache also serves the no-device fallback: with ``backend='cpu'`` the
 per-bucket entry wraps the canonical numpy predict instead of a jitted
@@ -24,6 +48,7 @@ the warmup discipline are identical on both backends.
 
 from __future__ import annotations
 
+import threading
 from typing import Optional
 
 import numpy as np
@@ -41,13 +66,28 @@ def bucket_rows(n: int, min_bucket: int = 8,
     return b
 
 
+class PreparedPredict:
+    """Host-side-ready predict work: padded chunks + their resolved
+    compiled callables.  Built by ``prepare_raw`` (pipeline stage A),
+    consumed by ``execute_raw`` (stage B)."""
+
+    __slots__ = ("entry", "n", "chunks")
+
+    def __init__(self, entry, n: int, chunks: list):
+        self.entry = entry
+        self.n = n
+        self.chunks = chunks    # [(fn, padded_chunk, start, m), ...]
+
+
 class CompiledPredictCache:
-    """(version, bucket) → prepared predict callable, with hit/compile
-    accounting.  ``backend`` is 'jax' (device-resident jitted accumulate)
-    or 'cpu' (canonical numpy predict)."""
+    """(version, bucket, n_shards) → prepared predict callable, with
+    hit/compile accounting.  ``backend`` is 'jax' (device-resident jitted
+    accumulate, optionally sharded over ``mesh``) or 'cpu' (canonical
+    numpy predict)."""
 
     def __init__(self, backend: str = "cpu", metrics=None, *,
-                 min_bucket: int = 8, max_bucket: int = 4096):
+                 min_bucket: int = 8, max_bucket: int = 4096,
+                 mesh=None, sharded_threshold: Optional[int] = None):
         if backend not in ("jax", "cpu"):
             raise ValueError(f"unknown cache backend {backend!r}")
         self.backend = backend
@@ -55,60 +95,116 @@ class CompiledPredictCache:
         self.min_bucket = int(min_bucket)
         # cap must be a power of two so chunk remainders re-bucket cleanly
         self.max_bucket = 1 << (int(max_bucket) - 1).bit_length()
-        # one prepared callable per VERSION (the callable is shape-
-        # agnostic; on the jax path the per-shape specialization lives in
-        # jit's own cache) + per-(version, bucket) warmth accounting: the
-        # first call at a bucket shape is what triggers an XLA compile
-        self._fns: dict[int, object] = {}
-        self._warm: set[tuple[int, int]] = set()
+        # sharding: None threshold disables the sharded family entirely
+        self.mesh = mesh if backend == "jax" else None
+        self.n_shards = (int(np.prod(mesh.devices.shape))
+                         if self.mesh is not None else 1)
+        self.sharded_threshold = (None if sharded_threshold is None
+                                  else int(sharded_threshold))
+        # one prepared callable per (version, n_shards) — the callable is
+        # shape-agnostic; on the jax path the per-shape specialization
+        # lives in jit's own cache — plus per-(version, bucket, n_shards)
+        # warmth accounting: the first call at a bucket shape is what
+        # triggers an XLA compile.  The lock covers _fns/_warm: the
+        # collector thread inserts via _get while an admin thread may
+        # purge via evict_version
+        self._lock = threading.Lock()
+        self._fns: dict[tuple, object] = {}
+        self._warm: set[tuple] = set()
 
     @property
     def num_entries(self) -> int:
-        """Warm (version, bucket) pairs — compiled shapes, not closures."""
+        """Warm (version, bucket, shards) keys — compiled shapes, not
+        closures."""
         return len(self._warm)
 
     def buckets(self) -> list[int]:
-        """Every bucket size this cache can ever produce — the warmup set."""
+        """Every bucket size this cache can ever produce — the warmup set.
+        Routing to the shard arm is a pure function of the bucket, so
+        touching each bucket once warms both families completely."""
         out, b = [], self.min_bucket
         while b <= self.max_bucket:
             out.append(b)
             b <<= 1
         return out
 
+    def shards_for(self, bucket: int, num_outputs: int) -> int:
+        """Deterministic shard-arm routing: the sharded family only when a
+        mesh is attached, the bucket divides it, and the bucket carries
+        enough row-outputs of work to beat the single-device dispatch."""
+        if (self.mesh is None or self.sharded_threshold is None
+                or self.n_shards <= 1):
+            return 1
+        if bucket % self.n_shards != 0:
+            return 1
+        return (self.n_shards
+                if bucket * int(num_outputs) >= self.sharded_threshold else 1)
+
     # ---- prediction --------------------------------------------------------
-    def predict_raw(self, entry, Xb: np.ndarray) -> np.ndarray:
-        """Raw scores (n, K) fp32 for pre-binned rows, through the bucketed
-        compiled program; bitwise equal to the direct unpadded predict."""
+    def prepare_raw(self, entry, Xb: np.ndarray) -> PreparedPredict:
+        """HOST stage: chunk at max_bucket, bucket, zero-pad, and resolve
+        each chunk's compiled callable (warmth accounting happens here).
+        No device work — safe to overlap with an in-flight execute."""
         n = int(Xb.shape[0])
-        K = entry.num_outputs
-        if n == 0:
-            return np.zeros((0, K), np.float32)
-        out = np.empty((n, K), np.float32)
+        chunks = []
         for start in range(0, n, self.max_bucket):
             chunk = Xb[start:start + self.max_bucket]
             m = int(chunk.shape[0])
             b = bucket_rows(m, self.min_bucket, self.max_bucket)
-            fn = self._get(entry, b)
+            fn = self._get(entry, b, self.shards_for(b, entry.num_outputs))
             if m < b:
                 pad = np.zeros((b - m,) + chunk.shape[1:], chunk.dtype)
                 chunk = np.concatenate([np.ascontiguousarray(chunk), pad])
+            chunks.append((fn, chunk, start, m))
+        return PreparedPredict(entry, n, chunks)
+
+    def execute_raw(self, prepared: PreparedPredict) -> np.ndarray:
+        """DEVICE stage: run the compiled programs; the np.asarray inside
+        each ``fn`` is the single real host fetch per chunk."""
+        out = np.empty((prepared.n, prepared.entry.num_outputs), np.float32)
+        for fn, chunk, start, m in prepared.chunks:
             out[start:start + m] = fn(chunk)[:m]
         return out
 
+    def predict_raw(self, entry, Xb: np.ndarray) -> np.ndarray:
+        """Raw scores (n, K) fp32 for pre-binned rows, through the bucketed
+        compiled program; bitwise equal to the direct unpadded predict."""
+        if int(Xb.shape[0]) == 0:
+            return np.zeros((0, entry.num_outputs), np.float32)
+        return self.execute_raw(self.prepare_raw(entry, Xb))
+
     # ---- entry construction ------------------------------------------------
-    def _get(self, entry, bucket: int):
-        key = (entry.version, bucket)
-        hit = key in self._warm
-        if not hit:
-            self._warm.add(key)
+    def _get(self, entry, bucket: int, n_shards: int):
+        key = (entry.version, bucket, n_shards)
+        with self._lock:
+            hit = key in self._warm
+            if not hit:
+                self._warm.add(key)
+            fkey = (entry.version, n_shards)
+            fn = self._fns.get(fkey)
+            if fn is None:
+                # closure construction is cheap and pure (the compile
+                # happens at first call, outside the lock)
+                fn = (self._build_jax(entry, n_shards)
+                      if self.backend == "jax" else self._build_cpu(entry))
+                self._fns[fkey] = fn
         if self.metrics is not None:
-            self.metrics.record_cache(hit)
-        fn = self._fns.get(entry.version)
-        if fn is None:
-            fn = (self._build_jax(entry) if self.backend == "jax"
-                  else self._build_cpu(entry))
-            self._fns[entry.version] = fn
+            self.metrics.record_cache(hit, entry.version)
         return fn
+
+    def evict_version(self, version: int) -> None:
+        """Drop a version's prepared callables + warmth keys (model
+        unloaded): the closures hold the ModelEntry (and through it the
+        booster) alive, so an unload without this purge would leak every
+        co-served model ever retired.  (An in-flight _get racing this can
+        re-insert one tiny closure for the dead version, but the entry is
+        closed by then — its staged() raises, so nothing big gets pinned
+        and the in-flight group fails like any unloaded-mid-queue group.)"""
+        version = int(version)
+        with self._lock:
+            for key in [k for k in self._fns if k[0] == version]:
+                del self._fns[key]
+            self._warm -= {k for k in self._warm if k[0] == version}
 
     def _build_cpu(self, entry):
         from dryad_tpu.cpu.predict import predict_binned_cpu
@@ -120,25 +216,40 @@ class CompiledPredictCache:
 
         return fn
 
-    def _build_jax(self, entry):
+    def _build_jax(self, entry, n_shards: int):
+        import jax
         import jax.numpy as jnp
 
         from dryad_tpu.cpu.predict import rf_average
-        from dryad_tpu.engine.predict import _accumulate
+        from dryad_tpu.engine.predict import _accumulate, sharded_accumulate_fn
 
-        trees_dev, init_dev = entry.device_state()
-        _, _, n_iter = entry.staged()
         booster = entry.booster
         depth = max(booster.max_depth_seen, 1)
-        is_rf = booster.params.boosting == "rf" and n_iter > 0
+        is_rf = booster.params.boosting == "rf"
+        mesh = self.mesh if n_shards > 1 else None
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from dryad_tpu.engine.distributed import AXIS
+
+            acc = sharded_accumulate_fn(mesh, depth)
+            row_sharding = NamedSharding(mesh, P(AXIS, None))
 
         def fn(Xp):
-            # trees/init are device-resident arguments; jit specializes on
-            # the (bucket, F) shape of Xp — one XLA program per bucket
-            raw = np.asarray(_accumulate(trees_dev, jnp.asarray(Xp),
-                                         init_dev, depth))
+            # device_state is re-resolved EVERY call so a registry
+            # eviction's re-stage is picked up transparently — jit caches
+            # on shape/dtype, not array identity, so this never recompiles
+            trees_dev, init_dev = entry.device_state(mesh)
+            if mesh is not None:
+                Xd = jax.device_put(Xp, row_sharding)
+                raw = np.asarray(acc(trees_dev, Xd, init_dev))
+            else:
+                raw = np.asarray(_accumulate(trees_dev, jnp.asarray(Xp),
+                                             init_dev, depth))
             if is_rf:
-                raw = rf_average(raw, booster.init_score, n_iter)
+                _, _, n_iter = entry.staged()
+                if n_iter > 0:
+                    raw = rf_average(raw, booster.init_score, n_iter)
             return raw
 
         return fn

@@ -3,13 +3,25 @@
 Endpoints (JSON in/out):
 
     POST /predict            {"rows": [[...], ...], "raw": false,
-                              "version": null, "binned": false}
+                              "version": null, "model": null,
+                              "binned": false}
                              → {"predictions": [...], "version": v}
     GET  /stats              → PredictServer.stats() snapshot
-    GET  /models             → {"active": v, "versions": [...]}
-    POST /models/load        {"path": "...", "activate": true} → {"version": v}
+    GET  /models             → {"active": v, "versions": [...],
+                                "aliases": {...}}
+    POST /models/load        {"path": "...", "activate": true,
+                              "name": null} → {"version": v}
     POST /models/activate    {"version": v}
     POST /models/rollback    → {"version": v}
+
+Routing: ``version`` pins an exact registry version, ``model`` routes by
+registry name (multi-model co-serving); default is the active version.
+
+Structured request logging (off by default; ``log_requests=True`` or
+``--log-requests`` on the CLI) emits one JSON line per request to
+``log_stream``: method, path, status, resolved model version, row count,
+and wall latency — greppable operational telemetry without a logging
+dependency.
 
 This is an operational front door, not a wire-speed RPC layer: requests
 ride the same micro-batcher as in-process callers (ThreadingHTTPServer
@@ -21,6 +33,9 @@ transport belongs to the in-process API / npy files.
 from __future__ import annotations
 
 import json
+import sys
+import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as np
@@ -37,6 +52,27 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)
+        self._log_request(code)
+
+    def _log_request(self, status: int) -> None:
+        """One structured JSON line per completed request (flag-gated)."""
+        if not getattr(self.server, "log_requests", False):
+            return
+        line = json.dumps({
+            "ts": time.time(),
+            "method": self.command,
+            "path": self.path,
+            "status": int(status),
+            "version": getattr(self, "_req_version", None),
+            "rows": getattr(self, "_req_rows", None),
+            "latency_ms": round(
+                (time.perf_counter() - getattr(self, "_req_t0",
+                                               time.perf_counter())) * 1e3, 3),
+        })
+        stream = self.server.log_stream
+        with self.server.log_lock:
+            stream.write(line + "\n")
+            stream.flush()
 
     def _read_json(self) -> dict:
         length = int(self.headers.get("Content-Length") or 0)
@@ -49,16 +85,19 @@ class _Handler(BaseHTTPRequestHandler):
             super().log_message(fmt, *args)
 
     def do_GET(self):  # noqa: N802 — stdlib handler API
+        self._req_t0 = time.perf_counter()
         server = self.server.predict_server
         if self.path == "/stats":
             self._send(200, server.stats())
         elif self.path == "/models":
             self._send(200, {"active": server.registry.active_version,
-                             "versions": server.registry.versions()})
+                             "versions": server.registry.versions(),
+                             "aliases": server.registry.aliases()})
         else:
             self._send(404, {"error": f"unknown path {self.path}"})
 
     def do_POST(self):  # noqa: N802 — stdlib handler API
+        self._req_t0 = time.perf_counter()
         server = self.server.predict_server
         try:
             body = self._read_json()
@@ -67,11 +106,14 @@ class _Handler(BaseHTTPRequestHandler):
                 # the model's bin dtype (not float), and the response must
                 # name the version that actually served — not whatever is
                 # active by the time the batch returns
-                entry = server.registry.get(body.get("version"))
+                entry = server.registry.get(body.get("version"),
+                                            name=body.get("model"))
+                self._req_version = entry.version
                 binned = bool(body.get("binned", False))
                 rows = np.asarray(body["rows"],
                                   entry.booster.mapper.bin_dtype if binned
                                   else np.float32)
+                self._req_rows = int(rows.shape[0]) if rows.ndim > 1 else 1
                 preds = server.predict(
                     rows,
                     version=entry.version,
@@ -83,13 +125,18 @@ class _Handler(BaseHTTPRequestHandler):
                                  "version": entry.version})
             elif self.path == "/models/load":
                 version = server.load_model(
-                    body["path"], activate=bool(body.get("activate", True)))
+                    body["path"], activate=bool(body.get("activate", True)),
+                    name=body.get("name"))
+                self._req_version = version
                 self._send(200, {"version": version})
             elif self.path == "/models/activate":
                 server.activate(int(body["version"]))
+                self._req_version = int(body["version"])
                 self._send(200, {"version": int(body["version"])})
             elif self.path == "/models/rollback":
-                self._send(200, {"version": server.rollback()})
+                version = server.rollback()
+                self._req_version = version
+                self._send(200, {"version": version})
             else:
                 self._send(404, {"error": f"unknown path {self.path}"})
         except ServeOverloaded as e:
@@ -103,12 +150,16 @@ class _Handler(BaseHTTPRequestHandler):
 
 
 def make_http_server(predict_server, host: str = "127.0.0.1",
-                     port: int = 8000, *,
-                     verbose: bool = False) -> ThreadingHTTPServer:
+                     port: int = 8000, *, verbose: bool = False,
+                     log_requests: bool = False,
+                     log_stream=None) -> ThreadingHTTPServer:
     """Bind (port 0 picks a free one: ``httpd.server_address``); caller
     runs ``serve_forever()`` / ``shutdown()``."""
     httpd = ThreadingHTTPServer((host, port), _Handler)
     httpd.predict_server = predict_server
     httpd.verbose = verbose
+    httpd.log_requests = log_requests
+    httpd.log_stream = log_stream if log_stream is not None else sys.stderr
+    httpd.log_lock = threading.Lock()
     predict_server.start()
     return httpd

@@ -1,42 +1,111 @@
 """Serving metrics: thread-safe counters + a bounded latency reservoir.
 
 One ``ServeMetrics`` instance is shared by the server, the micro-batcher,
-and the compiled-predict cache; ``snapshot()`` is the stats API the CLI
-and the HTTP ``/stats`` endpoint expose.  Latency percentiles come from a
-fixed-size reservoir of the most recent request latencies (a deque, not a
-histogram) — exact over the window, O(window) only at snapshot time, and
-free of bucket-boundary error at the tails we care about (p99).
-"""
+the compiled-predict cache, and the model registry; ``snapshot()`` is the
+stats API the CLI and the HTTP ``/stats`` endpoint expose.  Latency
+percentiles come from a fixed-size reservoir of the most recent request
+latencies (a deque, not a histogram) — exact over the window, O(window)
+only at snapshot time, and free of bucket-boundary error at the tails we
+care about (p99).
+
+Multi-model co-serving adds a per-model ledger: every counter that can be
+attributed to a version (requests, rows, latencies, cache warmth,
+evictions/re-stages) is ALSO recorded under that version, so operators
+can see which resident model is earning its device memory.  The ledger
+lives here, NOT on the registry entry — eviction drops a model's staged
+arrays but must never drop its history (test-pinned)."""
 
 from __future__ import annotations
 
 import threading
 from collections import deque
+from typing import Optional
+
+
+def _pct(lat: list, p: float) -> float:
+    if not lat:
+        return 0.0
+    # nearest-rank on the reservoir
+    idx = min(len(lat) - 1, max(0, int(round(p * (len(lat) - 1)))))
+    return lat[idx] * 1e3
+
+
+class ModelStats:
+    """Per-version slice of the serving counters (guarded by the owning
+    ServeMetrics lock; never touched directly by callers)."""
+
+    __slots__ = ("requests", "rows", "latencies", "cache_hits",
+                 "cache_compiles", "evictions", "restages", "errors")
+
+    def __init__(self, latency_window: int = 512):
+        self.requests = 0
+        self.rows = 0
+        self.latencies = deque(maxlen=int(latency_window))
+        self.cache_hits = 0
+        self.cache_compiles = 0
+        self.evictions = 0
+        self.restages = 0
+        self.errors = 0
+
+    def snapshot(self) -> dict:
+        lat = sorted(self.latencies)
+        return {
+            "requests": self.requests,
+            "rows": self.rows,
+            "p50_ms": _pct(lat, 0.50),
+            "p99_ms": _pct(lat, 0.99),
+            "cache_hits": self.cache_hits,
+            "cache_compiles": self.cache_compiles,
+            "evictions": self.evictions,
+            "restages": self.restages,
+            "errors": self.errors,
+        }
 
 
 class ServeMetrics:
     def __init__(self, latency_window: int = 4096):
         self._lock = threading.Lock()
         self._latencies = deque(maxlen=int(latency_window))
+        # per-model reservoirs track the configured window but are capped
+        # at 512 each — the model count is unbounded, the global window
+        # is not
+        self._model_window = min(512, int(latency_window))
+        self._models: dict[int, ModelStats] = {}
         self.requests = 0          # completed requests (incl. empty)
         self.rows = 0              # rows predicted across completed requests
         self.batches = 0           # device dispatches by the micro-batcher
         self.batch_rows = 0        # rows across those dispatches
         self.batch_capacity = 0    # Σ max_batch_rows across dispatches
         self.cache_hits = 0        # bucket already compiled/prepared
-        self.cache_compiles = 0    # new (version, bucket) entries built
+        self.cache_compiles = 0    # new (version, bucket, shards) entries built
         self.timeouts = 0          # requests that gave up waiting
         self.rejected = 0          # requests refused by the bounded queue
         self.errors = 0            # requests that raised in dispatch
+        self.evictions = 0         # staged models dropped by the LRU budget
+        self.restages = 0          # evicted models staged again on demand
         self.queue_depth = 0       # last sampled queue depth
         self.queue_depth_peak = 0
 
+    def _model(self, version: Optional[int]) -> Optional[ModelStats]:
+        if version is None:
+            return None
+        ms = self._models.get(version)
+        if ms is None:
+            ms = self._models[version] = ModelStats(self._model_window)
+        return ms
+
     # ---- recording ---------------------------------------------------------
-    def record_request(self, n_rows: int, latency_s: float) -> None:
+    def record_request(self, n_rows: int, latency_s: float,
+                       version: Optional[int] = None) -> None:
         with self._lock:
             self.requests += 1
             self.rows += int(n_rows)
             self._latencies.append(float(latency_s))
+            ms = self._model(version)
+            if ms is not None:
+                ms.requests += 1
+                ms.rows += int(n_rows)
+                ms.latencies.append(float(latency_s))
 
     def record_batch(self, rows: int, capacity: int) -> None:
         with self._lock:
@@ -44,12 +113,31 @@ class ServeMetrics:
             self.batch_rows += int(rows)
             self.batch_capacity += int(capacity)
 
-    def record_cache(self, hit: bool) -> None:
+    def record_cache(self, hit: bool, version: Optional[int] = None) -> None:
         with self._lock:
+            ms = self._model(version)
             if hit:
                 self.cache_hits += 1
+                if ms is not None:
+                    ms.cache_hits += 1
             else:
                 self.cache_compiles += 1
+                if ms is not None:
+                    ms.cache_compiles += 1
+
+    def record_eviction(self, version: Optional[int] = None) -> None:
+        with self._lock:
+            self.evictions += 1
+            ms = self._model(version)
+            if ms is not None:
+                ms.evictions += 1
+
+    def record_restage(self, version: Optional[int] = None) -> None:
+        with self._lock:
+            self.restages += 1
+            ms = self._model(version)
+            if ms is not None:
+                ms.restages += 1
 
     def record_timeout(self) -> None:
         with self._lock:
@@ -59,9 +147,12 @@ class ServeMetrics:
         with self._lock:
             self.rejected += 1
 
-    def record_error(self) -> None:
+    def record_error(self, version: Optional[int] = None) -> None:
         with self._lock:
             self.errors += 1
+            ms = self._model(version)
+            if ms is not None:
+                ms.errors += 1
 
     def sample_queue_depth(self, depth: int) -> None:
         with self._lock:
@@ -71,17 +162,9 @@ class ServeMetrics:
     # ---- snapshot ----------------------------------------------------------
     def snapshot(self) -> dict:
         """One consistent dict of everything — counters plus derived rates.
-        Latency keys are milliseconds."""
+        Latency keys are milliseconds; ``models`` maps version → its slice."""
         with self._lock:
             lat = sorted(self._latencies)
-
-            def pct(p: float) -> float:
-                if not lat:
-                    return 0.0
-                # nearest-rank on the reservoir
-                idx = min(len(lat) - 1, max(0, int(round(p * (len(lat) - 1)))))
-                return lat[idx] * 1e3
-
             return {
                 "requests": self.requests,
                 "rows": self.rows,
@@ -89,14 +172,18 @@ class ServeMetrics:
                 "batch_rows": self.batch_rows,
                 "batch_fill_ratio": (self.batch_rows / self.batch_capacity
                                      if self.batch_capacity else 0.0),
-                "p50_ms": pct(0.50),
-                "p99_ms": pct(0.99),
+                "p50_ms": _pct(lat, 0.50),
+                "p99_ms": _pct(lat, 0.99),
                 "mean_ms": (sum(lat) / len(lat) * 1e3 if lat else 0.0),
                 "cache_hits": self.cache_hits,
                 "cache_compiles": self.cache_compiles,
                 "timeouts": self.timeouts,
                 "rejected": self.rejected,
                 "errors": self.errors,
+                "evictions": self.evictions,
+                "restages": self.restages,
                 "queue_depth": self.queue_depth,
                 "queue_depth_peak": self.queue_depth_peak,
+                "models": {v: ms.snapshot()
+                           for v, ms in sorted(self._models.items())},
             }

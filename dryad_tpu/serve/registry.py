@@ -1,18 +1,33 @@
-"""Model registry: versioned boosters with hot-swap and rollback.
+"""Model registry: versioned boosters with hot-swap, rollback, names,
+and a device-memory budget with LRU eviction of staged trees.
 
 Every loaded model gets a monotonically increasing integer version.  One
 version is *active* (the default for requests that don't pin a version);
 ``activate`` hot-swaps it and records the previous active version on a
-history stack so ``rollback`` is one call.  In-flight requests resolve
-their version at submit time, so a swap never changes a request that is
-already queued.
+history stack so ``rollback`` is one call.  A model may also carry a
+``name`` — a routing alias for multi-model co-serving ("fraud",
+"ranker-v2"); re-adding under the same name repoints the alias, and
+requests address either a pinned version or a name.  In-flight requests
+resolve their version at submit time, so a swap never changes a request
+that is already queued.
 
 An entry lazily stages its tree tables for the device predict path
 (``engine.predict.stage_trees``) and keeps them device-resident across
 requests — the staged arrays are uploaded once per (version, process),
 then passed as *arguments* to the jitted accumulate (never closed over:
-remote compile rejects large jit constants, see CLAUDE.md).
-"""
+remote compile rejects large jit constants, see CLAUDE.md).  For the
+sharded predict family the tables are replicated over the mesh once at
+stage time (``engine.distributed.replicate``) so per-dispatch transfers
+never happen.
+
+Co-serving many models cannot hold them ALL resident: ``budget_bytes``
+bounds the summed staged-table footprint, and crossing it evicts the
+least-recently-used staged entries.  Eviction drops ONLY the staged /
+device arrays — the booster, the version, its aliases, and its metrics
+history all survive, and the next request against an evicted version
+transparently re-stages it (staging is lazy anyway).  The active version
+and the entry that just staged are pinned, so the budget is best-effort:
+it can be exceeded transiently when everything resident is pinned."""
 
 from __future__ import annotations
 
@@ -26,61 +41,139 @@ class ModelEntry:
     """A registered model plus its lazily staged predict state."""
 
     def __init__(self, version: int, booster: Booster, path: Optional[str] = None,
-                 num_iteration: Optional[int] = None):
+                 num_iteration: Optional[int] = None,
+                 name: Optional[str] = None, registry=None):
         self.version = int(version)
         self.booster = booster
         self.path = path
+        self.name = name
         self.num_iteration = num_iteration
+        self.last_used = 0        # registry tick; LRU eviction order
+        self.closed = False       # set by unload: staging is over forever
+        self._registry = registry
         self._lock = threading.Lock()
-        self._staged = None      # (trees_np, init_np, n_iter)
-        self._device = None      # (trees_dev, init_dev)
+        self._staged = None       # (trees_np, init_np, n_iter)
+        self._device = {}         # mesh (or None) → (trees_dev, init_dev)
+        self._staged_bytes = 0
+        self._stage_count = 0     # >1 means the entry was re-staged post-evict
 
     @property
     def num_outputs(self) -> int:
         return self.booster.num_outputs
 
-    def staged(self):
-        """(trees, init, n_iter) reshaped numpy tables, built once."""
+    @property
+    def is_staged(self) -> bool:
         with self._lock:
+            return self._staged is not None
+
+    @property
+    def staged_bytes(self) -> int:
+        """The budget's accounting unit: the host staged tables plus one
+        mirror per device-state family built so far (a model warm on BOTH
+        the single-device and the sharded family holds two independent
+        device-0 copies).  Approximate by design — device copies built
+        after the triggering stage event are only counted at the NEXT
+        stage event — the budget is best-effort, not a hard cap."""
+        with self._lock:
+            if self._staged is None:
+                return 0
+            return self._staged_bytes * (1 + len(self._device))
+
+    def staged(self):
+        """(trees, init, n_iter) reshaped numpy tables, built once (again
+        after an eviction); notifies the registry so the budget can react."""
+        notify = False
+        with self._lock:
+            if self.closed:
+                # an unloaded entry must never re-stage (a stale compiled
+                # closure calling in would rebuild arrays nothing can free)
+                raise KeyError(
+                    f"model version {self.version} is not loaded")
             if self._staged is None:
                 from dryad_tpu.engine.predict import stage_trees
 
                 self._staged = stage_trees(self.booster, self.num_iteration)
-            return self._staged
+                trees_np, init_np, _ = self._staged
+                self._staged_bytes = (sum(v.nbytes for v in trees_np.values())
+                                      + init_np.nbytes)
+                self._stage_count += 1
+                notify = True
+            staged = self._staged
+        if notify and self._registry is not None:
+            self._registry._on_staged(self, restage=self._stage_count > 1)
+        return staged
 
-    def device_state(self):
+    def device_state(self, mesh=None):
         """Device-resident (trees, init) for the jit predict path; uploaded
-        once and reused by every bucket's compiled program."""
-        trees_np, init_np, _ = self.staged()
-        with self._lock:
-            if self._device is None:
-                import jax
+        once and reused by every bucket's compiled program.  ``mesh`` keys
+        the placement: None is the plain single-device upload, a Mesh gets
+        the tables replicated over it for the shard_map family."""
+        while True:
+            trees_np, init_np, _ = self.staged()
+            with self._lock:
+                if self._staged is None:
+                    # a concurrent budget eviction fired between staged()
+                    # and here; caching device copies now would leave them
+                    # resident but invisible to the budget accounting —
+                    # re-stage and retry instead
+                    continue
+                return self._device_locked(mesh, trees_np, init_np)
 
-                self._device = (
+    def _device_locked(self, mesh, trees_np, init_np):
+        state = self._device.get(mesh)
+        if state is None:
+            import jax
+
+            if mesh is not None:
+                from dryad_tpu.engine.distributed import replicate
+
+                state = (replicate(mesh, trees_np),
+                         replicate(mesh, init_np))
+            else:
+                state = (
                     {k: jax.device_put(v) for k, v in trees_np.items()},
                     jax.device_put(init_np),
                 )
-            return self._device
+            self._device[mesh] = state
+        return state
+
+    def evict_staged(self) -> int:
+        """Drop the staged + device arrays (model/stats stay); returns the
+        host bytes released.  The next ``staged()`` rebuilds lazily."""
+        with self._lock:
+            if self._staged is None:
+                return 0
+            freed = self._staged_bytes
+            self._staged = None
+            self._device = {}
+            self._staged_bytes = 0
+            return freed
 
 
 class ModelRegistry:
-    def __init__(self):
+    def __init__(self, budget_bytes: Optional[int] = None, metrics=None):
         self._lock = threading.Lock()
         self._models: dict[int, ModelEntry] = {}
+        self._aliases: dict[str, int] = {}
         self._active: Optional[int] = None
         self._history: list[int] = []   # previously active versions (for rollback)
         self._next_version = 1
+        self._tick = 0
+        self.budget_bytes = None if budget_bytes is None else int(budget_bytes)
+        self.metrics = metrics
 
     # ---- loading -----------------------------------------------------------
     def load(self, path: str, *, activate: bool = True,
-             num_iteration: Optional[int] = None) -> int:
+             num_iteration: Optional[int] = None,
+             name: Optional[str] = None) -> int:
         """Register a model from disk — binary checkpoint or text dump,
         sniffed by content (Booster.load_any).  Returns its version."""
         return self.add(Booster.load_any(path), path=path, activate=activate,
-                        num_iteration=num_iteration)
+                        num_iteration=num_iteration, name=name)
 
     def load_latest_checkpoint(self, directory: str, *, activate: bool = True,
-                               num_iteration: Optional[int] = None) -> int:
+                               num_iteration: Optional[int] = None,
+                               name: Optional[str] = None) -> int:
         """Register the newest checkpoint a ``Checkpointer`` left in
         ``directory`` (serving straight off a training run's snapshots)."""
         from dryad_tpu.checkpoint import Checkpointer
@@ -90,15 +183,20 @@ class ModelRegistry:
             raise FileNotFoundError(f"no checkpoints in {directory!r}")
         booster, it = latest
         return self.add(booster, path=f"{directory}@{it}", activate=activate,
-                        num_iteration=num_iteration)
+                        num_iteration=num_iteration, name=name)
 
     def add(self, booster: Booster, *, path: Optional[str] = None,
-            activate: bool = True, num_iteration: Optional[int] = None) -> int:
+            activate: bool = True, num_iteration: Optional[int] = None,
+            name: Optional[str] = None) -> int:
         with self._lock:
             version = self._next_version
             self._next_version += 1
             self._models[version] = ModelEntry(version, booster, path,
-                                               num_iteration)
+                                               num_iteration, name=name,
+                                               registry=self)
+            if name is not None:
+                # latest add under a name wins — that's the deploy gesture
+                self._aliases[str(name)] = version
             if activate or self._active is None:
                 if self._active is not None:
                     self._history.append(self._active)
@@ -134,11 +232,69 @@ class ModelRegistry:
             if version == self._active:
                 raise ValueError("cannot unload the active version; "
                                  "activate or rollback first")
-            self._models.pop(version, None)
+            entry = self._models.pop(version, None)
+            for alias, v in list(self._aliases.items()):
+                if v == version:
+                    del self._aliases[alias]
+        if entry is not None:
+            # free the staged/device arrays NOW: the registry forgets the
+            # entry, so the budget's victim scan could never reach these
+            # bytes again (a stale cache closure may still hold the entry
+            # object, but a closed, empty one — and PredictServer.unload
+            # also purges those closures)
+            entry.closed = True
+            entry.evict_staged()
+
+    # ---- memory budget -----------------------------------------------------
+    def _on_staged(self, entry: ModelEntry, restage: bool = False) -> None:
+        """Budget enforcement hook, called by an entry right after it stages
+        (outside the entry lock).  Victims are chosen under the registry
+        lock but evicted outside it — an evicting thread must never hold
+        the registry lock while waiting on an entry lock a concurrent
+        stage holds (lock-order inversion)."""
+        if restage and self.metrics is not None:
+            self.metrics.record_restage(entry.version)
+        if self.budget_bytes is None:
+            return
+        victims: list[ModelEntry] = []
+        with self._lock:
+            staged = [e for e in self._models.values() if e.staged_bytes > 0]
+            total = sum(e.staged_bytes for e in staged)
+            # LRU first; the active version and the just-staged entry are
+            # pinned (evicting what we are about to predict with would
+            # thrash the budget into a livelock)
+            for e in sorted(staged, key=lambda e: e.last_used):
+                if total <= self.budget_bytes:
+                    break
+                if e.version == self._active or e is entry:
+                    continue
+                victims.append(e)
+                total -= e.staged_bytes
+        for e in victims:
+            if e.evict_staged() > 0 and self.metrics is not None:
+                self.metrics.record_eviction(e.version)
+
+    def memory(self) -> dict:
+        """Budget observability: resident footprint + who is staged."""
+        with self._lock:
+            staged = {v: e.staged_bytes for v, e in self._models.items()
+                      if e.staged_bytes > 0}
+            return {
+                "budget_bytes": self.budget_bytes,
+                "staged_bytes": sum(staged.values()),
+                "staged_versions": sorted(staged),
+            }
 
     # ---- lookup ------------------------------------------------------------
-    def get(self, version: Optional[int] = None) -> ModelEntry:
+    def get(self, version: Optional[int] = None, *,
+            name: Optional[str] = None) -> ModelEntry:
         with self._lock:
+            if name is not None:
+                if version is not None:
+                    raise ValueError("pass either version or name, not both")
+                version = self._aliases.get(str(name))
+                if version is None:
+                    raise KeyError(f"no model named {name!r}")
             if version is None:
                 version = self._active
             if version is None:
@@ -146,6 +302,8 @@ class ModelRegistry:
             entry = self._models.get(int(version))
             if entry is None:
                 raise KeyError(f"model version {version} is not loaded")
+            self._tick += 1
+            entry.last_used = self._tick
             return entry
 
     @property
@@ -156,3 +314,7 @@ class ModelRegistry:
     def versions(self) -> list[int]:
         with self._lock:
             return sorted(self._models)
+
+    def aliases(self) -> dict:
+        with self._lock:
+            return dict(self._aliases)

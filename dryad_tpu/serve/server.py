@@ -1,16 +1,30 @@
 """PredictServer: the long-lived online-inference front object.
 
-Composes the registry (versioned, hot-swappable, device-resident
-models), the shape-bucketed compiled-predict cache, and the
-micro-batching queue behind one thread-safe ``predict`` call, with a
-``stats()`` snapshot for observability.  ``python -m dryad_tpu serve``
-wraps this in an HTTP front end (serve/http.py).
+Composes the registry (versioned, named, hot-swappable, device-resident
+models under an LRU memory budget), the shape-bucketed compiled-predict
+cache (single-device + sharded entry families), and the micro-batching
+queue's overlapped dispatch pipeline behind one thread-safe ``predict``
+call, with a ``stats()`` snapshot for observability.  ``python -m
+dryad_tpu serve`` wraps this in an HTTP front end (serve/http.py).
 
 Backend resolution ('auto') prefers the device path when an accelerator
 is attached and falls back gracefully to the canonical numpy predict
 when no device can be initialized — the serving semantics (bucketing,
 batching, metrics, bitwise parity with ``Booster.predict``) are
 identical on both paths.
+
+Sharded predict: on the device path with a multi-device mesh, buckets
+whose rows × outputs clear ``sharded_threshold`` run under ``shard_map``
+with rows split over the mesh (``sharded='auto'``; ``True`` forces every
+bucket onto the mesh, ``False`` disables it).  Small interactive batches
+stay on the single-device fast path either way.
+
+The dispatch pipeline splits each coalesced batch into ``_prepare``
+(host: group by version, concatenate, bucket-pad, resolve compiled
+entries) and ``_execute`` (device: run programs + the one real host
+fetch, then per-request slice/transform) so batch i+1's host work
+overlaps batch i's device work (batcher.py; ``pipeline_depth=1`` forces
+the old strictly serial loop, kept as the bench comparison arm).
 """
 
 from __future__ import annotations
@@ -51,21 +65,79 @@ def _resolve_backend(backend: str) -> str:
     return "jax" if any(d.platform != "cpu" for d in devices) else "cpu"
 
 
+class _PreparedGroup:
+    """One model-version group of a prepared batch (see _prepare)."""
+
+    __slots__ = ("idxs", "entry", "prepared", "row_counts", "raw_flags",
+                 "error")
+
+    def __init__(self, idxs, entry=None, prepared=None, row_counts=None,
+                 raw_flags=None, error=None):
+        self.idxs = idxs
+        self.entry = entry
+        self.prepared = prepared
+        self.row_counts = row_counts
+        self.raw_flags = raw_flags
+        self.error = error
+
+
 class PredictServer:
     def __init__(self, registry: Optional[ModelRegistry] = None, *,
                  backend: str = "auto", max_batch_rows: int = 4096,
                  max_wait_ms: float = 2.0, queue_size: int = 256,
-                 min_bucket: int = 8, latency_window: int = 4096):
-        self.registry = registry if registry is not None else ModelRegistry()
+                 min_bucket: int = 8, latency_window: int = 4096,
+                 pipeline_depth: int = 2, sharded="auto",
+                 sharded_threshold: Optional[int] = None,
+                 device_budget_bytes: Optional[int] = None):
         self.backend = _resolve_backend(backend)
         self.metrics = ServeMetrics(latency_window=latency_window)
+        if registry is not None:
+            self.registry = registry
+            # a caller-supplied registry still honors this server's budget
+            # unless it already carries its own
+            if (device_budget_bytes is not None
+                    and self.registry.budget_bytes is None):
+                self.registry.budget_bytes = int(device_budget_bytes)
+        else:
+            self.registry = ModelRegistry(budget_bytes=device_budget_bytes)
+        if self.registry.metrics is None:
+            self.registry.metrics = self.metrics
+        self.mesh = self._make_mesh(sharded)
+        if sharded_threshold is None:
+            from dryad_tpu.engine.predict import SHARDED_MIN_WORK
+
+            sharded_threshold = SHARDED_MIN_WORK
+        # threshold in rows × outputs; sharded=True forces the mesh arm for
+        # every bucket, False (or a 1-device mesh) disables it entirely.
+        # NOTE the interplay with max_batch_rows: buckets cap there, so at
+        # the default 4096-row cap 'auto' (32k row-outputs) shards only
+        # wide-output models (K >= 8) — by design: sharding a 4096-row
+        # binary dispatch is dispatch-bound and loses to the single-device
+        # program.  Giant-batch bulk scoring should raise max_batch_rows
+        # (or force sharded=True), which is what unlocks the mesh for K=1.
+        threshold = (None if self.mesh is None
+                     else 0 if sharded is True else int(sharded_threshold))
         self.cache = CompiledPredictCache(
             self.backend, self.metrics,
-            min_bucket=min_bucket, max_bucket=max_batch_rows)
+            min_bucket=min_bucket, max_bucket=max_batch_rows,
+            mesh=self.mesh, sharded_threshold=threshold)
         self.batcher = MicroBatcher(
-            self._dispatch, max_batch_rows=max_batch_rows,
+            self._dispatch, prepare=self._prepare, execute=self._execute,
+            pipeline_depth=pipeline_depth, max_batch_rows=max_batch_rows,
             max_wait_ms=max_wait_ms, queue_size=queue_size,
             metrics=self.metrics)
+
+    def _make_mesh(self, sharded):
+        if self.backend != "jax" or sharded is False:
+            return None
+        import jax
+
+        devices = jax.devices()
+        if len(devices) < 2:
+            return None
+        from dryad_tpu.engine.distributed import make_mesh
+
+        return make_mesh(devices)
 
     # ---- lifecycle ---------------------------------------------------------
     def start(self) -> "PredictServer":
@@ -83,9 +155,10 @@ class PredictServer:
 
     # ---- model lifecycle (thin registry passthroughs) ----------------------
     def load_model(self, path: str, *, activate: bool = True,
-                   num_iteration: Optional[int] = None) -> int:
+                   num_iteration: Optional[int] = None,
+                   name: Optional[str] = None) -> int:
         return self.registry.load(path, activate=activate,
-                                  num_iteration=num_iteration)
+                                  num_iteration=num_iteration, name=name)
 
     def activate(self, version: int) -> None:
         self.registry.activate(version)
@@ -93,60 +166,120 @@ class PredictServer:
     def rollback(self) -> int:
         return self.registry.rollback()
 
+    def unload(self, version: int) -> None:
+        """Unload a version AND purge its compiled-cache closures — the
+        registry alone cannot free those (they hold the entry alive)."""
+        self.registry.unload(version)
+        self.cache.evict_version(version)
+
     # ---- request path ------------------------------------------------------
     def predict(self, X: np.ndarray, *, version: Optional[int] = None,
-                raw_score: bool = False, binned: bool = False,
+                model: Optional[str] = None, raw_score: bool = False,
+                binned: bool = False,
                 timeout: Optional[float] = None) -> np.ndarray:
         """Predict through the full serving stack (bin → bucket → batch →
         compiled predict → link transform); bitwise equal to the direct
-        ``Booster.predict`` / ``predict_binned`` on the same rows."""
+        ``Booster.predict`` / ``predict_binned`` on the same rows.
+        Routing: ``version`` pins an exact version, ``model`` routes by
+        registry name; default is the active version."""
         self.start()
-        entry = self.registry.get(version)   # pin the version at submit time
+        # pin the version at submit time (a name is resolved here too, so
+        # a mid-queue re-deploy under the same name can't switch models)
+        entry = self.registry.get(version, name=model)
         X = np.asarray(X)
         if X.ndim == 1:
             X = X[None, :]
         if binned:
             Xb = np.ascontiguousarray(X)
         else:
-            Xb = entry.booster.mapper.transform(np.asarray(X, np.float32))
+            # binning is DEFERRED to _prepare: it rides the dispatch
+            # pipeline's host stage, overlapped with the in-flight device
+            # predict (dtype is coerced here so _prepare can concatenate
+            # requests without widening surprises)
+            Xb = np.ascontiguousarray(np.asarray(X, np.float32))
+        # validate the feature width HERE, in the caller's thread: binning
+        # is deferred into the coalesced _prepare, and without this check
+        # one malformed request would poison every co-batched request of
+        # the same version (raw width is the BASE mapper's for bundled
+        # mappers — transform folds it down to num_features)
+        mapper = entry.booster.mapper
+        nf = (mapper.num_features if binned
+              else getattr(mapper, "base", mapper).num_features)
+        if Xb.ndim != 2 or Xb.shape[1] != nf:
+            raise ValueError(
+                f"request shape {Xb.shape} does not match model version "
+                f"{entry.version}: expected (n, {nf}) "
+                f"{'binned' if binned else 'raw'} features")
         if Xb.shape[0] == 0:
             # empty request: no dispatch, same output shape/dtype contract
             t0 = time.perf_counter()
             raw = np.zeros((0, entry.num_outputs), np.float32)
             out = entry.booster.transform_raw(raw, raw_score=raw_score)
-            self.metrics.record_request(0, time.perf_counter() - t0)
+            self.metrics.record_request(0, time.perf_counter() - t0,
+                                        entry.version)
             return out
-        req = Request(Xb, version=entry.version, raw_score=raw_score)
+        req = Request(Xb, version=entry.version, raw_score=raw_score,
+                      binned=binned)
         return self.batcher.submit(req, timeout=timeout)
 
-    def _dispatch(self, batch: list[Request]) -> list[np.ndarray]:
-        """Coalesced batch → per-request outputs.  Requests are grouped by
-        model version (a hot-swap mid-queue may interleave versions); each
-        group is one concatenated bucketed predict, sliced back per
-        request.  Per-row arithmetic makes the slicing bitwise-exact."""
-        results: list = [None] * len(batch)
-        groups: dict[int, list[int]] = {}
+    # ---- dispatch (serial) / prepare + execute (pipeline) ------------------
+    def _prepare(self, batch: list[Request]) -> list[_PreparedGroup]:
+        """HOST stage: group the coalesced batch by (model version, binned)
+        — a hot-swap mid-queue may interleave versions — concatenate each
+        group's rows, BIN the raw-feature groups through the model's
+        frozen mapper, and run the cache's host-side bucket/pad + entry
+        resolution.  Binning is per-row, so batching it here is bitwise
+        equal to per-request binning.  A dead group (e.g. its version was
+        unloaded mid-queue) carries its error instead of poisoning the
+        batch."""
+        groups: dict[tuple, list[int]] = {}
         for i, req in enumerate(batch):
-            groups.setdefault(req.version, []).append(i)
-        for version, idxs in groups.items():
+            groups.setdefault((req.version, req.binned), []).append(i)
+        out = []
+        for (version, binned), idxs in groups.items():
             try:
                 entry = self.registry.get(version)
                 if len(idxs) == 1:
                     X = batch[idxs[0]].rows
                 else:
                     X = np.concatenate([batch[i].rows for i in idxs], axis=0)
-                raw = self.cache.predict_raw(entry, X)
+                if not binned:
+                    X = entry.booster.mapper.transform(X)
+                out.append(_PreparedGroup(
+                    idxs, entry, self.cache.prepare_raw(entry, X),
+                    [batch[i].rows.shape[0] for i in idxs],
+                    [batch[i].raw_score for i in idxs]))
+            except Exception as e:  # noqa: BLE001 — fail only this group
+                out.append(_PreparedGroup(idxs, error=e))
+        return out
+
+    def _execute(self, prepared: list[_PreparedGroup]) -> list:
+        """DEVICE stage: run each group's compiled programs (one real host
+        fetch per chunk inside the cache), then slice + link-transform per
+        request.  Per-row arithmetic makes the slicing bitwise-exact."""
+        n = 1 + max(i for g in prepared for i in g.idxs)
+        results: list = [None] * n
+        for g in prepared:
+            if g.error is not None:
+                for i in g.idxs:
+                    results[i] = g.error
+                continue
+            try:
+                raw = self.cache.execute_raw(g.prepared)
                 offset = 0
-                for i in idxs:
-                    n = batch[i].rows.shape[0]
-                    results[i] = entry.booster.transform_raw(
-                        raw[offset:offset + n], raw_score=batch[i].raw_score)
-                    offset += n
-            except Exception as e:  # noqa: BLE001 — e.g. a version unloaded
-                # mid-queue; fail only this group's requests, not the batch
-                for i in idxs:
+                for i, rows, raw_flag in zip(g.idxs, g.row_counts,
+                                             g.raw_flags):
+                    results[i] = g.entry.booster.transform_raw(
+                        raw[offset:offset + rows], raw_score=raw_flag)
+                    offset += rows
+            except Exception as e:  # noqa: BLE001 — fail only this group
+                for i in g.idxs:
                     results[i] = e
         return results
+
+    def _dispatch(self, batch: list[Request]) -> list:
+        """Serial-mode dispatch: the pipeline stages composed in-line."""
+        return self._execute(self._prepare(batch))
 
     # ---- observability -----------------------------------------------------
     def stats(self) -> dict:
@@ -154,5 +287,11 @@ class PredictServer:
         snap["backend"] = self.backend
         snap["active_version"] = self.registry.active_version
         snap["versions"] = self.registry.versions()
+        snap["aliases"] = self.registry.aliases()
         snap["compiled_buckets"] = self.cache.num_entries
+        snap["pipeline_depth"] = (self.batcher.pipeline_depth
+                                  if self.batcher.pipelined else 1)
+        snap["mesh_shards"] = self.cache.n_shards
+        snap["sharded_threshold"] = self.cache.sharded_threshold
+        snap["memory"] = self.registry.memory()
         return snap

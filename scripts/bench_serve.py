@@ -2,13 +2,28 @@
 
     PYTHONPATH=/root/.axon_site:/root/repo python scripts/bench_serve.py \
         [--model m.dryad] [--backend auto|tpu|cpu] [--clients 8] \
-        [--duration 5] [--max-batch-rows 256] [--max-wait-ms 1.0] \
-        [--sizes 1,3,9,17,40] [--json report.json]
+        [--duration 5] [--arms 2] [--max-batch-rows 256] [--max-wait-ms 1.0] \
+        [--sizes 1,3,9,17,40] [--pipeline-depth 2] [--compare] [--sharded] \
+        [--smoke] [--json report.json]
 
-Without --model it trains a small throwaway booster first.  Acceptance
-gate: a forced-CPU run must report ``recompiles_after_warmup: 0`` — the
-shape-bucketed cache makes warm traffic structurally recompile-free
-(bench.py warms every reachable bucket before measuring).
+Without --model it trains a small throwaway booster first.  The last
+stdout line is ONE flat JSON summary (bench.py's format) with rows/s,
+p50/p99, batch fill, recompile count, and the per-arm spread —
+``suspect_capture`` flags spread > 5% per CLAUDE.md.
+
+Arms:
+  --compare   pipeline-vs-serial A/B (records ``pipeline_speedup``;
+              ISSUE r7 acceptance wants ≥ 1.3× on CPU)
+  --sharded   adds a forced-sharded arm (backend tpu, every bucket on the
+              mesh — on CPU CI this is the 8 fake devices)
+  --smoke     short CI mode: tiny model, short loops, exit 1 unless BOTH
+              the bucketed and sharded arms report zero recompiles after
+              warmup (scripts/ci.sh runs this)
+
+Acceptance gate: a forced-CPU run must report
+``recompiles_after_warmup: 0`` — the shape-bucketed cache makes warm
+traffic structurally recompile-free (bench warms every reachable bucket
+before measuring, and shard-arm routing is deterministic per bucket).
 """
 
 from __future__ import annotations
@@ -18,14 +33,14 @@ import json
 import sys
 
 
-def _train_throwaway(n_rows: int = 4000):
+def _train_throwaway(n_rows: int = 4000, num_trees: int = 50):
     import dryad_tpu as dryad
     from dryad_tpu.datasets import higgs_like
 
     X, y = higgs_like(n_rows, seed=11)
     ds = dryad.Dataset(X, y, max_bins=64)
-    return dryad.train(dict(objective="binary", num_trees=50, num_leaves=31,
-                            max_bins=64), ds, backend="cpu")
+    return dryad.train(dict(objective="binary", num_trees=num_trees,
+                            num_leaves=31, max_bins=64), ds, backend="cpu")
 
 
 def main(argv=None) -> int:
@@ -34,29 +49,102 @@ def main(argv=None) -> int:
     ap.add_argument("--backend", default="cpu",
                     choices=["auto", "tpu", "cpu"])
     ap.add_argument("--clients", type=int, default=8)
-    ap.add_argument("--duration", type=float, default=5.0)
-    ap.add_argument("--max-batch-rows", type=int, default=256)
-    ap.add_argument("--max-wait-ms", type=float, default=1.0)
-    ap.add_argument("--sizes", default="1,3,9,17,40",
+    ap.add_argument("--duration", type=float, default=None)
+    ap.add_argument("--arms", type=int, default=2,
+                    help="measured-loop repetitions (per-arm spread)")
+    ap.add_argument("--max-batch-rows", type=int, default=None)
+    ap.add_argument("--max-wait-ms", type=float, default=None)
+    ap.add_argument("--sizes", default=None,
                     help="comma-separated request row sizes")
+    ap.add_argument("--pipeline-depth", type=int, default=2,
+                    help="overlapped dispatch run-ahead (1 = serial loop)")
+    ap.add_argument("--compare", action="store_true",
+                    help="pipeline-vs-serial A/B (pipeline_speedup)")
+    ap.add_argument("--sharded", action="store_true",
+                    help="add a forced-sharded arm (backend tpu over the "
+                         "mesh; CI runs it on the 8 fake CPU devices)")
+    ap.add_argument("--smoke", action="store_true",
+                    help="short CI mode: bucketed + sharded arms, exit 1 "
+                         "on any recompile after warmup")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--json", help="also write the report here")
     args = ap.parse_args(argv)
 
-    from dryad_tpu.serve.bench import run_bench
+    from dryad_tpu.serve.bench import run_bench, run_bench_compare, summary_line
 
-    model = args.model if args.model else _train_throwaway()
-    report = run_bench(
-        model, backend=args.backend, clients=args.clients,
-        duration_s=args.duration,
-        sizes=[int(s) for s in args.sizes.split(",")],
-        max_batch_rows=args.max_batch_rows, max_wait_ms=args.max_wait_ms,
-        seed=args.seed, verbose=True)
+    # --compare measures the BULK-scoring regime (the north star's "giant
+    # batches"): pow2-aligned requests big enough that both pipeline
+    # stages are dominated by GIL-releasing native/XLA work — that is
+    # where host/device overlap is physical rather than GIL-interleaved.
+    # Interactive-sized defaults otherwise.
+    if args.sizes is None:
+        args.sizes = "2048,4096" if args.compare else "1,3,9,17,40"
+    if args.max_batch_rows is None:
+        args.max_batch_rows = 4096 if args.compare else 256
+    if args.max_wait_ms is None:
+        args.max_wait_ms = 0.5 if args.compare else 1.0
+    if args.duration is None:
+        args.duration = 2.0 if args.compare else 5.0
+    if args.smoke:
+        args.duration = min(args.duration, 0.5)
+        args.arms = 1
+        args.clients = min(args.clients, 4)
+    model = args.model if args.model else _train_throwaway(
+        n_rows=1500 if args.smoke else 4000,
+        num_trees=20 if args.smoke else 50)
+    kw = dict(clients=args.clients, duration_s=args.duration,
+              sizes=[int(s) for s in args.sizes.split(",")],
+              max_batch_rows=args.max_batch_rows,
+              max_wait_ms=args.max_wait_ms, seed=args.seed, arms=args.arms,
+              verbose=not args.smoke)
+
+    report: dict
+    if args.compare:
+        report = run_bench_compare(model, backend=args.backend,
+                                   pipeline_depth=args.pipeline_depth, **kw)
+        summary = summary_line(report["pipeline"], "serve_pipeline")
+        summary["serial_rows_per_s"] = round(report["serial"]["rows_per_s"], 1)
+        summary["pipeline_speedup"] = report["pipeline_speedup"]
+        summary["suspect_capture"] = report["suspect_capture"]
+        # the exit gate must cover BOTH arms — a serial-only recompile
+        # regression would otherwise pass --compare runs silently
+        summary["recompiles_after_warmup"] = report["recompiles_after_warmup"]
+    else:
+        report = run_bench(model, backend=args.backend,
+                           pipeline_depth=args.pipeline_depth, **kw)
+        summary = summary_line(report, "serve")
+
+    if args.sharded:
+        # forced-sharded arm: every bucket takes the shard_map family
+        sharded_report = run_bench(model, backend="tpu", sharded=True,
+                                   pipeline_depth=args.pipeline_depth, **kw)
+        if args.smoke and sharded_report["mesh_shards"] <= 1:
+            # a 1-device mesh silently degrades this arm to a duplicate
+            # single-device check — the CI gate must not pass on that
+            print("ERROR: sharded smoke got a 1-device mesh (set "
+                  "XLA_FLAGS=--xla_force_host_platform_device_count=8)",
+                  file=sys.stderr)
+            return 1
+        report = {"bucketed": report, "sharded": sharded_report}
+        summary["sharded_rows_per_s"] = round(
+            sharded_report["rows_per_s"], 1)
+        summary["sharded_recompiles_after_warmup"] = (
+            sharded_report["recompiles_after_warmup"])
+        summary["mesh_shards"] = sharded_report["mesh_shards"]
+
     print(json.dumps(report, indent=1))
     if args.json:
         with open(args.json, "w") as f:
             json.dump(report, f, indent=1)
-    if report["recompiles_after_warmup"] != 0:
+    if summary.get("suspect_capture"):
+        print("WARNING: per-arm spread > 5% — suspect capture (CLAUDE.md)",
+              file=sys.stderr)
+    # the one-line summary is the LAST stdout line (bench.py's format)
+    print(json.dumps(summary))
+
+    recompiles = summary.get("recompiles_after_warmup", 0)
+    recompiles += summary.get("sharded_recompiles_after_warmup", 0)
+    if recompiles != 0:
         print("WARNING: cache recompiled after warmup", file=sys.stderr)
         return 1
     return 0

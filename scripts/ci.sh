@@ -17,4 +17,31 @@ if grep -nE 'tile_plan' dryad_tpu/engine/levelwise.py; then
   exit 1
 fi
 
+# Serving dispatch-loop lint (r7): the batcher must never touch the
+# device result itself — the ONE real host fetch per chunk lives in the
+# cache's execute stage (np.asarray on the raw scores).  A fetch growing
+# back into the collect/dispatch loop would serialize the overlapped
+# pipeline (and block_until_ready returns instantly on the tunnel, so it
+# is banned everywhere in serve/ — CLAUDE.md measuring notes).
+if grep -rnE '\.block_until_ready\(' dryad_tpu/serve/; then
+  echo "LINT FAIL: serve/ uses block_until_ready (lies on the tunnel; use a real fetch)" >&2
+  exit 1
+fi
+if grep -nE 'np\.asarray|asnumpy|device_get|import jax' dryad_tpu/serve/batcher.py; then
+  echo "LINT FAIL: serve/batcher.py grew a device fetch — the single result fetch belongs in cache.execute_raw" >&2
+  exit 1
+fi
+
+# Serving bench smoke (r7): zero recompiles after warmup across BOTH the
+# bucketed (forced-CPU) and sharded (8 fake devices) compiled-entry
+# families — warm traffic must be structurally recompile-free.
+if ! env JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}" \
+    python scripts/bench_serve.py --smoke --sharded > /tmp/_serve_smoke.log 2>&1; then
+  echo "SERVE SMOKE FAIL: bench_serve --smoke --sharded (see /tmp/_serve_smoke.log)" >&2
+  tail -5 /tmp/_serve_smoke.log >&2
+  exit 1
+fi
+tail -1 /tmp/_serve_smoke.log
+
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc

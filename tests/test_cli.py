@@ -92,6 +92,32 @@ def test_cli_serve_one_shot_smoke(paths):
     assert served.dtype == direct.dtype and np.array_equal(served, direct)
 
 
+def test_cli_serve_r7_flags_and_named_models(paths):
+    """r7 serving flags ride the one-shot path: NAME=path model aliases,
+    --pipeline-depth 1 (serial loop), --sharded off, --device-budget-mb —
+    output stays bitwise equal to the pipelined default."""
+    model = str(paths / "m.dryad")
+    rc = main([
+        "train", "--config", str(paths / "cfg.json"),
+        "--data", str(paths / "X.npy"), "--label", str(paths / "y.npy"),
+        "--model", model, "--backend", "cpu", "--quiet",
+    ])
+    assert rc == 0
+    rc = main(["serve", "--model", f"champion={model}", "--backend", "cpu",
+               "--pipeline-depth", "1", "--sharded", "off",
+               "--device-budget-mb", "64", "--max-batch-rows", "64",
+               "--request", str(paths / "X.npy"),
+               "--out", str(paths / "served_serial.npy"), "--quiet"])
+    assert rc == 0
+    rc = main(["serve", "--model", model, "--backend", "cpu",
+               "--max-batch-rows", "64", "--request", str(paths / "X.npy"),
+               "--out", str(paths / "served_piped.npy"), "--quiet"])
+    assert rc == 0
+    a = np.load(paths / "served_serial.npy")
+    b = np.load(paths / "served_piped.npy")
+    assert np.array_equal(a, b)
+
+
 def test_cli_serve_arg_parsing(paths, capsys):
     with pytest.raises(SystemExit):                # --model is required
         main(["serve"])

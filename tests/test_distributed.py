@@ -161,3 +161,21 @@ def test_sharded_weighted_parity(mesh):
     b8 = train_device(p, ds, mesh=mesh)
     np.testing.assert_array_equal(b1.feature, b8.feature)
     np.testing.assert_array_equal(b1.threshold, b8.threshold)
+
+
+def test_sharded_predict_bitwise(mesh):
+    """r7 serving tentpole anchor: shard_map predict over the mesh is
+    bitwise equal to the CPU reference — raw scores are per-row, so row
+    sharding (incl. the zero-bin padding for non-divisible batches) is a
+    pure shape game (tests/test_serve_sharded.py covers the serving
+    layer; this pins the engine primitive next to its training peers)."""
+    from dryad_tpu.engine.predict import predict_binned_sharded
+
+    X, y = higgs_like(2001)   # 2001 % 8 != 0
+    ds = dryad.Dataset(X, y, max_bins=64)
+    b = dryad.train(dict(objective="binary", num_trees=6, num_leaves=15,
+                         max_bins=64), ds, backend="cpu")
+    Xb = ds.X_binned
+    ref = b.predict_binned(Xb, raw_score=True)
+    sharded = np.asarray(predict_binned_sharded(b, Xb, mesh=mesh))[:, 0]
+    assert np.array_equal(sharded, ref)

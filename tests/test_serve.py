@@ -248,6 +248,270 @@ def test_dispatch_error_propagates():
     batcher.stop()
 
 
+def test_pipeline_and_serial_dispatch_agree(model):
+    """The overlapped two-deep pipeline returns the same bits as the
+    strictly serial loop — pipelining changes WHEN a batch runs, never
+    what runs."""
+    booster, X = model
+    outs = {}
+    for depth in (1, 2, 3):
+        server = PredictServer(backend="cpu", max_batch_rows=32,
+                               max_wait_ms=0.5, pipeline_depth=depth)
+        server.registry.add(booster)
+        with server:
+            outs[depth] = [server.predict(X[:n]) for n in (1, 9, 33)]
+        assert server.stats()["pipeline_depth"] == (depth if depth >= 2 else 1)
+    for n_i in range(3):
+        direct = booster.predict(X[: (1, 9, 33)[n_i]])
+        for depth in (1, 2, 3):
+            assert np.array_equal(outs[depth][n_i], direct), depth
+
+
+def test_pipeline_concurrent_bitwise(model):
+    """Concurrent load through the pipeline: request-exact answers while
+    collector and executor overlap."""
+    booster, X = model
+    server = PredictServer(backend="cpu", max_batch_rows=64, max_wait_ms=5.0,
+                           pipeline_depth=2, queue_size=64)
+    server.registry.add(booster)
+    sizes = [1, 3, 5, 8, 13, 21]
+    outs: dict[int, np.ndarray] = {}
+    start = threading.Barrier(len(sizes))
+
+    def worker(i, n):
+        start.wait()
+        outs[i] = server.predict(X[i:i + n])
+
+    with server:
+        threads = [threading.Thread(target=worker, args=(i, n))
+                   for i, n in enumerate(sizes)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    for i, n in enumerate(sizes):
+        assert np.array_equal(outs[i], booster.predict(X[i:i + n]))
+
+
+def test_registry_budget_evicts_lru_not_active(model, model_multiclass):
+    """Device-memory budget: staging past the budget evicts the LRU staged
+    entry; the active version is pinned; an evicted model transparently
+    re-stages on its next request with bitwise-identical output; its
+    metrics history survives eviction."""
+    booster_a, X = model
+    booster_b, Xm = model_multiclass
+    reg = ModelRegistry(budget_bytes=1)       # everything non-pinned evicts
+    server = PredictServer(reg, backend="tpu", max_wait_ms=0.2)
+    vA = reg.add(booster_a)                   # active
+    vB = reg.add(booster_b, activate=False, name="challenger")
+    with server:
+        outB1 = server.predict(Xm[:5], version=vB)
+        eA, eB = reg.get(vA), reg.get(vB)
+        assert eB.is_staged
+        server.predict(X[:5])                 # stages A → B is the LRU victim
+        assert not eB.is_staged, "inactive LRU entry must be evicted"
+        assert eA.is_staged, "active version is pinned"
+        reqs_before = server.stats()["models"][vB]["requests"]
+        outB2 = server.predict(Xm[:5], version=vB)   # transparent re-stage
+        assert eB.is_staged
+        assert np.array_equal(outB1, outB2)
+        assert np.array_equal(outB2, booster_b.predict(Xm[:5]))
+    snap = server.stats()
+    assert snap["evictions"] >= 1 and snap["restages"] >= 1
+    mB = snap["models"][vB]
+    assert mB["evictions"] >= 1 and mB["restages"] >= 1
+    assert mB["requests"] == reqs_before + 1, "stats must survive eviction"
+    assert snap["memory"]["budget_bytes"] == 1
+
+
+def test_unbudgeted_registry_never_evicts(model, model_multiclass):
+    booster_a, X = model
+    booster_b, Xm = model_multiclass
+    server = PredictServer(backend="tpu", max_wait_ms=0.2)
+    vA = server.registry.add(booster_a)
+    vB = server.registry.add(booster_b, activate=False)
+    with server:
+        server.predict(Xm[:5], version=vB)
+        server.predict(X[:5], version=vA)
+    assert server.registry.get(vA).is_staged
+    assert server.registry.get(vB).is_staged
+    assert server.stats()["evictions"] == 0
+    assert server.stats()["memory"]["staged_versions"] == [vA, vB]
+
+
+def test_named_model_routing(model, model_multiclass):
+    """Multi-model co-serving routes by name; re-adding under the same
+    name repoints the alias (deploy gesture); unload drops the alias."""
+    booster_a, X = model
+    booster_b, Xm = model_multiclass
+    server = PredictServer(backend="cpu", max_wait_ms=0.2)
+    v1 = server.registry.add(booster_a, name="champion")
+    v2 = server.registry.add(booster_b, activate=False, name="challenger")
+    with server:
+        assert np.array_equal(server.predict(X[:5], model="champion"),
+                              booster_a.predict(X[:5]))
+        assert np.array_equal(server.predict(Xm[:5], model="challenger"),
+                              booster_b.predict(Xm[:5]))
+        with pytest.raises(KeyError):
+            server.predict(X[:2], model="nobody")
+        with pytest.raises(ValueError):
+            server.predict(X[:2], version=v1, model="champion")
+        v3 = server.registry.add(booster_b, activate=False, name="champion")
+        assert np.array_equal(server.predict(Xm[:5], model="champion"),
+                              booster_b.predict(Xm[:5]))
+        assert server.registry.aliases() == {"champion": v3,
+                                             "challenger": v2}
+        server.registry.unload(v2)
+        assert server.registry.aliases() == {"champion": v3}
+
+
+def test_unload_frees_staged_and_cache_entries(model, model_multiclass):
+    """Unloading a co-served model must actually release it: the registry
+    drops its staged/device arrays immediately (the budget can never
+    reach them again) and server.unload purges the compiled-cache
+    closures that would otherwise pin the entry alive."""
+    booster_a, X = model
+    booster_b, Xm = model_multiclass
+    server = PredictServer(backend="tpu", max_wait_ms=0.2)
+    vA = server.registry.add(booster_a)
+    vB = server.registry.add(booster_b, activate=False, name="retired")
+    with server:
+        server.predict(Xm[:5], version=vB)
+        entry_b = server.registry.get(vB)
+        assert entry_b.is_staged
+        assert any(k[0] == vB for k in server.cache._fns)
+        server.unload(vB)
+        assert not entry_b.is_staged, "unload must free the staged arrays"
+        assert not any(k[0] == vB for k in server.cache._fns)
+        assert not any(k[0] == vB for k in server.cache._warm)
+        assert server.registry.aliases() == {}
+        # the survivor still serves, bitwise
+        assert np.array_equal(server.predict(X[:5]), booster_a.predict(X[:5]))
+
+
+def test_malformed_request_fails_alone(model):
+    """Width validation happens at submit time, in the caller's thread:
+    binning is deferred into the coalesced _prepare, so without the check
+    one wrong-width request would poison every co-batched request of the
+    same version."""
+    booster, X = model
+    server = PredictServer(backend="cpu", max_batch_rows=64, max_wait_ms=20.0)
+    server.registry.add(booster)
+    results: dict = {}
+    start = threading.Barrier(2)
+
+    def good():
+        start.wait()
+        results["good"] = server.predict(X[:5])
+
+    def bad():
+        start.wait()
+        try:
+            server.predict(X[:3, :-1])          # one feature short
+            results["bad"] = "no error"
+        except ValueError as e:
+            results["bad"] = e
+
+    with server:
+        threads = [threading.Thread(target=good), threading.Thread(target=bad)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert isinstance(results["bad"], ValueError)
+        assert np.array_equal(results["good"], booster.predict(X[:5]))
+        with pytest.raises(ValueError, match="expected"):
+            server.predict(booster.mapper.transform(X[:2])[:, :-1],
+                           binned=True)
+
+
+def test_per_model_stats(model, model_multiclass):
+    booster_a, X = model
+    booster_b, Xm = model_multiclass
+    server = PredictServer(backend="cpu", max_wait_ms=0.2)
+    v1 = server.registry.add(booster_a)
+    v2 = server.registry.add(booster_b, activate=False)
+    with server:
+        for _ in range(3):
+            server.predict(X[:4], version=v1)
+        server.predict(Xm[:7], version=v2)
+    snap = server.stats()
+    assert snap["models"][v1]["requests"] == 3
+    assert snap["models"][v1]["rows"] == 12
+    assert snap["models"][v2]["requests"] == 1
+    assert snap["models"][v2]["rows"] == 7
+    assert snap["models"][v2]["p99_ms"] >= 0.0
+
+
+def test_bench_compare_pipeline_vs_serial(model):
+    """The A/B harness reports both arms + the speedup field and stays
+    recompile-free; the ≥1.3× acceptance number itself is recorded by
+    scripts/bench_serve.py --compare (timing asserts would be flaky in
+    a shared CI container)."""
+    from dryad_tpu.serve import run_bench_compare
+
+    booster, X = model
+    report = run_bench_compare(booster, backend="cpu", clients=3,
+                               duration_s=0.3, sizes=(1, 5, 9),
+                               max_batch_rows=32, max_wait_ms=1.0, seed=0,
+                               arms=2, feature_pool=X)
+    assert report["recompiles_after_warmup"] == 0
+    assert report["serial"]["pipeline_depth"] == 1
+    assert report["pipeline"]["pipeline_depth"] == 2
+    assert report["pipeline_speedup"] > 0
+    for arm in ("serial", "pipeline"):
+        assert report[arm]["bench_arms"] == 2
+        assert "spread_rows_per_s" in report[arm]
+        assert isinstance(report[arm]["suspect_capture"], bool)
+
+
+def test_http_structured_request_logging(model):
+    """--log-requests emits one JSON line per request with version, rows,
+    latency, and status (including error statuses)."""
+    import io
+    import json
+    import urllib.error
+    import urllib.request
+
+    from dryad_tpu.serve.http import make_http_server
+
+    booster, X = model
+    server = PredictServer(backend="cpu", max_wait_ms=0.5)
+    server.registry.add(booster)
+    stream = io.StringIO()
+    httpd = make_http_server(server, port=0, log_requests=True,
+                             log_stream=stream)
+    port = httpd.server_address[1]
+    thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+    thread.start()
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/predict",
+            data=json.dumps({"rows": X[:3].tolist()}).encode(),
+            headers={"Content-Type": "application/json"})
+        urllib.request.urlopen(req, timeout=10).read()
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(urllib.request.Request(
+                f"http://127.0.0.1:{port}/predict",
+                data=json.dumps({"rows": X[:2].tolist(),
+                                 "version": 99}).encode(),
+                headers={"Content-Type": "application/json"}), timeout=10)
+        urllib.request.urlopen(f"http://127.0.0.1:{port}/stats",
+                               timeout=10).read()
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        server.stop()
+    lines = [json.loads(l) for l in stream.getvalue().splitlines()]
+    assert len(lines) == 3
+    ok = lines[0]
+    assert ok["path"] == "/predict" and ok["status"] == 200
+    assert ok["version"] == 1 and ok["rows"] == 3
+    assert ok["latency_ms"] >= 0
+    assert lines[1]["status"] == 400 and lines[1]["version"] is None
+    assert lines[2]["path"] == "/stats" and lines[2]["status"] == 200
+
+
 def test_bench_serve_zero_recompiles_after_warmup(model):
     """Acceptance gate: the closed-loop bench on forced CPU reports zero
     recompiles after warmup — warm traffic only ever hits warm buckets."""

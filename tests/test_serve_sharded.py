@@ -1,0 +1,151 @@
+"""Sharded predict parity (ISSUE r7): shard_map inference over the 8
+fake-CPU-device mesh must be BITWISE equal to the single-device predict —
+rows are padded with zero bins to divide the mesh, trees are replicated,
+and every predict stage is per-row, so sharding is a shape game that
+cannot change a bit (the same structural argument as bucket padding).
+
+Also pins the serving-layer integration: the (version, bucket, n_shards)
+compiled-entry family, deterministic threshold routing (small interactive
+buckets stay on the single-device fast path), and recompile-free warm
+traffic across BOTH shard arms."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+
+import dryad_tpu as dryad
+from dryad_tpu.datasets import higgs_like
+from dryad_tpu.serve import PredictServer
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    from dryad_tpu.engine.distributed import make_mesh
+
+    assert len(jax.devices()) >= 8, "conftest must provide 8 virtual devices"
+    return make_mesh(jax.devices()[:8])
+
+
+@pytest.fixture(scope="module")
+def model():
+    X, y = higgs_like(600, seed=7)
+    ds = dryad.Dataset(X, y, max_bins=32)
+    booster = dryad.train(dict(objective="binary", num_trees=8, num_leaves=7,
+                               max_bins=32), ds, backend="cpu")
+    return booster, X
+
+
+@pytest.fixture(scope="module")
+def model_multiclass():
+    rng = np.random.default_rng(3)
+    X = rng.standard_normal((500, 8)).astype(np.float32)
+    y = (X[:, 0] + X[:, 1] > 0).astype(np.float32) + (X[:, 2] > 0.5)
+    ds = dryad.Dataset(X, y, max_bins=32)
+    booster = dryad.train(dict(objective="multiclass", num_class=3,
+                               num_trees=4, num_leaves=7, max_bins=32),
+                          ds, backend="cpu")
+    return booster, X
+
+
+def test_engine_sharded_bitwise(mesh, model):
+    """predict_binned_sharded == single-device == CPU, bitwise, including
+    batches that do NOT divide the 8-way mesh (padding must not leak)."""
+    from dryad_tpu.engine.predict import predict_binned_sharded
+
+    booster, X = model
+    Xb = booster.mapper.transform(X)
+    for n in (1, 7, 8, 9, 13, 600):       # 1-row, sub-mesh, non-divisible
+        ref = booster.predict_binned(Xb[:n], raw_score=True)
+        single = booster.predict_binned(Xb[:n], raw_score=True, backend="tpu")
+        sharded = np.asarray(predict_binned_sharded(booster, Xb[:n],
+                                                    mesh=mesh))[:, 0]
+        assert np.array_equal(sharded, ref), n
+        assert np.array_equal(sharded, single), n
+
+
+def test_booster_predict_sharded_passthrough(mesh, model_multiclass):
+    """Booster.predict(..., backend='tpu', sharded=True) — multiclass K=3,
+    non-divisible rows, link transform included."""
+    booster, X = model_multiclass
+    for n in (1, 9, 13, 500):
+        ref = booster.predict(X[:n])
+        got = booster.predict(X[:n], backend="tpu", sharded=True)
+        assert got.shape == (n, 3)
+        assert np.array_equal(got, ref), n
+
+
+@pytest.mark.parametrize("batch_mode", ["forced", "auto"])
+def test_server_sharded_parity(model, batch_mode):
+    """Serving through the sharded compiled-entry family is bitwise equal
+    to the direct predict at 1-row, bucket-boundary, and chunked sizes;
+    'auto' keeps small buckets on the single-device arm (threshold gate),
+    'forced' puts every bucket on the mesh."""
+    booster, X = model
+    kw = (dict(sharded=True) if batch_mode == "forced"
+          else dict(sharded="auto", sharded_threshold=32))
+    server = PredictServer(backend="tpu", max_batch_rows=64, max_wait_ms=0.5,
+                           min_bucket=8, **kw)
+    server.registry.add(booster)
+    with server:
+        for n in (1, 7, 8, 9, 16, 17, 33, 64, 100):
+            for raw in (False, True):
+                direct = booster.predict(X[:n], raw_score=raw)
+                served = server.predict(X[:n], raw_score=raw)
+                assert served.dtype == direct.dtype
+                assert served.shape == direct.shape
+                assert np.array_equal(served, direct), (batch_mode, n, raw)
+    snap = server.stats()
+    assert snap["mesh_shards"] == 8
+    shard_arms = {k[2] for k in server.cache._warm}
+    if batch_mode == "forced":
+        assert shard_arms == {8}              # every bucket on the mesh
+    else:
+        # threshold 32 row-outputs: buckets 8/16 single-device, 32/64 sharded
+        assert shard_arms == {1, 8}
+        assert (1, 8, 1) in server.cache._warm
+        assert (1, 64, 8) in server.cache._warm
+
+
+def test_server_sharded_multiclass_binned(model_multiclass):
+    booster, X = model_multiclass
+    Xb = booster.mapper.transform(X)
+    server = PredictServer(backend="tpu", sharded=True, max_batch_rows=32,
+                           max_wait_ms=0.2)
+    server.registry.add(booster)
+    with server:
+        for n in (1, 9, 33):
+            direct = booster.predict_binned(Xb[:n])
+            served = server.predict(Xb[:n], binned=True)
+            assert direct.shape == (n, 3) and np.array_equal(served, direct)
+
+
+def test_sharded_threshold_keeps_interactive_on_fast_path(model):
+    """Default 'auto' threshold (32k row-outputs) routes small-bucket
+    interactive traffic to the single-device arm only."""
+    booster, X = model
+    server = PredictServer(backend="tpu", max_batch_rows=64, max_wait_ms=0.2)
+    server.registry.add(booster)
+    with server:
+        server.predict(X[:40])
+    assert {k[2] for k in server.cache._warm} == {1}
+
+
+def test_sharded_warm_traffic_never_recompiles(model):
+    """Zero recompiles after warmup across the sharded family: warm every
+    bucket once, then replay mixed sizes — compile count must not move."""
+    booster, X = model
+    server = PredictServer(backend="tpu", sharded=True, max_batch_rows=32,
+                           max_wait_ms=0.2)
+    server.registry.add(booster)
+    with server:
+        for b in server.cache.buckets():
+            server.predict(X[:b])
+        compiles = server.stats()["cache_compiles"]
+        for n in (1, 5, 9, 17, 30, 33, 64):
+            server.predict(X[:n])
+        snap = server.stats()
+    assert snap["cache_compiles"] == compiles
+    assert snap["cache_hits"] > 0
