@@ -215,6 +215,45 @@ def main() -> None:
         "trees_timed": trees,
     }
 
+    # ---- supervisor overhead (r8: the wrapper must be free on the hot path)
+    # supervised vs direct short run, NO faults, BOTH arms checkpointed the
+    # same way so the delta isolates the supervisor wrapper itself
+    # (classification plumbing, journal-less hook threading, the retry
+    # loop's bookkeeping) — not checkpoint I/O.
+    import tempfile
+
+    from dryad_tpu.resilience import supervise_train
+
+    # a deliberately SHORT config (sub-second arms) so the wrapper's fixed
+    # per-run cost is measured against a small noise floor — the wrapper
+    # adds only host bookkeeping (one Checkpointer.latest probe, a hook
+    # call per chunk/fetch, the retry-loop frame), none of it scaling with
+    # rows, so a short run bounds the long-run overhead from above.
+    # Per-arm min of 3 (stalls only ever ADD time) + spread observability.
+    p_sup = params.replace(num_trees=8, num_leaves=15, max_depth=4)
+    ds_sup = dryad.Dataset(X[:10_000], y[:10_000], max_bins=64)
+    with tempfile.TemporaryDirectory() as td:
+        dryad.train(p_sup, ds_sup, backend="tpu",                # warm/compile
+                    checkpoint_dir=td + "/w", checkpoint_every=4)
+
+        def arm(kind: str, i: int) -> float:
+            ck = f"{td}/{kind}{i}"
+            t0 = time.perf_counter()
+            if kind == "sup":
+                supervise_train(p_sup, ds_sup, backend="tpu",
+                                checkpoint_dir=ck, checkpoint_every=4)
+            else:
+                dryad.train(p_sup, ds_sup, backend="tpu",
+                            checkpoint_dir=ck, checkpoint_every=4)
+            return time.perf_counter() - t0
+
+        directs = [arm("direct", i) for i in range(3)]
+        sups = [arm("sup", i) for i in range(3)]
+    out["supervisor_overhead_ms"] = round(
+        (min(sups) - min(directs)) * 1000, 1)
+    out["supervisor_overhead_spread"] = round(
+        max(max(directs) / min(directs), max(sups) / min(sups)) - 1, 3)
+
     # ---- 10M-row warm marginal (the BASELINE.json:2 scale) ------------------
     if os.environ.get("BENCH_10M", "1") != "0" and rows == 200_000:
         del X, y, ds  # host copies of the 200k run are dead weight now

@@ -40,6 +40,9 @@ def train(
     checkpoint_every: int = 10,
     resume: bool = False,
     profile_dir: Optional[str] = None,
+    chunk_hook=None,
+    chunk_policy=None,
+    mesh=None,
     **kw: Any,
 ) -> Booster:
     """Train a booster.  backend: 'auto' (TPU if available), 'tpu', 'cpu'.
@@ -52,6 +55,12 @@ def train(
     dryad_tpu/callbacks.py); ``callback`` remains as a single-function alias.
     ``profile_dir`` captures a jax.profiler trace of the whole training run
     (open with XProf/Perfetto — SURVEY.md §5 tracing).
+    ``chunk_hook``/``chunk_policy`` are the resilience subsystem's loop
+    observation + adaptive chunk-cap surfaces (see engine/train.py and
+    dryad_tpu/resilience/ — most callers want ``supervise_train`` instead of
+    passing these directly).  ``mesh`` forwards an explicit device mesh to
+    the device trainer (rows sharded, histograms psum'd; see
+    ``distributed.train_distributed`` for the usual front door).
     """
     p = make_params(params, **kw)
     if train_set is None:
@@ -71,7 +80,14 @@ def train(
         if valid is None or len(valid_names) != len(valid):
             raise ValueError("valid_names must match valid_sets in length")
         valid = list(zip(valid_names, valid))
-    if backend == "auto":
+    if mesh is not None:
+        if backend == "cpu":
+            raise ValueError(
+                "mesh requires the device trainer — backend='cpu' with an "
+                "explicit mesh is contradictory (drop the mesh to run the "
+                "CPU reference path)")
+        backend = "tpu"           # an explicit mesh means the device path
+    elif backend == "auto":
         backend = "tpu" if (_accelerator_present() and _engine_present()) else "cpu"
 
     checkpointer = None
@@ -105,11 +121,13 @@ def train(
             from dryad_tpu.cpu.trainer import train_cpu
 
             return train_cpu(p, train_set, valid, init_booster=init_booster,
-                             callback=cb, checkpointer=checkpointer)
+                             callback=cb, checkpointer=checkpointer,
+                             chunk_hook=chunk_hook)
         from dryad_tpu.engine.train import train_device
 
         return train_device(p, train_set, valid, init_booster=init_booster,
-                            callback=cb, checkpointer=checkpointer)
+                            callback=cb, checkpointer=checkpointer, mesh=mesh,
+                            chunk_hook=chunk_hook, chunk_policy=chunk_policy)
 
 
 def predict(

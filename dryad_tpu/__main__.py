@@ -3,6 +3,7 @@
     python -m dryad_tpu train   --config params.json --data X.npy --label y.npy \
         [--valid Xv.npy --valid-label yv.npy] [--model out.dryad] \
         [--checkpoint-dir DIR --checkpoint-every N --resume] \
+        [--supervise --journal run.jsonl --retry-budget N] \
         [--log-jsonl metrics.jsonl] [--backend auto|tpu|cpu] [--quiet]
     python -m dryad_tpu predict --model m.dryad --data X.npy --out preds.npy [--raw]
     python -m dryad_tpu dump    --model m.dryad [--out model.json]
@@ -68,6 +69,31 @@ def cmd_train(args) -> int:
     from dryad_tpu.callbacks import JsonlLogger, log_evaluation
     from dryad_tpu.config import Params
 
+    # pure-argument guards FIRST: a mis-flagged invocation must not pay
+    # the full dataset load/bin (minutes at 10M rows) before the usage error
+    if args.supervise and not args.checkpoint_dir:
+        raise SystemExit("--supervise requires --checkpoint-dir "
+                         "(resume is the recovery mechanism)")
+    if args.supervise and not args.resume:
+        # mid-run faults always auto-resume, but continuing a PRIOR
+        # invocation's checkpoints must be explicit (--resume), exactly
+        # like the unsupervised path — a stale dir under changed
+        # params/data would silently yield a mixed model otherwise.
+        from dryad_tpu.checkpoint import Checkpointer
+
+        if Checkpointer.has_checkpoints(args.checkpoint_dir):
+            raise SystemExit(
+                f"--supervise found existing checkpoints in "
+                f"{args.checkpoint_dir}; pass --resume to continue "
+                "that run, or clear the directory to start fresh")
+    if not args.supervise:
+        if args.journal:
+            raise SystemExit("--journal is the supervised-run journal; "
+                             "it requires --supervise")
+        if args.retry_budget is not None:
+            raise SystemExit("--retry-budget configures the supervised "
+                             "fault budget; it requires --supervise")
+
     params = Params.from_json(args.config) if args.config else dryad.Params()
     ds = _make_dataset(args.data, args.label, args.group, params)
     valid_sets = None
@@ -87,15 +113,34 @@ def cmd_train(args) -> int:
         callbacks.append(logger)
 
     try:
-        booster = dryad.train(
-            params, ds, valid_sets,
-            backend=args.backend,
-            callbacks=callbacks,
-            checkpoint_dir=args.checkpoint_dir,
-            checkpoint_every=args.checkpoint_every,
-            resume=args.resume,
-            profile_dir=args.profile_dir,
-        )
+        if args.supervise:
+            # resilient long runs: classify tunnel/device faults, degrade
+            # chunking, auto-resume from checkpoints (dryad_tpu/resilience);
+            # the stale-checkpoint --resume guard already ran up top
+            from dryad_tpu.resilience import RetryPolicy, supervise_train
+
+            policy = (RetryPolicy() if args.retry_budget is None
+                      else RetryPolicy(retry_budget=args.retry_budget))
+            booster = supervise_train(
+                params, ds, valid_sets,
+                backend=args.backend,
+                policy=policy,
+                checkpoint_dir=args.checkpoint_dir,
+                checkpoint_every=args.checkpoint_every,
+                journal=args.journal,
+                callbacks=callbacks,
+                profile_dir=args.profile_dir,
+            )
+        else:
+            booster = dryad.train(
+                params, ds, valid_sets,
+                backend=args.backend,
+                callbacks=callbacks,
+                checkpoint_dir=args.checkpoint_dir,
+                checkpoint_every=args.checkpoint_every,
+                resume=args.resume,
+                profile_dir=args.profile_dir,
+            )
     finally:
         if logger is not None:
             logger.close()
@@ -232,7 +277,20 @@ def main(argv=None) -> int:
     t.add_argument("--checkpoint-dir")
     t.add_argument("--checkpoint-every", type=int, default=10)
     t.add_argument("--resume", action="store_true")
-    t.add_argument("--log-jsonl", help="per-iteration metrics JSONL path")
+    t.add_argument("--supervise", action="store_true",
+                   help="resilient run: classify tunnel/device faults, "
+                        "degrade chunking, auto-resume from checkpoints "
+                        "(requires --checkpoint-dir)")
+    t.add_argument("--journal",
+                   help="supervised-run journal JSONL path (with --supervise)")
+    t.add_argument("--retry-budget", type=int, default=None,
+                   help="supervised-run fault budget before failing closed")
+    t.add_argument("--log-jsonl",
+                   help="per-iteration metrics JSONL path (under "
+                        "--supervise, post-fault segments re-log the "
+                        "replayed iterations — identical values; dedupe by "
+                        "keeping the highest supervise_attempt per "
+                        "iteration)")
     t.add_argument("--profile-dir", help="capture a jax.profiler trace here")
     t.add_argument("--log-period", type=int, default=1)
     t.add_argument("--quiet", action="store_true")

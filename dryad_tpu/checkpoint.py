@@ -37,6 +37,18 @@ class Checkpointer:
     def _path(self, iteration: int) -> str:
         return os.path.join(self.directory, f"ckpt_{iteration:08d}.dryad")
 
+    @staticmethod
+    def has_checkpoints(directory: str) -> bool:
+        """Read-only probe (no mkdir): does ``directory`` hold any
+        checkpoint?  Keeps the filename convention in one place for
+        callers that must not create the directory as a side effect
+        (e.g. the CLI's --supervise stale-checkpoint guard)."""
+        try:
+            return any(_PATTERN.match(name)
+                       for name in os.listdir(directory))
+        except OSError:
+            return False
+
     def iterations(self) -> list[int]:
         out = []
         for name in os.listdir(self.directory):

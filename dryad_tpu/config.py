@@ -163,6 +163,17 @@ class Params:
     # documented chunked-vs-dispatch tolerance class in engine/train.py);
     # model quality is unaffected.
     deep_layout: str = "auto"    # auto | legacy
+    # Cap on boosting iterations fused into one device program (the chunked
+    # dispatch path in engine/train.py).  0 = no cap beyond the calibrated
+    # watchdog budget.  Precedence (single documented order): the
+    # DRYAD_CH_MAX env var, when set > 0, OVERRIDES this param (the
+    # operational escape hatch stays the highest authority); otherwise this
+    # param applies; the resilience supervisor's adaptive chunk policy
+    # (resilience/policy.py) may additionally cap individual chunks at
+    # runtime, below whichever of the two is in force.  ch_max=2 is the
+    # known-safe setting for tunnel phases that kill standard ~20 s chunks
+    # (STATUS r5: 6/6 first-fetch deaths at CH 6-8, zero at CH <= 2).
+    ch_max: int = 0
     hist_subtraction: bool = True
     rows_per_chunk: int = 65536  # row-tile for the chunked histogram scan
     deterministic: bool = True
@@ -271,6 +282,8 @@ class Params:
             raise ValueError("hist_backend must be auto|xla|pallas")
         if self.deep_layout not in ("auto", "legacy"):
             raise ValueError("deep_layout must be auto|legacy")
+        if self.ch_max < 0:
+            raise ValueError("ch_max must be >= 0 (0 = uncapped)")
         if self.hist_precision not in ("exact", "fast"):
             raise ValueError("hist_precision must be exact|fast")
         return self

@@ -382,8 +382,15 @@ def train_cpu(
     init_booster: Optional[Booster] = None,
     callback: Optional[Callable[[int, dict], None]] = None,
     checkpointer=None,
+    chunk_hook: Optional[Callable[[str, int], None]] = None,
 ) -> Booster:
-    """Reference trainer: ``dryad.train`` semantics on the CPU backend."""
+    """Reference trainer: ``dryad.train`` semantics on the CPU backend.
+
+    ``chunk_hook(site, iteration)`` mirrors the device trainer's loop
+    observation points (resilience/faults.py injection + journaling) on
+    this backend's per-iteration loop: ``"dispatch"`` at each iteration
+    start, ``"fetch"`` at each checkpoint/final materialization — the
+    sites the supervised-run fault classes attach to."""
     p = params.validate()
     Xb = data.X_binned
     y = data.y
@@ -503,6 +510,8 @@ def train_cpu(
                 and stale >= p.early_stopping_rounds):
             T = it * K
             break
+        if chunk_hook is not None:
+            chunk_hook("dispatch", it)
         # ---- DART: drop previous iterations before computing gradients ----
         # paper semantics (see config); arithmetic order mirrors the device
         # trainer exactly (score - drop; grads; score - drop/(k+1);
@@ -571,7 +580,10 @@ def train_cpu(
                     vs[:, t2 % K] += out["value"][t2, vlv]
                 vscores[vi] = vs
 
-        info: dict = {"iteration": it}
+        # ch_max_effective = 0: no chunking on this backend, no cap in
+        # force — but the key is the documented contract journals/benches
+        # read on every path (engine/train.py)
+        info: dict = {"iteration": it, "ch_max_effective": 0}
         # eval every eval_period-th iteration, always including the last so
         # the training tail is never silently unscored
         eval_now = (it + 1) % p.eval_period == 0 or it + 1 == T // K
@@ -607,6 +619,8 @@ def train_cpu(
         if callback is not None:
             callback(it, info)
         if checkpointer is not None and checkpointer.due(it + 1):
+            if chunk_hook is not None:
+                chunk_hook("fetch", it + 1)
             ckpt = _make_booster(p, data.mapper, out, (it + 1) * K, init,
                                  max_depth_seen, best_iteration, best_value,
                                  stale)
@@ -616,6 +630,8 @@ def train_cpu(
         if stop:
             break
 
+    if chunk_hook is not None:
+        chunk_hook("fetch", T // K)
     booster = _make_booster(p, data.mapper, out, T, init, max_depth_seen,
                             best_iteration, best_value, stale)
     if eval_history:

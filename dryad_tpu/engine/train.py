@@ -543,9 +543,27 @@ def train_device(
     callback: Optional[Callable[[int, dict], None]] = None,
     mesh=None,
     checkpointer=None,
+    chunk_hook: Optional[Callable[[str, int], None]] = None,
+    chunk_policy=None,
 ) -> Booster:
     """Device trainer.  With ``mesh`` set, rows are sharded over the mesh's
-    data axis and histograms allreduced by psum (engine/distributed.py)."""
+    data axis and histograms allreduced by psum (engine/distributed.py).
+
+    ``chunk_hook(site, iteration)`` observes the boosting loop's host-side
+    events — ``site`` is ``"dispatch"`` (a chunk/iteration is about to be
+    enqueued) or ``"fetch"`` (a real device->host fetch is about to run:
+    calibration, run-ahead throttle, eval read, checkpoint/final
+    materialize).  The resilience supervisor journals these and the
+    deterministic fault injector raises the recorded tunnel error classes
+    from them (resilience/faults.py); ``None`` (the default) costs nothing.
+    ``chunk_policy`` is a live cap on chunk length (``cap() -> int``, 0 =
+    uncapped, plus ``note_dispatch(n)`` / ``note_clean_chunk(n)`` feedback
+    — the dispatch-time length report is load-bearing: the policy's
+    degrade step must undercut what actually ran) consulted per chunk
+    AFTER path selection and calibration, so the supervisor's mid-run
+    degradation can never flip the compiled program — only shorten chunks
+    (resume bit-identity is preserved by construction; chunk length is a
+    traced scalar of one shared executable)."""
     p = params.validate()
     N, F = data.X_binned.shape
     B = data.mapper.total_bins
@@ -606,7 +624,10 @@ def train_device(
 
     # static jit key: strip fields that cannot affect the compiled programs
     # so e.g. a warmup run with fewer trees reuses the same executables
-    p_key = p.replace(num_trees=1, early_stopping_rounds=0, metric="")
+    # (ch_max only sizes host-side chunking, so supervisor retries that
+    # vary the cap keep sharing one program)
+    p_key = p.replace(num_trees=1, early_stopping_rounds=0, metric="",
+                      ch_max=0)
 
     def grads(score):
         return _grads_jit(p_key, N, K, pad, score, y, weight, qoff_j,
@@ -863,12 +884,17 @@ def train_device(
         # model under-estimates (Epsilon 1.25x); the second-chunk
         # calibration still re-derives CH from measurement either way
         CH = max(1, min(64, int(25.0 / max(est_for_ch, 1e-3))))
-        # DRYAD_CH_MAX caps the chunk length (initial AND calibrated) —
-        # an operational escape hatch for tunnel phases that kill
-        # standard-length (~20 s) chunk executions: the 2026-07-31
-        # 500-tree 10M headline runs died 6/6 with CH 6-8 while CH <= 2
-        # runs sailed through (same program, same data).  Off by default.
-        _ch_max = int(os.environ.get("DRYAD_CH_MAX", "0"))
+        # The chunk-length cap (initial AND calibrated) — an operational
+        # escape hatch for tunnel phases that kill standard-length (~20 s)
+        # chunk executions: the 2026-07-31 500-tree 10M headline runs died
+        # 6/6 with CH 6-8 while CH <= 2 runs sailed through (same program,
+        # same data).  Off by default.  Precedence (documented on
+        # Params.ch_max): the DRYAD_CH_MAX env var, when set > 0, OVERRIDES
+        # the threaded param; otherwise Params.ch_max applies; the
+        # supervisor's chunk_policy caps individual chunks below either,
+        # inside the loop.
+        _ch_env = int(os.environ.get("DRYAD_CH_MAX", "0"))
+        _ch_max = _ch_env if _ch_env > 0 else int(p.ch_max)
         if _ch_max > 0:
             CH = min(CH, _ch_max)
         # The cost model overestimates (measured 1.7-4x — fixed overheads
@@ -978,6 +1004,15 @@ def train_device(
         it = start_iter
         while it < total_iters:
             n = min(CH, total_iters - it)
+            # the supervisor's live cap applies HERE — after path selection
+            # and independent of calibration — so degradation mid-run only
+            # shortens chunks (traced scalar), never changes the program
+            ch_eff = _ch_max
+            if chunk_policy is not None:
+                cap_dyn = int(chunk_policy.cap())
+                if cap_dyn > 0:
+                    n = min(n, cap_dyn)
+                    ch_eff = min(ch_eff, cap_dyn) if ch_eff > 0 else cap_dyn
             if checkpointer is not None:
                 # land chunk ends exactly on checkpoint boundaries
                 n = min(n, checkpointer.every - (it % checkpointer.every))
@@ -985,6 +1020,13 @@ def train_device(
                 # early stopping reads each eval before growing past it:
                 # every chunk must END on an eval boundary
                 n = min(n, next_eval_end(it) - it)
+            if chunk_policy is not None:
+                # report the length BEFORE anything can fault: a death at
+                # this chunk's first fetch must still leave the policy
+                # knowing what length was fatal (resilience/policy.py)
+                chunk_policy.note_dispatch(n)
+            if chunk_hook is not None:
+                chunk_hook("dispatch", it)
 
             bag_bits = fmask_chunk = None
             if bagging:
@@ -1030,6 +1072,8 @@ def train_device(
             if not calibrated:
                 # drain the pipeline: chunk 0 absorbs compile, chunk 1 is
                 # the measurement
+                if chunk_hook is not None:
+                    chunk_hook("fetch", it)
                 jax.block_until_ready(out["max_depth"])
                 now = _time.perf_counter()
                 if chunk_idx == 1 and t_mark is not None:
@@ -1057,9 +1101,16 @@ def train_device(
                 # latter returned instantly on this tunnel for jit scalar
                 # results (CLAUDE.md measuring notes) and would leave the
                 # cap a no-op; the ~100 ms fetch RTT is <1% of a chunk
-                inflight.append(out["max_depth"])
+                inflight.append((it, out["max_depth"]))
                 if len(inflight) > 2:
-                    jax.device_get(inflight.pop(0)[:1])
+                    # the fetch blocks on the OLDEST inflight chunk — label
+                    # the hook with ITS head iteration, not the current
+                    # chunk's, so a tunnel kill here journals against the
+                    # work that actually stalled
+                    fetch_it, fetch_arr = inflight.pop(0)
+                    if chunk_hook is not None:
+                        chunk_hook("fetch", fetch_it)
+                    jax.device_get(fetch_arr[:1])
             chunk_idx += 1
 
             evs = eval_iters_in(it, it + n)
@@ -1069,12 +1120,14 @@ def train_device(
                 # one small fetch per chunk: the values feed early stopping
                 # and live callbacks (the chunk ended ON the eval boundary,
                 # so stopping here is iteration-exact)
+                if chunk_hook is not None:
+                    chunk_hook("fetch", it)
                 vals = np.asarray(jax.device_get(
                     eval_buf[host_cnt - len(evs):host_cnt]))
                 _, higher0, _ = evaluators[0]
                 val_rows = dict(zip(evs, vals))
                 for j in range(it, it + n):
-                    info = {"iteration": j}
+                    info = {"iteration": j, "ch_max_effective": ch_eff}
                     if comm is not None:
                         info.update(comm)
                     if j in val_rows:
@@ -1092,12 +1145,16 @@ def train_device(
                 flushed_cnt = host_cnt  # consumed: keep deferred flush exact
             elif callback is not None:
                 for j in range(it, it + n):
-                    info = {"iteration": j}
+                    info = {"iteration": j, "ch_max_effective": ch_eff}
                     if comm is not None:
                         info.update(comm)
                     callback(j, info)
             it += n
             if checkpointer is not None and checkpointer.due(it):
+                # _materialize is a real bulk fetch — the site the tunnel's
+                # >1-min-pending kills surface at (STATUS r5)
+                if chunk_hook is not None:
+                    chunk_hook("fetch", it)
                 if valids and not sync_eval:
                     flush_chunk_evals(host_cnt)
                 ckpt = _materialize(p, data.mapper, out, it * K, init,
@@ -1106,10 +1163,22 @@ def train_device(
                 if eval_history is not None:  # carried through from resume
                     ckpt.train_state["eval_history"] = eval_history
                 checkpointer.save(ckpt, it)
+            if chunk_policy is not None:
+                # "clean" = dispatched + all due host work done; the async
+                # run-ahead means device completion trails <= 2 chunks, so
+                # a re-widen decision is at most two chunks optimistic
+                # (documented in resilience/policy.py).  The length feeds
+                # the policy's degrade target: the first step must actually
+                # SHORTEN chunks relative to what has been running.
+                chunk_policy.note_clean_chunk(n)
             if stop:
                 total_iters = it
                 break
 
+        # hook BEFORE the deferred-eval flush: that flush is itself a bulk
+        # fetch, and a tunnel kill inside it must attribute to a fetch site
+        if chunk_hook is not None:
+            chunk_hook("fetch", total_iters)
         if valids and not sync_eval:
             flush_chunk_evals(host_cnt)
         booster = _materialize(p, data.mapper, out, total_iters * K, init,
@@ -1119,6 +1188,9 @@ def train_device(
             booster.train_state["eval_history"] = eval_history
         if comm is not None:
             booster.train_state["comm_stats"] = comm
+        # journals/benches read the cap that governed this run (0 = uncapped;
+        # the supervisor's per-chunk cap additionally rides the info dicts)
+        booster.train_state["ch_max_effective"] = _ch_max
         return booster
 
     # ---- boosting loop: async dispatch, zero per-iteration syncs -------------
@@ -1129,6 +1201,8 @@ def train_device(
                 and stale >= p.early_stopping_rounds):
             T = it * K
             break
+        if chunk_hook is not None:
+            chunk_hook("dispatch", it)
         row_mask_np, feat_mask_np = sample_masks(p, it, N, F)
         if row_mask_np is None:
             bag = ones_rows
@@ -1211,7 +1285,10 @@ def train_device(
                                         db)
                        for vXb in vXbs]
 
-        info: dict = {"iteration": it}
+        # ch_max_effective = 0 here: per-iteration dispatch has no chunking,
+        # so no cap is in force — but the key is the documented contract
+        # journals/benches read on every path
+        info: dict = {"iteration": it, "ch_max_effective": 0}
         if comm is not None:
             info.update(comm)
         stop = False
@@ -1231,6 +1308,8 @@ def train_device(
             if not sync_eval:
                 deferred.append((it, vals_dev))
             else:
+                if chunk_hook is not None:
+                    chunk_hook("fetch", it)
                 vals = jax.device_get(vals_dev)  # ONE fetch for all sets
                 for vi, ((vname, _), (mname, higher, _)) in enumerate(
                         zip(valids, evaluators)):
@@ -1247,6 +1326,8 @@ def train_device(
         if callback is not None:
             callback(it, info)
         if checkpointer is not None and checkpointer.due(it + 1):
+            if chunk_hook is not None:
+                chunk_hook("fetch", it + 1)
             flush_deferred()
             ckpt = _materialize(p, data.mapper, out, (it + 1) * K, init,
                                 max_depth_prev, best_iteration, best_value,
@@ -1261,6 +1342,8 @@ def train_device(
     # deferred evals: one final bulk fetch + replay; the full per-set
     # history lands on the booster (train_state["eval_history"]) since no
     # callback saw the values live
+    if chunk_hook is not None:
+        chunk_hook("fetch", T // K)
     flush_deferred()
 
     # ---- the single end-of-training fetch ------------------------------------
@@ -1270,4 +1353,5 @@ def train_device(
         booster.train_state["eval_history"] = eval_history
     if comm is not None:
         booster.train_state["comm_stats"] = comm
+    booster.train_state["ch_max_effective"] = 0   # per-iteration: no chunks
     return booster

@@ -1,0 +1,179 @@
+"""Fault classification + deterministic injection for supervised runs.
+
+The classes named here are the failure modes actually RECORDED against the
+tunneled device (STATUS.md r5 "Infrastructure note"), not a hypothetical
+taxonomy:
+
+* **fetch_death** — a device->host fetch pending more than ~1 min behind
+  queued work is killed by the tunnel and surfaces as an
+  ``UNAVAILABLE: TPU device error`` / ``worker process crashed`` at the
+  fetch site (2026-07-31: 6/6 first-fetch deaths on ~20 s chunks while
+  ``DRYAD_CH_MAX=2`` runs always passed).  The remedy is chunk
+  degradation (resilience/policy.py), which is why this class is split
+  from the generic device error even though the message family overlaps —
+  the distinguishing signal is the SITE the error was raised at, which the
+  supervisor tracks through the trainer's ``chunk_hook``.
+* **device_unavailable** — the same ``UNAVAILABLE`` family raised away
+  from a fetch (dispatch-time device loss, worker crash, connection
+  reset).  Remedy: plain resume from the latest checkpoint.
+* **oom** — ``RESOURCE_EXHAUSTED`` / "out of memory" allocations.
+* **preemption** — ``ABORTED`` / "preempted" worker revocations.
+* **unknown** — everything else.  The supervisor FAILS CLOSED on these:
+  retrying an unrecognized error hides real bugs behind checkpoints.
+
+Classification matches on exception type family (RuntimeError/OSError —
+jaxlib's ``XlaRuntimeError`` is a RuntimeError subclass) plus the recorded
+message signatures, so the injected faults below and the real runtime's
+errors classify identically.
+
+``FaultInjector`` is the deterministic injection layer: it IS a
+``chunk_hook`` (engine/train.py, cpu/trainer.py) and raises the real error
+classes at configured (site, iteration) points, so every resilience path
+runs under ``JAX_PLATFORMS=cpu`` in tier-1.  Not passing one costs
+nothing — the trainers skip the hook entirely when it is None.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+
+FETCH_DEATH = "fetch_death"
+DEVICE_UNAVAILABLE = "device_unavailable"
+OOM = "oom"
+PREEMPTION = "preemption"
+UNKNOWN = "unknown"
+
+#: classes the supervisor may retry; UNKNOWN always fails closed
+RETRYABLE = (FETCH_DEATH, DEVICE_UNAVAILABLE, OOM, PREEMPTION)
+
+#: the site vocabulary of the trainers' chunk_hook
+SITES = ("dispatch", "fetch")
+
+_OOM_PAT = re.compile(r"RESOURCE_EXHAUSTED|out of memory|hbm.*exceeds",
+                      re.IGNORECASE)
+# "preempt" in any casing, but the grpc status token only as the exact
+# uppercase word — prose like "compilation aborted" must NOT classify as
+# a retryable preemption (it would burn the retry budget on a real bug)
+_PREEMPT_PAT = re.compile(r"(?i:preempt)|\bABORTED\b")
+_UNAVAILABLE_PAT = re.compile(
+    r"UNAVAILABLE|TPU device error|worker process crashed"
+    r"|socket closed|connection reset", re.IGNORECASE)
+# a fetch death announced in the message itself (deadline class) — site
+# information is then not required to classify it
+_FETCH_PAT = re.compile(r"DEADLINE_EXCEEDED|fetch.*(timed out|killed)",
+                        re.IGNORECASE)
+
+
+def classify_fault(exc: BaseException, at_fetch: bool = False) -> str:
+    """Map a raised exception onto the recorded fault classes.
+
+    ``at_fetch`` says whether the trainer's last chunk_hook event before
+    the raise was a ``"fetch"`` site — the supervisor tracks this; it is
+    what splits fetch_death from device_unavailable for the overlapping
+    ``UNAVAILABLE`` message family (see module docstring).
+    """
+    # only runtime-shaped errors can be device faults: a ValueError from
+    # config validation (or any non-Exception) must never be retried
+    if not isinstance(exc, (RuntimeError, OSError)):
+        return UNKNOWN
+    msg = f"{type(exc).__name__}: {exc}"
+    if _OOM_PAT.search(msg):
+        return OOM
+    if _PREEMPT_PAT.search(msg):
+        return PREEMPTION
+    if _FETCH_PAT.search(msg):
+        return FETCH_DEATH
+    if _UNAVAILABLE_PAT.search(msg):
+        return FETCH_DEATH if at_fetch else DEVICE_UNAVAILABLE
+    return UNKNOWN
+
+
+# the messages injection raises — the real signatures from STATUS r5, so
+# classify_fault treats injected and genuine faults identically
+_CANONICAL_MSG = {
+    # "fetch ... killed" matches _FETCH_PAT, so the injected exception
+    # classifies as fetch_death by MESSAGE alone — make_fault's contract
+    # ("classifies as kind") holds at any site.  Real tunnel deaths carry
+    # no such token and rely on the supervisor's fetch-site attribution.
+    FETCH_DEATH: ("UNAVAILABLE: TPU device error: worker process crashed "
+                  "(fetch pending >60s behind queued work killed by the "
+                  "tunnel) [injected]"),
+    DEVICE_UNAVAILABLE: "UNAVAILABLE: TPU device error [injected]",
+    OOM: ("RESOURCE_EXHAUSTED: out of memory while trying to allocate "
+          "device buffer [injected]"),
+    PREEMPTION: "ABORTED: the TPU worker was preempted [injected]",
+    UNKNOWN: "injected fault with no recorded tunnel signature",
+}
+
+_ERROR_CLS = None
+
+
+def _error_class():
+    """The real jaxlib error type when constructible (it subclasses
+    RuntimeError), else RuntimeError — classification only reads the
+    message, so both exercise identical supervisor paths."""
+    global _ERROR_CLS
+    if _ERROR_CLS is None:
+        try:
+            from jaxlib.xla_extension import XlaRuntimeError
+
+            XlaRuntimeError("constructibility probe")
+            _ERROR_CLS = XlaRuntimeError
+        except Exception:
+            _ERROR_CLS = RuntimeError
+    return _ERROR_CLS
+
+
+def make_fault(kind: str) -> BaseException:
+    """An exception instance that classifies as ``kind`` (UNKNOWN included:
+    its message matches no recorded signature)."""
+    if kind not in _CANONICAL_MSG:
+        raise ValueError(f"unknown fault kind {kind!r}")
+    return _error_class()(_CANONICAL_MSG[kind])
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultPoint:
+    """One configured injection: fire at the FIRST chunk-hook event with
+    ``site`` at/after ``iteration`` (>=, not ==: chunked dispatch only
+    visits chunk-start iterations, so an exact match could never hit)."""
+
+    iteration: int
+    kind: str = DEVICE_UNAVAILABLE
+    site: str = "dispatch"
+
+    def __post_init__(self):
+        if self.site not in SITES:
+            raise ValueError(f"site must be one of {SITES}, got {self.site!r}")
+        if self.kind not in _CANONICAL_MSG:
+            raise ValueError(f"unknown fault kind {self.kind!r}")
+
+
+class FaultInjector:
+    """Deterministic fault injection, shaped as a trainer ``chunk_hook``.
+
+    Each point fires EXACTLY ONCE per injector lifetime — the supervisor
+    keeps one injector across retries, so a resumed segment replays past
+    the already-fired point instead of dying on it again.  ``fired``
+    records (point index, site, iteration, kind) for test assertions.
+    """
+
+    def __init__(self, points):
+        self.points = [p if isinstance(p, FaultPoint) else FaultPoint(*p)
+                       for p in points]
+        self._armed = [True] * len(self.points)
+        self.fired: list[dict] = []
+
+    def __call__(self, site: str, iteration: int) -> None:
+        for i, pt in enumerate(self.points):
+            if self._armed[i] and site == pt.site and iteration >= pt.iteration:
+                self._armed[i] = False
+                self.fired.append({"point": i, "site": site,
+                                   "iteration": int(iteration),
+                                   "kind": pt.kind})
+                raise make_fault(pt.kind)
+
+    @property
+    def pending(self) -> int:
+        return sum(self._armed)

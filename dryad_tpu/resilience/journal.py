@@ -1,0 +1,59 @@
+"""Append-only JSONL run journal for supervised training.
+
+Same shape discipline as ``callbacks.JsonlLogger`` (one JSON object per
+line, line-buffered append, elapsed seconds since construction) but keyed
+by EVENT rather than iteration: chunk dispatch/fetch, fault
+classification, backoff decisions, resume points, completion wall.  The
+journal is the supervised run's flight recorder — `scripts/headline_10m.py`
+and the ci.sh supervisor smoke both read it back.
+
+Event vocabulary (the ``event`` field; producers in supervisor.py):
+``run_start``, ``segment_start``, ``chunk_dispatch``, ``chunk_fetch``,
+``fault``, ``backoff_chunks``, ``resume``, ``fail_closed``, ``complete``.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+
+class RunJournal:
+    """Append one JSON event line per supervision event to ``path``."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._t0 = time.perf_counter()
+        self._fh = open(path, "a", buffering=1)
+
+    def event(self, kind: str, /, **fields) -> None:
+        rec = {"event": kind,
+               "elapsed_s": round(time.perf_counter() - self._t0, 6)}
+        rec.update(fields)
+        self._fh.write(json.dumps(rec) + "\n")
+
+    def close(self) -> None:
+        self._fh.close()
+
+    def __enter__(self) -> "RunJournal":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    @staticmethod
+    def read(path: str) -> list[dict]:
+        """Parse a journal back into its event dicts (tests/smokes)."""
+        with open(path) as f:
+            return [json.loads(line) for line in f if line.strip()]
+
+    @classmethod
+    def read_last_run(cls, path: str) -> list[dict]:
+        """Events of the LAST supervised run only.  The file is append-only
+        across invocations, so any consumer counting faults/resumes must
+        slice after the final run_start or it inherits a prior invocation's
+        records (scripts/headline_10m.py reads artifact counts this way)."""
+        events = cls.read(path)
+        starts = [i for i, e in enumerate(events)
+                  if e["event"] == "run_start"]
+        return events[starts[-1]:] if starts else events

@@ -32,6 +32,28 @@ if grep -nE 'np\.asarray|asnumpy|device_get|import jax' dryad_tpu/serve/batcher.
   exit 1
 fi
 
+# Resilience fetch lint (r8): the supervisor/journal layer must never
+# throttle or time anything on block_until_ready — it returns instantly
+# through this tunnel (STATUS r5 / CLAUDE.md measuring notes), so a
+# "wait" built on it is a no-op that would let the supervisor misjudge
+# run health.  Same rule the batcher lint enforces for serve/.
+if grep -rnE '\.block_until_ready\(' dryad_tpu/resilience/; then
+  echo "LINT FAIL: resilience/ uses block_until_ready (lies on the tunnel; use a real fetch)" >&2
+  exit 1
+fi
+
+# Supervisor smoke (r8): two injected faults (one fetch-death) through a
+# short supervised run — exactly-once resume per fault, chunk backoff to
+# the known-safe 2, well-formed journal, bitwise-equal final model.
+if ! env JAX_PLATFORMS=cpu \
+    PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}" \
+    python scripts/smoke_supervisor.py > /tmp/_sup_smoke.log 2>&1; then
+  echo "SUPERVISOR SMOKE FAIL: scripts/smoke_supervisor.py (see /tmp/_sup_smoke.log)" >&2
+  tail -5 /tmp/_sup_smoke.log >&2
+  exit 1
+fi
+tail -1 /tmp/_sup_smoke.log
+
 # Serving bench smoke (r7): zero recompiles after warmup across BOTH the
 # bucketed (forced-CPU) and sharded (8 fake devices) compiled-entry
 # families — warm traffic must be structurally recompile-free.

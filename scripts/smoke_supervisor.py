@@ -1,0 +1,99 @@
+"""Supervisor smoke for scripts/ci.sh (runs under JAX_PLATFORMS=cpu).
+
+Two injected faults (one generic device error at a dispatch site, one
+fetch-death that must degrade the chunk cap) drive a short supervised run;
+the smoke asserts:
+
+* EXACTLY-ONCE resume per fault (2 faults -> 2 resumes -> 3 segments),
+* the fetch-death triggered a backoff_chunks event,
+* the journal is well-formed (every line parses; run_start first,
+  complete last; every fault is followed by exactly one resume),
+* the supervised model is bitwise identical to the uninterrupted run.
+
+Prints one JSON summary line on success, exits 1 with a reason otherwise.
+"""
+
+import json
+import os
+import sys
+import tempfile
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import dryad_tpu as dryad  # noqa: E402
+from dryad_tpu.datasets import higgs_like  # noqa: E402
+from dryad_tpu.resilience import (  # noqa: E402
+    FaultInjector,
+    RetryPolicy,
+    RunJournal,
+    supervise_train,
+)
+from dryad_tpu.resilience import faults as F  # noqa: E402
+
+PARAMS = dict(objective="binary", num_trees=12, num_leaves=7, max_bins=32,
+              seed=3, min_data_in_leaf=5)
+
+
+def fail(reason: str) -> int:
+    print(f"SUPERVISOR SMOKE FAIL: {reason}", flush=True)
+    return 1
+
+
+def main() -> int:
+    X, y = higgs_like(2500, seed=29)
+    ds = dryad.Dataset(X, y, max_bins=32)
+    reference = dryad.train(PARAMS, ds, backend="tpu")
+
+    injector = FaultInjector([
+        (3, F.DEVICE_UNAVAILABLE, "dispatch"),
+        (8, F.FETCH_DEATH, "fetch"),
+    ])
+    with tempfile.TemporaryDirectory() as td:
+        journal_path = os.path.join(td, "journal.jsonl")
+        booster = supervise_train(
+            PARAMS, ds, backend="tpu",
+            checkpoint_dir=os.path.join(td, "ck"), checkpoint_every=2,
+            journal=journal_path, fault_injector=injector,
+            policy=RetryPolicy(backoff_base_s=0.0, ch_max_ladder=(2,)))
+        events = RunJournal.read(journal_path)
+
+    if injector.pending:
+        return fail(f"{injector.pending} injected fault(s) never fired")
+    kinds = [e["event"] for e in events]
+    n_fault = kinds.count("fault")
+    n_resume = kinds.count("resume")
+    n_segment = kinds.count("segment_start")
+    if not (n_fault == 2 and n_resume == 2 and n_segment == 3):
+        return fail(f"expected 2 faults/2 resumes/3 segments, got "
+                    f"{n_fault}/{n_resume}/{n_segment}")
+    # exactly-once resume per fault: fault and resume events alternate
+    fr = [k for k in kinds if k in ("fault", "resume")]
+    if fr != ["fault", "resume", "fault", "resume"]:
+        return fail(f"fault/resume stream not exactly-once: {fr}")
+    if kinds[0] != "run_start" or kinds[-1] != "complete":
+        return fail("journal must open with run_start and end with complete")
+    backoffs = [e for e in events if e["event"] == "backoff_chunks"]
+    if not (backoffs and backoffs[-1]["ch_max_to"] == 2):
+        return fail(f"fetch-death did not degrade the chunk cap to 2: "
+                    f"{backoffs}")
+    if not (np.array_equal(reference.feature, booster.feature)
+            and np.array_equal(reference.threshold, booster.threshold)
+            and np.array_equal(reference.value, booster.value)):
+        return fail("supervised model is not bitwise equal to the "
+                    "uninterrupted run")
+
+    print(json.dumps({
+        "supervisor_smoke": "ok",
+        "faults": n_fault,
+        "resumes": n_resume,
+        "ch_max_after_backoff": backoffs[-1]["ch_max_to"],
+        "bitwise": True,
+        "journal_events": len(events),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -49,6 +49,60 @@ def test_train_predict_dump_roundtrip(paths):
     assert dump["num_iterations"] == 10 and len(dump["trees"]) == 10
 
 
+def test_cli_supervised_train(paths):
+    """--supervise --journal: the resilient-run CLI path writes a
+    well-formed journal and a model bitwise equal to the direct train."""
+    model = str(paths / "m_sup.dryad")
+    jpath = str(paths / "run.journal.jsonl")
+    rc = main([
+        "train", "--config", str(paths / "cfg.json"),
+        "--data", str(paths / "X.npy"), "--label", str(paths / "y.npy"),
+        "--model", model, "--backend", "cpu", "--quiet",
+        "--checkpoint-dir", str(paths / "ck_sup"), "--checkpoint-every", "3",
+        "--supervise", "--journal", jpath, "--retry-budget", "2",
+    ])
+    assert rc == 0 and os.path.exists(model)
+    events = [json.loads(line) for line in open(jpath)]
+    assert events[0]["event"] == "run_start"
+    assert events[-1]["event"] == "complete" and events[-1]["faults"] == 0
+
+    direct = str(paths / "m_direct.dryad")
+    rc = main([
+        "train", "--config", str(paths / "cfg.json"),
+        "--data", str(paths / "X.npy"), "--label", str(paths / "y.npy"),
+        "--model", direct, "--backend", "cpu", "--quiet",
+    ])
+    assert rc == 0
+    import dryad_tpu as dryad
+
+    a, b = dryad.Booster.load(model), dryad.Booster.load(direct)
+    np.testing.assert_array_equal(a.feature, b.feature)
+    np.testing.assert_array_equal(a.value, b.value)
+
+    # guard rails: continuing a prior invocation's checkpoints must be
+    # explicit — the first run left checkpoints in ck_sup
+    with pytest.raises(SystemExit, match="existing checkpoints"):
+        main(["train", "--config", str(paths / "cfg.json"),
+              "--data", str(paths / "X.npy"), "--label", str(paths / "y.npy"),
+              "--backend", "cpu", "--quiet", "--supervise",
+              "--checkpoint-dir", str(paths / "ck_sup")])
+    rc = main(["train", "--config", str(paths / "cfg.json"),
+               "--data", str(paths / "X.npy"), "--label", str(paths / "y.npy"),
+               "--backend", "cpu", "--quiet", "--supervise", "--resume",
+               "--checkpoint-dir", str(paths / "ck_sup")])
+    assert rc == 0                       # explicit --resume continues it
+
+    # --supervise needs --checkpoint-dir; --journal needs --supervise
+    with pytest.raises(SystemExit, match="checkpoint-dir"):
+        main(["train", "--config", str(paths / "cfg.json"),
+              "--data", str(paths / "X.npy"), "--label", str(paths / "y.npy"),
+              "--backend", "cpu", "--quiet", "--supervise"])
+    with pytest.raises(SystemExit, match="supervise"):
+        main(["train", "--config", str(paths / "cfg.json"),
+              "--data", str(paths / "X.npy"), "--label", str(paths / "y.npy"),
+              "--backend", "cpu", "--quiet", "--journal", jpath])
+
+
 def test_cli_csr_npz_train_predict(tmp_path):
     (indptr, indices, values, F), y, cat_ids = criteo_like(n=2000, seed=43)
     np.savez(tmp_path / "X.npz", indptr=indptr, indices=indices,
