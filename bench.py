@@ -20,6 +20,11 @@ is made of.  Set BENCH_10M=0 to skip (~5 min: two compiles + four runs).
 Env knobs: BENCH_ROWS (default 200000), BENCH_TREES (default 50),
 BENCH_LEAVES (default 255), BENCH_GROWTH (default depthwise),
 BENCH_10M (default 1).
+
+r9 adds ``obs_overhead_ms``/``obs_overhead_pct``: instrumented-vs-
+disabled telemetry registry (dryad_tpu/obs) on the 200k series, min-of-3
+spread-checked arms — the zero-cost-when-disabled contract as a measured
+number (acceptance: <= 2%).
 """
 
 from __future__ import annotations
@@ -253,6 +258,37 @@ def main() -> None:
         (min(sups) - min(directs)) * 1000, 1)
     out["supervisor_overhead_spread"] = round(
         max(max(directs) / min(directs), max(sups) / min(sups)) - 1, 3)
+
+    # ---- observability overhead (r9: the zero-cost contract, measured) ------
+    # Instrumented vs disabled on the SAME 200k series the headline times:
+    # the obs wiring is a handful of host-side clock reads per chunk (and
+    # per iteration on the dispatch path), so the delta must be noise-level
+    # (acceptance: <= 2% of the arm wall).  Min-of-3 per arm — stalls only
+    # ever ADD time — with the per-arm spread recorded next to the number.
+    from dryad_tpu.obs.registry import default_registry
+
+    _reg = default_registry()
+    _was_enabled = _reg.enabled
+    p_obs = params.replace(num_trees=12)
+    train_device(p_obs, ds)                    # warm/compile the T=12 shape
+
+    def obs_arm(enabled: bool) -> float:
+        (_reg.enable if enabled else _reg.disable)()
+        t0 = time.perf_counter()
+        train_device(p_obs, ds)
+        return time.perf_counter() - t0
+
+    try:
+        ons = [obs_arm(True) for _ in range(3)]
+        offs = [obs_arm(False) for _ in range(3)]
+    finally:
+        # restore what the process started with (DRYAD_OBS=0 must keep the
+        # 10M arm below uninstrumented)
+        (_reg.enable if _was_enabled else _reg.disable)()
+    out["obs_overhead_ms"] = round((min(ons) - min(offs)) * 1000, 2)
+    out["obs_overhead_pct"] = round((min(ons) / min(offs) - 1) * 100, 3)
+    out["obs_overhead_spread"] = round(
+        max(max(ons) / min(ons), max(offs) / min(offs)) - 1, 3)
 
     # ---- 10M-row warm marginal (the BASELINE.json:2 scale) ------------------
     if os.environ.get("BENCH_10M", "1") != "0" and rows == 200_000:

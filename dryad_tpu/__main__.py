@@ -4,6 +4,7 @@
         [--valid Xv.npy --valid-label yv.npy] [--model out.dryad] \
         [--checkpoint-dir DIR --checkpoint-every N --resume] \
         [--supervise --journal run.jsonl --retry-budget N] \
+        [--metrics-port N [--metrics-host H] [--auth-token T]] \
         [--log-jsonl metrics.jsonl] [--backend auto|tpu|cpu] [--quiet]
     python -m dryad_tpu predict --model m.dryad --data X.npy --out preds.npy [--raw]
     python -m dryad_tpu dump    --model m.dryad [--out model.json]
@@ -11,6 +12,7 @@
         [--host H --port P] [--backend auto|tpu|cpu] \
         [--max-batch-rows N --max-wait-ms F] [--pipeline-depth 2] \
         [--sharded auto|on|off] [--device-budget-mb M] [--log-requests] \
+        [--auth-token T] \
         [--request X.npy --out p.npy]   # one-shot through the full stack
 
 Data formats: ``.npy`` (dense float matrix), ``.npz`` with keys
@@ -23,7 +25,9 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
+import time
 
 import numpy as np
 
@@ -95,24 +99,54 @@ def cmd_train(args) -> int:
                              "fault budget; it requires --supervise")
 
     params = Params.from_json(args.config) if args.config else dryad.Params()
-    ds = _make_dataset(args.data, args.label, args.group, params)
-    valid_sets = None
-    if args.valid:
-        if not args.valid_label:
-            raise SystemExit("--valid requires --valid-label")
-        vds = _make_dataset(args.valid, args.valid_label, args.valid_group,
-                            params, mapper=ds.mapper)
-        valid_sets = [vds]
 
-    callbacks = []
-    if not args.quiet:
-        callbacks.append(log_evaluation(period=args.log_period))
-    logger = None
-    if args.log_jsonl:
-        logger = JsonlLogger(args.log_jsonl)
-        callbacks.append(logger)
-
+    # live observability: mount the metrics endpoint BEFORE the (possibly
+    # minutes-long) dataset load so /healthz answers for the whole run;
+    # with --supervise --journal the journal is tailed into the registry
+    # live, so fault/backoff/resume series appear on /stats as they happen
+    exporter = tail = None
+    # parse the hold up front: a malformed value must fail HERE, not inside
+    # the finally block where it would mask a training error (and skip the
+    # model save after a completed run)
     try:
+        hold = float(os.environ.get("DRYAD_METRICS_HOLD_S", "0") or 0)
+    except ValueError:
+        raise SystemExit("DRYAD_METRICS_HOLD_S must be a number, got "
+                         f"{os.environ['DRYAD_METRICS_HOLD_S']!r}")
+    if args.metrics_port is not None:
+        from dryad_tpu.obs import JournalTail, start_exporter
+
+        exporter = start_exporter(host=args.metrics_host,
+                                  port=args.metrics_port,
+                                  auth_token=args.auth_token)
+        if not args.quiet:
+            print(f"metrics on http://{exporter.host}:{exporter.port}  "
+                  "(GET /stats, /metrics, /healthz)")
+        if args.journal:
+            tail = JournalTail(args.journal).start()
+
+    logger = None
+    # everything past exporter/tail startup runs under the finally that
+    # stops them: an in-process caller (tests, smoke_obs) hitting a bad
+    # --data path or a SystemExit validation below must not leak a bound
+    # HTTP server and tail thread
+    try:
+        ds = _make_dataset(args.data, args.label, args.group, params)
+        valid_sets = None
+        if args.valid:
+            if not args.valid_label:
+                raise SystemExit("--valid requires --valid-label")
+            vds = _make_dataset(args.valid, args.valid_label,
+                                args.valid_group, params, mapper=ds.mapper)
+            valid_sets = [vds]
+
+        callbacks = []
+        if not args.quiet:
+            callbacks.append(log_evaluation(period=args.log_period))
+        if args.log_jsonl:
+            logger = JsonlLogger(args.log_jsonl)
+            callbacks.append(logger)
+
         if args.supervise:
             # resilient long runs: classify tunnel/device faults, degrade
             # chunking, auto-resume from checkpoints (dryad_tpu/resilience);
@@ -144,6 +178,14 @@ def cmd_train(args) -> int:
     finally:
         if logger is not None:
             logger.close()
+        # DRYAD_METRICS_HOLD_S keeps the endpoint up briefly after the run
+        # (smokes/tests scrape the final state through it; 0 = no hold)
+        if exporter is not None and hold > 0:
+            time.sleep(hold)
+        if tail is not None:
+            tail.stop()
+        if exporter is not None:
+            exporter.stop()
     if args.model:
         booster.save(args.model)
         if not args.quiet:
@@ -245,7 +287,8 @@ def cmd_serve(args) -> int:
 
     httpd = make_http_server(server, args.host, args.port,
                              verbose=not args.quiet,
-                             log_requests=args.log_requests)
+                             log_requests=args.log_requests,
+                             auth_token=args.auth_token)
     host, port = httpd.server_address[:2]
     print(f"dryad serving on http://{host}:{port}  "
           f"(backend={server.backend}; POST /predict, GET /stats)")
@@ -293,6 +336,16 @@ def main(argv=None) -> int:
                         "iteration)")
     t.add_argument("--profile-dir", help="capture a jax.profiler trace here")
     t.add_argument("--log-period", type=int, default=1)
+    t.add_argument("--metrics-port", type=int, default=None,
+                   help="mount the live observability endpoint on this "
+                        "port for the duration of the run (0 = any free "
+                        "port; GET /stats, /metrics, /healthz — "
+                        "dryad_tpu/obs); with --supervise --journal the "
+                        "journal is tailed into the live series")
+    t.add_argument("--metrics-host", default="127.0.0.1")
+    t.add_argument("--auth-token", default=os.environ.get("DRYAD_AUTH_TOKEN"),
+                   help="bearer token for the metrics endpoint (env "
+                        "DRYAD_AUTH_TOKEN; /healthz stays open)")
     t.add_argument("--quiet", action="store_true")
     t.set_defaults(fn=cmd_train)
 
@@ -336,6 +389,9 @@ def main(argv=None) -> int:
                         "(LRU eviction, active version pinned)")
     s.add_argument("--log-requests", action="store_true",
                    help="structured JSON request log on stderr")
+    s.add_argument("--auth-token", default=os.environ.get("DRYAD_AUTH_TOKEN"),
+                   help="bearer token required on every endpoint except "
+                        "/healthz (env DRYAD_AUTH_TOKEN)")
     s.add_argument("--request", help="one-shot mode: predict this matrix "
                                      "through the serving stack and exit")
     s.add_argument("--out", help="one-shot mode: output .npy path")

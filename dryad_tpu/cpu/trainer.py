@@ -16,6 +16,7 @@ TPU engine replicates (SURVEY.md §7 step 1):
 
 from __future__ import annotations
 
+import time
 from typing import Callable, Optional
 
 import numpy as np
@@ -31,6 +32,12 @@ from dryad_tpu.cpu.histogram import (
 from dryad_tpu.cpu.predict import predict_tree_leaves
 from dryad_tpu.dataset import Dataset
 from dryad_tpu.objectives import get_objective
+
+# per-stage wall/count series (dryad_tpu/obs): host-side clocks around
+# work this loop already does — zero-cost when the registry is disabled
+from dryad_tpu.obs.registry import default_registry
+from dryad_tpu.obs.spans import record as record_span
+from dryad_tpu.obs.spans import span
 
 
 def goss_uniform(params: Params, iteration: int, num_rows: int) -> np.ndarray:
@@ -503,6 +510,13 @@ def train_cpu(
     renew_a = _obj_renew_alpha(p, weighted=data.weight is not None)
 
     all_rows = np.arange(N, dtype=np.int64)
+    # span series use record() rather than a with-block: the loop body has
+    # break edges a context manager would force a reindent across
+    _obs = default_registry()
+    # bound handle per the registry's hot-loop contract (no per-iteration
+    # family lookup); bound on FIRST enabled use — eager binding would
+    # register the family on a disabled registry
+    _obs_iter = None
     for it in range(start_iter, T // K):
         # resuming from a checkpoint taken at the early-stop boundary must
         # not grow past it (the restored stale counter already says stop)
@@ -512,6 +526,9 @@ def train_cpu(
             break
         if chunk_hook is not None:
             chunk_hook("dispatch", it)
+        # None (not 0.0) when disabled: an enable() landing mid-iteration
+        # must not record a since-process-boot wall into the counters
+        _t_it = time.perf_counter() if _obs.enabled else None
         # ---- DART: drop previous iterations before computing gradients ----
         # paper semantics (see config); arithmetic order mirrors the device
         # trainer exactly (score - drop; grads; score - drop/(k+1);
@@ -547,6 +564,7 @@ def train_cpu(
             grads = grads * w[:, None]
             hess = hess * w[:, None]
             rows = all_rows[mask]
+        _t_grow = time.perf_counter() if _obs.enabled else None
         for k in range(K):
             t = it * K + k
             d = grower.grow(grads[:, k], hess[:, k], rows, feat_mask, out, t)
@@ -565,6 +583,8 @@ def train_cpu(
                 for vXb, vscore in zip(vXbs, vscores):
                     vleaves = predict_tree_leaves(out, vXb, t, max(max_depth_seen, 1))
                     vscore[:, k] += out["value"][t, vleaves]
+        if _t_grow is not None:
+            record_span("train.grow", time.perf_counter() - _t_grow)
         if drop.size:
             # full replay-sum (ascending t, the resume construction): the
             # live score after a drop iteration is bitwise what a resumed
@@ -588,6 +608,7 @@ def train_cpu(
         # the training tail is never silently unscored
         eval_now = (it + 1) % p.eval_period == 0 or it + 1 == T // K
         stop = False
+        _t_ev = time.perf_counter() if _obs.enabled else None
         if valids and eval_now:
             from dryad_tpu.metrics import evaluate_raw
 
@@ -614,6 +635,8 @@ def train_cpu(
                 if p.early_stopping_rounds and stale >= p.early_stopping_rounds:
                     stop = True
                     T = (it + 1) * K  # trim unfilled trailing trees
+        if valids and eval_now and _t_ev is not None:
+            record_span("train.eval", time.perf_counter() - _t_ev)
         # stop falls through to the callback and the due boundary checkpoint
         # before breaking — same checkpoint stream as the device trainer
         if callback is not None:
@@ -621,12 +644,20 @@ def train_cpu(
         if checkpointer is not None and checkpointer.due(it + 1):
             if chunk_hook is not None:
                 chunk_hook("fetch", it + 1)
-            ckpt = _make_booster(p, data.mapper, out, (it + 1) * K, init,
-                                 max_depth_seen, best_iteration, best_value,
-                                 stale)
-            if eval_history:
-                ckpt.train_state["eval_history"] = eval_history
-            checkpointer.save(ckpt, it + 1)
+            with span("train.checkpoint"):
+                ckpt = _make_booster(p, data.mapper, out, (it + 1) * K, init,
+                                     max_depth_seen, best_iteration,
+                                     best_value, stale)
+                if eval_history:
+                    ckpt.train_state["eval_history"] = eval_history
+                checkpointer.save(ckpt, it + 1)
+        if _t_it is not None:
+            record_span("train.iteration", time.perf_counter() - _t_it)
+            if _obs_iter is None:
+                _obs_iter = _obs.gauge(
+                    "dryad_train_iteration",
+                    "Last host-side boosting iteration")
+            _obs_iter.set(it)
         if stop:
             break
 

@@ -42,6 +42,13 @@ from dryad_tpu.engine.grower import grow_any
 from dryad_tpu.engine.predict import _accumulate, tree_leaves
 from dryad_tpu.objectives import get_objective
 
+# per-stage span series (dryad_tpu/obs): host wall around work this loop
+# already does — dispatch cost on the async sites, real fetch wall on the
+# fetch sites.  Never a new device fetch; zero-cost when disabled.
+from dryad_tpu.obs.registry import default_registry
+from dryad_tpu.obs.spans import record as record_span
+from dryad_tpu.obs.spans import span
+
 _TREE_KEYS = ("feature", "threshold", "left", "right", "value", "is_cat",
               "cat_bitset", "gain", "default_left", "cover")
 
@@ -978,8 +985,9 @@ def train_device(
             nonlocal flushed_cnt
             if upto <= flushed_cnt:
                 return
-            vals, its_arr = jax.device_get(
-                (eval_buf[flushed_cnt:upto], eval_its[flushed_cnt:upto]))
+            with span("train.fetch.eval_flush"):
+                vals, its_arr = jax.device_get(
+                    (eval_buf[flushed_cnt:upto], eval_its[flushed_cnt:upto]))
             for row, it_d in zip(np.asarray(vals), np.asarray(its_arr)):
                 fold_eval_row(it_d, row)
             flushed_cnt = upto
@@ -1000,6 +1008,11 @@ def train_device(
         t_mark = None
         calibrated = False
         inflight: list = []
+        _obs = default_registry()
+        # bound handles per the registry's hot-loop contract (no per-chunk
+        # family lookup); bound on FIRST enabled use — eager binding would
+        # register the families on a disabled registry
+        _obs_chunks = _obs_iter = None
 
         it = start_iter
         while it < total_iters:
@@ -1027,6 +1040,9 @@ def train_device(
                 chunk_policy.note_dispatch(n)
             if chunk_hook is not None:
                 chunk_hook("dispatch", it)
+            # None (not 0.0) when disabled: an enable() landing mid-chunk
+            # must not record a since-process-boot wall into the counters
+            _t_ch = _time.perf_counter() if _obs.enabled else None
 
             bag_bits = fmask_chunk = None
             if bagging:
@@ -1068,12 +1084,30 @@ def train_device(
                 metric_names, p.ndcg_at, p.eval_period, total_iters,
                 vXbs_t, vys_t, vqids_t, vscores_t, eval_buf, eval_its,
                 eval_cnt, init_arr=init_dev, renew_alpha=renew_a)
+            if _t_ch is not None:
+                # async site: this is host dispatch wall (masks + enqueue),
+                # not device execution — the fetch spans carry that
+                record_span("train.chunk_dispatch",
+                            _time.perf_counter() - _t_ch)
+                if _obs_chunks is None:
+                    _obs_chunks = _obs.counter(
+                        "dryad_train_chunks_total",
+                        "Chunk programs dispatched")
+                    _obs_iter = _obs.gauge(
+                        "dryad_train_iteration",
+                        "Last host-side boosting iteration")
+                _obs_chunks.inc()
+                _obs_iter.set(it)
 
             if not calibrated:
                 # drain the pipeline: chunk 0 absorbs compile, chunk 1 is
                 # the measurement
                 if chunk_hook is not None:
                     chunk_hook("fetch", it)
+                # deliberately NOT timed as a fetch span: block_until_ready
+                # returns instantly through the tunnel (CLAUDE.md), so a
+                # span here would advertise a ~0 fetch wall that never
+                # happened — the real-fetch sites below carry that series
                 jax.block_until_ready(out["max_depth"])
                 now = _time.perf_counter()
                 if chunk_idx == 1 and t_mark is not None:
@@ -1110,7 +1144,8 @@ def train_device(
                     fetch_it, fetch_arr = inflight.pop(0)
                     if chunk_hook is not None:
                         chunk_hook("fetch", fetch_it)
-                    jax.device_get(fetch_arr[:1])
+                    with span("train.fetch.runahead"):
+                        jax.device_get(fetch_arr[:1])
             chunk_idx += 1
 
             evs = eval_iters_in(it, it + n)
@@ -1122,8 +1157,9 @@ def train_device(
                 # so stopping here is iteration-exact)
                 if chunk_hook is not None:
                     chunk_hook("fetch", it)
-                vals = np.asarray(jax.device_get(
-                    eval_buf[host_cnt - len(evs):host_cnt]))
+                with span("train.fetch.eval"):
+                    vals = np.asarray(jax.device_get(
+                        eval_buf[host_cnt - len(evs):host_cnt]))
                 _, higher0, _ = evaluators[0]
                 val_rows = dict(zip(evs, vals))
                 for j in range(it, it + n):
@@ -1155,14 +1191,15 @@ def train_device(
                 # >1-min-pending kills surface at (STATUS r5)
                 if chunk_hook is not None:
                     chunk_hook("fetch", it)
-                if valids and not sync_eval:
-                    flush_chunk_evals(host_cnt)
-                ckpt = _materialize(p, data.mapper, out, it * K, init,
-                                    max_depth_prev, best_iteration,
-                                    best_value, stale)
-                if eval_history is not None:  # carried through from resume
-                    ckpt.train_state["eval_history"] = eval_history
-                checkpointer.save(ckpt, it)
+                with span("train.fetch.checkpoint"):
+                    if valids and not sync_eval:
+                        flush_chunk_evals(host_cnt)
+                    ckpt = _materialize(p, data.mapper, out, it * K, init,
+                                        max_depth_prev, best_iteration,
+                                        best_value, stale)
+                    if eval_history is not None:  # carried from resume
+                        ckpt.train_state["eval_history"] = eval_history
+                    checkpointer.save(ckpt, it)
             if chunk_policy is not None:
                 # "clean" = dispatched + all due host work done; the async
                 # run-ahead means device completion trails <= 2 chunks, so
@@ -1179,11 +1216,12 @@ def train_device(
         # fetch, and a tunnel kill inside it must attribute to a fetch site
         if chunk_hook is not None:
             chunk_hook("fetch", total_iters)
-        if valids and not sync_eval:
-            flush_chunk_evals(host_cnt)
-        booster = _materialize(p, data.mapper, out, total_iters * K, init,
-                               max_depth_prev, best_iteration, best_value,
-                               stale)
+        with span("train.fetch.final"):
+            if valids and not sync_eval:
+                flush_chunk_evals(host_cnt)
+            booster = _materialize(p, data.mapper, out, total_iters * K,
+                                   init, max_depth_prev, best_iteration,
+                                   best_value, stale)
         if eval_history is not None:
             booster.train_state["eval_history"] = eval_history
         if comm is not None:
@@ -1194,6 +1232,10 @@ def train_device(
         return booster
 
     # ---- boosting loop: async dispatch, zero per-iteration syncs -------------
+    import time as _time
+
+    _obs = default_registry()
+    _obs_iter = None    # bound on first enabled use (see chunked path)
     for it in range(start_iter, T // K):
         # a checkpoint taken AT the early-stop boundary restores stale >=
         # rounds; growing anything past it would diverge from the stopped run
@@ -1203,6 +1245,7 @@ def train_device(
             break
         if chunk_hook is not None:
             chunk_hook("dispatch", it)
+        _t_it = _time.perf_counter() if _obs.enabled else None
         row_mask_np, feat_mask_np = sample_masks(p, it, N, F)
         if row_mask_np is None:
             bag = ones_rows
@@ -1310,7 +1353,8 @@ def train_device(
             else:
                 if chunk_hook is not None:
                     chunk_hook("fetch", it)
-                vals = jax.device_get(vals_dev)  # ONE fetch for all sets
+                with span("train.fetch.eval"):
+                    vals = jax.device_get(vals_dev)  # ONE fetch for all sets
                 for vi, ((vname, _), (mname, higher, _)) in enumerate(
                         zip(valids, evaluators)):
                     value = float(vals[vi])
@@ -1328,13 +1372,22 @@ def train_device(
         if checkpointer is not None and checkpointer.due(it + 1):
             if chunk_hook is not None:
                 chunk_hook("fetch", it + 1)
-            flush_deferred()
-            ckpt = _materialize(p, data.mapper, out, (it + 1) * K, init,
-                                max_depth_prev, best_iteration, best_value,
-                                stale)
-            if eval_history is not None:
-                ckpt.train_state["eval_history"] = eval_history
-            checkpointer.save(ckpt, it + 1)
+            with span("train.fetch.checkpoint"):
+                flush_deferred()
+                ckpt = _materialize(p, data.mapper, out, (it + 1) * K, init,
+                                    max_depth_prev, best_iteration,
+                                    best_value, stale)
+                if eval_history is not None:
+                    ckpt.train_state["eval_history"] = eval_history
+                checkpointer.save(ckpt, it + 1)
+        if _t_it is not None:
+            # async dispatch: this is the iteration's HOST dispatch wall
+            record_span("train.iteration", _time.perf_counter() - _t_it)
+            if _obs_iter is None:
+                _obs_iter = _obs.gauge(
+                    "dryad_train_iteration",
+                    "Last host-side boosting iteration")
+            _obs_iter.set(it)
         if stop:
             T = (it + 1) * K
             break
@@ -1344,11 +1397,12 @@ def train_device(
     # callback saw the values live
     if chunk_hook is not None:
         chunk_hook("fetch", T // K)
-    flush_deferred()
+    with span("train.fetch.final"):
+        flush_deferred()
 
-    # ---- the single end-of-training fetch ------------------------------------
-    booster = _materialize(p, data.mapper, out, T, init, max_depth_prev,
-                           best_iteration, best_value, stale)
+        # ---- the single end-of-training fetch --------------------------------
+        booster = _materialize(p, data.mapper, out, T, init, max_depth_prev,
+                               best_iteration, best_value, stale)
     if eval_history is not None:
         booster.train_state["eval_history"] = eval_history
     if comm is not None:

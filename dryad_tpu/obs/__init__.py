@@ -1,0 +1,40 @@
+"""dryad_tpu.obs — the unified observability subsystem.
+
+One process-wide telemetry registry spans training (both backends),
+serving, and resilient-run supervision; trace spans decompose loop wall
+into per-stage series; an stdlib HTTP exporter serves ``/metrics``
+(Prometheus text), ``/stats`` (JSON), and ``/healthz``; and a journal
+tail folds the supervised-run flight recorder into live series.
+
+Hard contracts (see registry.py / scripts/ci.sh):
+
+* host-side only — nothing here may touch jax or fetch from a device;
+* zero-cost when disabled (``DRYAD_OBS=0`` or ``disable()``) — measured
+  as ``obs_overhead_ms`` in bench.py, not just claimed.
+
+    from dryad_tpu.obs import default_registry, span, start_exporter
+
+    with span("my_stage"):
+        ...
+    exporter = start_exporter(port=9100)   # GET /stats, /metrics, /healthz
+"""
+
+from dryad_tpu.obs.exporter import MetricsExporter, start_exporter
+from dryad_tpu.obs.journal_tail import JournalTail
+from dryad_tpu.obs.registry import (
+    Registry,
+    default_registry,
+    set_default_registry,
+)
+from dryad_tpu.obs.spans import record, span
+
+__all__ = [
+    "Registry",
+    "default_registry",
+    "set_default_registry",
+    "span",
+    "record",
+    "MetricsExporter",
+    "start_exporter",
+    "JournalTail",
+]

@@ -1,0 +1,168 @@
+"""Stdlib HTTP exposition of the telemetry registry (fleet monitoring).
+
+Endpoints (shared by this standalone exporter AND the serve front end,
+which mounts the same handlers next to /predict — serve/http.py):
+
+    GET /metrics   Prometheus text exposition (registry.exposition())
+    GET /stats     JSON: uptime, span summary, counters/gauges/histograms
+                   (+ any extra_stats providers merged in)
+    GET /healthz   {"ok": true} — ALWAYS auth-exempt (probes must not
+                   need credentials)
+
+Bearer-token auth: when ``auth_token`` is set every endpoint except
+/healthz requires ``Authorization: Bearer <token>`` and answers 401
+otherwise (constant-time compare).  ``python -m dryad_tpu train
+--metrics-port N`` mounts this next to a training run; ``--auth-token``
+(or DRYAD_AUTH_TOKEN) guards both this exporter and the serve front end.
+
+The exporter only READS the registry — the host-side snapshot path.  It
+never touches jax or the device (scripts/ci.sh lints the package).
+"""
+
+from __future__ import annotations
+
+import hmac
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Optional, Sequence
+
+from dryad_tpu.obs import spans
+from dryad_tpu.obs.registry import Registry, default_registry
+
+
+def authorized(handler: BaseHTTPRequestHandler,
+               token: Optional[str]) -> bool:
+    """Shared bearer check (also used by serve/http.py).  /healthz is the
+    caller's responsibility to exempt BEFORE calling this."""
+    if not token:
+        return True
+    header = handler.headers.get("Authorization", "")
+    return hmac.compare_digest(header.encode(), f"Bearer {token}".encode())
+
+
+def send_unauthorized(handler: BaseHTTPRequestHandler) -> None:
+    body = b'{"error": "unauthorized"}'
+    handler.send_response(401)
+    handler.send_header("Content-Type", "application/json")
+    handler.send_header("WWW-Authenticate", "Bearer")
+    handler.send_header("Content-Length", str(len(body)))
+    handler.end_headers()
+    handler.wfile.write(body)
+
+
+class _Handler(BaseHTTPRequestHandler):
+    # the exporter rides on the server object (see MetricsExporter)
+
+    def log_message(self, fmt, *args):  # quiet: this is a scrape target
+        pass
+
+    def _send(self, code: int, body: bytes, ctype: str) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):  # noqa: N802 — stdlib handler API
+        if self.path == "/healthz":
+            self._send(200, b'{"ok": true}', "application/json")
+            return
+        if not authorized(self, self.server.auth_token):
+            send_unauthorized(self)
+            return
+        reg: Registry = self.server.obs_registry
+        if self.path == "/metrics":
+            self._send(200, reg.exposition().encode(),
+                       "text/plain; version=0.0.4; charset=utf-8")
+        elif self.path == "/stats":
+            self._send(200, json.dumps(stats_payload(
+                reg, self.server.started_at,
+                self.server.extra_stats)).encode(), "application/json")
+        else:
+            self._send(404, b'{"error": "unknown path"}', "application/json")
+
+
+def stats_payload(registry: Registry, started_at: float,
+                  extra_stats: Sequence[Callable[[], dict]] = ()) -> dict:
+    """The /stats JSON body: registry snapshot + span summary + uptime,
+    with any extra provider dicts merged in under their returned keys."""
+    payload = {"uptime_s": round(time.monotonic() - started_at, 3),
+               "spans": spans.snapshot(registry)}
+    payload.update(registry.snapshot())
+    for provider in extra_stats or ():
+        try:
+            payload.update(provider())
+        except Exception as e:  # noqa: BLE001 — a dead provider must not
+            payload.setdefault("stats_errors", []).append(repr(e))  # kill /stats
+    return payload
+
+
+class MetricsExporter:
+    """Bind-and-serve wrapper; ``port=0`` picks a free port (read it back
+    from ``.port`` after ``start()``)."""
+
+    def __init__(self, registry: Optional[Registry] = None,
+                 host: str = "127.0.0.1", port: int = 0, *,
+                 auth_token: Optional[str] = None,
+                 extra_stats: Sequence[Callable[[], dict]] = ()):
+        self.registry = registry if registry is not None else default_registry()
+        self._host, self._port = host, int(port)
+        self._auth_token = auth_token
+        self._extra_stats = tuple(extra_stats or ())
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def host(self) -> str:
+        return self._httpd.server_address[0] if self._httpd else self._host
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1] if self._httpd else self._port
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def start(self) -> "MetricsExporter":
+        if self._httpd is not None:
+            return self
+        httpd = ThreadingHTTPServer((self._host, self._port), _Handler)
+        httpd.daemon_threads = True
+        httpd.obs_registry = self.registry
+        httpd.auth_token = self._auth_token
+        httpd.extra_stats = self._extra_stats
+        httpd.started_at = time.monotonic()
+        self._httpd = httpd
+        self._thread = threading.Thread(target=httpd.serve_forever,
+                                        daemon=True, name="dryad-obs-exporter")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._httpd is None:
+            return
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._httpd = None
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    def __enter__(self) -> "MetricsExporter":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+
+def start_exporter(registry: Optional[Registry] = None,
+                   host: str = "127.0.0.1", port: int = 0, *,
+                   auth_token: Optional[str] = None,
+                   extra_stats: Sequence[Callable[[], dict]] = ()
+                   ) -> MetricsExporter:
+    """Convenience: construct + start (the CLI front door)."""
+    return MetricsExporter(registry, host, port, auth_token=auth_token,
+                           extra_stats=extra_stats).start()

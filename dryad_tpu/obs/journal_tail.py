@@ -1,0 +1,168 @@
+"""Tail a resilience ``RunJournal`` (JSONL) into registry series.
+
+This closes ROADMAP r8 follow-up (c): the supervised-run flight recorder
+(fault classifications, chunk-cap degradations, resume points, chunk
+traffic) becomes live series on the ``/stats``/``/metrics`` endpoint
+instead of a file someone greps after the fact.
+
+The adapter works on the FILE, not the ``RunJournal`` object: the journal
+is line-buffered append (journal.py), so a poll sees every completed
+event of a live run, and the same ``poll()`` replays a finished journal
+post-hoc (parity with ``RunJournal.read()`` is test-pinned).  A partial
+trailing line (a write raced mid-poll) is carried to the next poll, never
+half-parsed.  Counters are the ONLY journal-event consumers in the
+registry — the supervisor itself does not double-record them.
+
+Series produced (event vocabulary from resilience/journal.py):
+
+* ``dryad_run_events_total{event=...}`` — every event, by kind
+* ``dryad_run_faults_total{kind=...}`` — fault classifications
+* ``dryad_run_chunk_backoffs_total`` + ``dryad_run_ch_max`` (gauge) —
+  chunk-cap degradations and the live cap
+* ``dryad_run_resumes_total`` + ``dryad_run_resume_iteration`` (gauge)
+* ``dryad_run_iteration`` (gauge) — last chunk_dispatch/fetch iteration
+* ``dryad_run_attempt`` (gauge) — segment attempt counter
+* ``dryad_run_wall_seconds`` / ``dryad_run_iterations`` (gauges) — from
+  the ``complete`` event
+
+Pure stdlib file reads — no jax, no device (the obs package contract).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Optional
+
+from dryad_tpu.obs.registry import Registry, default_registry
+
+
+class JournalTail:
+    """Incrementally fold a journal file's events into ``registry``.
+
+    ``poll()`` consumes everything appended since the last poll and
+    returns the number of events folded; ``start()`` polls on a daemon
+    thread for live runs (``stop()`` runs one final poll so no tail
+    events are lost at shutdown)."""
+
+    def __init__(self, path: str, registry: Optional[Registry] = None,
+                 poll_interval_s: float = 0.25):
+        self.path = os.fspath(path)
+        self.registry = registry if registry is not None else default_registry()
+        self.poll_interval_s = float(poll_interval_s)
+        self.events_seen = 0
+        self._offset = 0
+        self._carry = ""
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # ---- consuming ---------------------------------------------------------
+    def poll(self) -> int:
+        """Fold newly appended events; safe to call concurrently with the
+        background thread and after the run finished (post-hoc replay of a
+        whole journal is just one big first poll)."""
+        with self._lock:
+            try:
+                with open(self.path, "r") as fh:
+                    fh.seek(self._offset)
+                    chunk = fh.read()
+                    self._offset = fh.tell()
+            except (FileNotFoundError, OSError):
+                return 0     # journal not created yet — not an error
+            if not chunk:
+                return 0
+            data = self._carry + chunk
+            lines = data.split("\n")
+            self._carry = lines.pop()      # '' when chunk ended on a newline
+            n = 0
+            for line in lines:
+                if not line.strip():
+                    continue
+                try:
+                    event = json.loads(line)
+                except ValueError:
+                    continue               # torn/foreign line: skip, don't die
+                self._fold(event)
+                n += 1
+            self.events_seen += n
+            return n
+
+    def _fold(self, e: dict) -> None:
+        reg = self.registry
+        kind = str(e.get("event", "unknown"))
+        if kind == "run_start":
+            # an appended/reused journal (--resume, repeated --supervise
+            # invocations) begins a NEW run here: drop the prior run's
+            # series so the live endpoint mirrors RunJournal.read_last_run
+            # instead of presenting stale fault/backoff counts as current
+            reg.reset_prefix("dryad_run_")
+        reg.counter("dryad_run_events_total",
+                    "Supervised-run journal events by kind").labels(
+            event=kind).inc()
+        if kind == "fault":
+            reg.counter("dryad_run_faults_total",
+                        "Classified faults by class").labels(
+                kind=str(e.get("kind", "unknown"))).inc()
+        elif kind == "backoff_chunks":
+            reg.counter("dryad_run_chunk_backoffs_total",
+                        "Chunk-cap degradations").inc()
+            if "ch_max_to" in e:
+                reg.gauge("dryad_run_ch_max",
+                          "Live supervised chunk cap (0 = uncapped)").set(
+                    e["ch_max_to"])
+        elif kind == "resume":
+            reg.counter("dryad_run_resumes_total",
+                        "Auto-resumes from checkpoint").inc()
+            if "from_iteration" in e:
+                reg.gauge("dryad_run_resume_iteration",
+                          "Last resume point").set(e["from_iteration"])
+        elif kind == "fail_closed":
+            reg.counter("dryad_run_fail_closed_total",
+                        "Supervisor fail-closed exits").inc()
+        elif kind in ("chunk_dispatch", "chunk_fetch"):
+            if "iteration" in e:
+                reg.gauge("dryad_run_iteration",
+                          "Last journaled loop iteration").set(e["iteration"])
+        elif kind == "segment_start":
+            if "attempt" in e:
+                reg.gauge("dryad_run_attempt",
+                          "Supervised segment attempt").set(e["attempt"])
+            if "ch_max" in e:
+                reg.gauge("dryad_run_ch_max",
+                          "Live supervised chunk cap (0 = uncapped)").set(
+                    e["ch_max"])
+        elif kind == "complete":
+            if "wall_s" in e:
+                reg.gauge("dryad_run_wall_seconds",
+                          "Completed run wall").set(e["wall_s"])
+            if "iterations" in e:
+                reg.gauge("dryad_run_iterations",
+                          "Completed run iterations").set(e["iterations"])
+
+    # ---- live tailing ------------------------------------------------------
+    def start(self) -> "JournalTail":
+        if self._thread is None or not self._thread.is_alive():
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._run, daemon=True, name="dryad-journal-tail")
+            self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.poll_interval_s):
+            self.poll()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        self.poll()    # final sweep: events appended after the last tick
+
+    def __enter__(self) -> "JournalTail":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

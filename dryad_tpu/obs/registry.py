@@ -1,0 +1,326 @@
+"""Process-wide telemetry registry: counters, gauges, histograms.
+
+One registry spans train/serve/resilience (the "single pane of glass"
+ROADMAP r8 follow-up (c) asks for): producers record into named families,
+optionally labeled; consumers read one consistent ``snapshot()`` dict or
+the Prometheus text ``exposition()`` the HTTP exporter serves.
+
+Design contract (test-pinned in tests/test_obs.py):
+
+* **Host-side only.**  Collectors record values the engine already holds
+  on the host — a Python int/float the trainer fetched, a wall-clock
+  delta, a queue depth.  Nothing in ``dryad_tpu/obs`` may touch jax or a
+  device buffer (no fetch calls of any kind, no per-iteration syncs —
+  CLAUDE.md's never-fetch rule); scripts/ci.sh lints the package for it.
+* **Zero-cost when disabled.**  Every record method's FIRST action is the
+  ``enabled`` check and the disabled path allocates nothing — no lock,
+  no float boxing, no label-tuple build.  Hot loops keep a bound series
+  handle (``family.labels(...)`` / the unlabeled family itself) so the
+  disabled fast path is one attribute read + one branch.
+* **Thread-safe when enabled.**  One lock per family; concurrent writers
+  never lose increments.  ``snapshot()``/``exposition()`` take the same
+  locks per family, so a read sees each family consistently.
+
+Registries are instantiable (tests use private ones); production code
+records into ``default_registry()``, toggled by ``DRYAD_OBS=0`` at import
+or ``enable()``/``disable()`` at runtime (bench.py measures the
+instrumented-vs-disabled delta as ``obs_overhead_ms``).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Optional, Sequence
+
+COUNTER = "counter"
+GAUGE = "gauge"
+HISTOGRAM = "histogram"
+
+#: default histogram bounds — tuned for serving/trainer wall times in
+#: seconds (sub-ms batcher hops up to multi-second chunk fetches)
+DEFAULT_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                   0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0)
+
+
+def _label_key(labels: dict) -> tuple:
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+def _escape(value: str) -> str:
+    return (value.replace("\\", r"\\").replace("\n", r"\n")
+            .replace('"', r'\"'))
+
+
+def _fmt_labels(key: tuple) -> str:
+    """Prometheus label block for a sorted (k, v) tuple ('' if unlabeled)."""
+    if not key:
+        return ""
+    return "{" + ",".join(f'{k}="{_escape(v)}"' for k, v in key) + "}"
+
+
+def _fmt_value(v: float) -> str:
+    # integers render without the trailing .0 — keeps counters greppable
+    return str(int(v)) if float(v).is_integer() and abs(v) < 1e15 else repr(v)
+
+
+class _Series:
+    """Bound handle for ONE label set of a family — the hot-path object.
+
+    The disabled check is the first statement of every record method: the
+    disabled path is one attribute read + one branch, allocation-free
+    (the zero-cost contract)."""
+
+    __slots__ = ("_fam", "_key")
+
+    def __init__(self, fam: "_Family", key: tuple):
+        self._fam = fam
+        self._key = key
+
+    # counter / gauge -------------------------------------------------------
+    def inc(self, amount: float = 1.0) -> None:
+        fam = self._fam
+        if not fam.registry.enabled:
+            return
+        if fam.kind == GAUGE:
+            with fam.lock:
+                fam.values[self._key] = fam.values.get(self._key, 0.0) + amount
+            return
+        if fam.kind != COUNTER:
+            raise TypeError(f"{fam.name} is a {fam.kind}, not a counter")
+        if amount < 0:
+            raise ValueError("counters only go up")
+        with fam.lock:
+            fam.values[self._key] = fam.values.get(self._key, 0.0) + amount
+
+    def set(self, value: float) -> None:
+        fam = self._fam
+        if not fam.registry.enabled:
+            return
+        if fam.kind != GAUGE:
+            raise TypeError(f"{fam.name} is a {fam.kind}, not a gauge")
+        with fam.lock:
+            fam.values[self._key] = float(value)
+
+    # histogram -------------------------------------------------------------
+    def observe(self, value: float) -> None:
+        fam = self._fam
+        if not fam.registry.enabled:
+            return
+        if fam.kind != HISTOGRAM:
+            raise TypeError(f"{fam.name} is a {fam.kind}, not a histogram")
+        bounds = fam.buckets
+        with fam.lock:
+            state = fam.values.get(self._key)
+            if state is None:
+                state = fam.values[self._key] = [[0] * (len(bounds) + 1),
+                                                 0.0, 0]
+            counts, _, _ = state
+            i = 0
+            # Prometheus 'le' semantics: a value ON a bound lands in that
+            # bound's bucket (test_histogram_bucket_edges)
+            while i < len(bounds) and value > bounds[i]:
+                i += 1
+            counts[i] += 1
+            state[1] += float(value)
+            state[2] += 1
+
+    def value(self):
+        """Current value (counter/gauge float; histogram
+        (counts, sum, count) copy) — 0-initialized if never recorded."""
+        fam = self._fam
+        with fam.lock:
+            if fam.kind == HISTOGRAM:
+                state = fam.values.get(self._key)
+                if state is None:
+                    return ([0] * (len(fam.buckets) + 1), 0.0, 0)
+                return (list(state[0]), state[1], state[2])
+            return fam.values.get(self._key, 0.0)
+
+
+class _Family:
+    """One named metric family: a kind, a help string, and the labeled
+    series under it.  The family itself doubles as its own unlabeled
+    series, so ``registry.counter("x").inc()`` needs no ``.labels()``."""
+
+    __slots__ = ("registry", "name", "kind", "help", "buckets", "lock",
+                 "values", "_children", "_unlabeled")
+
+    def __init__(self, registry: "Registry", name: str, kind: str,
+                 help: str = "", buckets: Optional[Sequence[float]] = None):
+        self.registry = registry
+        self.name = name
+        self.kind = kind
+        self.help = help
+        self.buckets = tuple(float(b) for b in (buckets or ())) or None
+        if kind == HISTOGRAM:
+            self.buckets = self.buckets or DEFAULT_BUCKETS
+            if list(self.buckets) != sorted(self.buckets):
+                raise ValueError("histogram buckets must be sorted")
+        self.lock = threading.Lock()
+        self.values: dict = {}
+        self._children: dict = {}
+        self._unlabeled = _Series(self, ())
+
+    def labels(self, **labels) -> _Series:
+        if not labels:
+            return self._unlabeled
+        key = _label_key(labels)
+        child = self._children.get(key)
+        if child is None:
+            with self.lock:
+                child = self._children.setdefault(key, _Series(self, key))
+        return child
+
+    # unlabeled passthroughs (the common hot path)
+    def inc(self, amount: float = 1.0) -> None:
+        self._unlabeled.inc(amount)
+
+    def set(self, value: float) -> None:
+        self._unlabeled.set(value)
+
+    def observe(self, value: float) -> None:
+        self._unlabeled.observe(value)
+
+    def value(self):
+        return self._unlabeled.value()
+
+    def series(self) -> dict:
+        """label-block string -> value (see _Series.value) for snapshot."""
+        with self.lock:
+            keys = list(self.values.keys())
+        out = {}
+        for key in keys:
+            out[_fmt_labels(key).strip("{}")] = _Series(self, key).value()
+        return out
+
+
+class Registry:
+    def __init__(self, enabled: bool = True):
+        self.enabled = bool(enabled)
+        self._lock = threading.Lock()
+        self._families: dict[str, _Family] = {}
+
+    # ---- lifecycle ---------------------------------------------------------
+    def enable(self) -> None:
+        self.enabled = True
+
+    def disable(self) -> None:
+        self.enabled = False
+
+    # ---- family accessors (idempotent; kind mismatch raises) ---------------
+    def _family(self, name: str, kind: str, help: str,
+                buckets=None) -> _Family:
+        fam = self._families.get(name)
+        if fam is None:
+            with self._lock:
+                fam = self._families.get(name)
+                if fam is None:
+                    fam = self._families[name] = _Family(
+                        self, name, kind, help, buckets)
+        if fam.kind != kind:
+            raise ValueError(
+                f"metric {name!r} already registered as a {fam.kind}")
+        return fam
+
+    def reset_prefix(self, prefix: str) -> None:
+        """Drop every recorded series in families whose name starts with
+        ``prefix``.  Used for run-scoped series (``dryad_run_*``): a
+        reused/appended journal begins a new run with ``run_start``, and
+        without the reset the live endpoint would present the PRIOR run's
+        fault/backoff/resume counts as current.  Scrapers see a counter
+        reset, which Prometheus ``rate()`` absorbs."""
+        with self._lock:
+            fams = [f for f in self._families.values()
+                    if f.name.startswith(prefix)]
+        for fam in fams:
+            with fam.lock:
+                fam.values.clear()
+
+    def counter(self, name: str, help: str = "") -> _Family:
+        return self._family(name, COUNTER, help)
+
+    def gauge(self, name: str, help: str = "") -> _Family:
+        return self._family(name, GAUGE, help)
+
+    def histogram(self, name: str, help: str = "",
+                  buckets: Optional[Sequence[float]] = None) -> _Family:
+        return self._family(name, HISTOGRAM, help, buckets)
+
+    # ---- consumers (the explicitly-annotated SNAPSHOT PATH: the one place
+    # obs is allowed to allocate freely; still jax-free by construction) ----
+    def snapshot(self) -> dict:
+        """One JSON-able dict of everything: ``{"counters": {name:
+        {labelblock: value}}, "gauges": {...}, "histograms": {name:
+        {labelblock: {"bounds", "counts", "sum", "count"}}}}``."""
+        with self._lock:
+            fams = list(self._families.values())
+        out: dict = {"counters": {}, "gauges": {}, "histograms": {}}
+        for fam in fams:
+            if fam.kind == HISTOGRAM:
+                out["histograms"][fam.name] = {
+                    lbl: {"bounds": list(fam.buckets), "counts": counts,
+                          "sum": total, "count": n}
+                    for lbl, (counts, total, n) in fam.series().items()}
+            else:
+                out[fam.kind + "s"][fam.name] = fam.series()
+        return out
+
+    def exposition(self) -> str:
+        """Prometheus text exposition (format 0.0.4) of every family."""
+        with self._lock:
+            fams = sorted(self._families.values(), key=lambda f: f.name)
+        lines: list[str] = []
+        for fam in fams:
+            if fam.help:
+                lines.append(f"# HELP {fam.name} {_escape(fam.help)}")
+            lines.append(f"# TYPE {fam.name} {fam.kind}")
+            with fam.lock:
+                items = sorted(fam.values.items())
+                for key, val in items:
+                    if fam.kind != HISTOGRAM:
+                        lines.append(
+                            f"{fam.name}{_fmt_labels(key)} {_fmt_value(val)}")
+                        continue
+                    counts, total, n = val
+                    cum = 0
+                    for bound, c in zip(fam.buckets, counts):
+                        cum += c
+                        lk = _fmt_labels(key + (("le", repr(float(bound))),))
+                        lines.append(f"{fam.name}_bucket{lk} {cum}")
+                    lk = _fmt_labels(key + (("le", "+Inf"),))
+                    lines.append(f"{fam.name}_bucket{lk} {cum + counts[-1]}")
+                    lines.append(
+                        f"{fam.name}_sum{_fmt_labels(key)} {_fmt_value(total)}")
+                    lines.append(f"{fam.name}_count{_fmt_labels(key)} {n}")
+        return "\n".join(lines) + ("\n" if lines else "")
+
+
+# ---- the process-wide default ----------------------------------------------
+
+_default: Optional[Registry] = None
+_default_lock = threading.Lock()
+
+
+def default_registry() -> Registry:
+    """The shared registry train/serve/resilience record into.  Created
+    enabled unless ``DRYAD_OBS=0``; swap with ``set_default_registry``
+    (tests) or toggle with ``enable()``/``disable()`` (bench arms)."""
+    global _default
+    if _default is None:
+        with _default_lock:
+            if _default is None:
+                _default = Registry(
+                    enabled=os.environ.get("DRYAD_OBS", "1") != "0")
+    return _default
+
+
+def set_default_registry(registry: Registry) -> Registry:
+    """Replace the process default (tests/smokes); returns the OLD one so
+    callers can restore it."""
+    global _default
+    with _default_lock:
+        old = _default if _default is not None else Registry(
+            enabled=os.environ.get("DRYAD_OBS", "1") != "0")
+        _default = registry
+    return old

@@ -1,0 +1,133 @@
+"""Lightweight trace spans over the registry: per-stage wall/count series.
+
+    with span("train.chunk_dispatch"):
+        ... host-side work ...
+
+Each exit adds the span's wall seconds to ``dryad_span_seconds_total`` and
+1 to ``dryad_span_count_total``, labeled with the span's PATH.  Spans nest
+per thread: a span opened inside another records under
+``parent_path/name`` (tree -> level -> stage reads as
+``tree/level/stage``), so per-stage series decompose their parent's wall
+(children sum <= parent wall — test-pinned).
+
+The timing here is HOST wall around work the caller already performs —
+wrapping an existing fetch measures that fetch; no span ever ADDS a
+device fetch or sync (the registry's host-side contract).  Under the
+device trainer's async dispatch a span around a dispatch site therefore
+measures dispatch cost, not device execution — same caveat as
+callbacks.JsonlLogger's ``dispatch_s``.
+
+Zero-cost when disabled: ``span()`` returns one shared null context
+manager before touching the clock, and ``record()`` returns after the
+enabled check — both allocation-free (test-pinned with tracemalloc).
+
+``record(name, seconds)`` feeds the same series without a ``with`` block,
+for loop bodies where a context manager would force a reindent across
+``break`` edges (both trainers use it for their per-iteration series).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+from dryad_tpu.obs.registry import Registry, default_registry
+
+SECONDS = "dryad_span_seconds_total"
+COUNT = "dryad_span_count_total"
+
+_TLS = threading.local()
+
+
+class _NullSpan:
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL = _NullSpan()
+
+
+def _emit(reg: Registry, path: str, seconds: float) -> None:
+    # count BEFORE seconds (and snapshot() reads seconds before counts): a
+    # scrape tearing between the two families then at worst sees a span
+    # with count=1 and a not-yet-summed wall (benign), never the
+    # self-contradictory total_s > 0 with count 0
+    reg.counter(COUNT, "Completions per span path").labels(span=path).inc()
+    reg.counter(SECONDS, "Aggregate wall seconds per span path").labels(
+        span=path).inc(seconds)
+
+
+class _Span:
+    __slots__ = ("_reg", "name", "path", "_t0")
+
+    def __init__(self, reg: Registry, name: str):
+        self._reg = reg
+        self.name = name
+        self.path = name
+        self._t0 = 0.0
+
+    def __enter__(self):
+        stack = getattr(_TLS, "stack", None)
+        if stack is None:
+            stack = _TLS.stack = []
+        if stack:
+            self.path = stack[-1].path + "/" + self.name
+        stack.append(self)
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        dt = time.perf_counter() - self._t0
+        stack = _TLS.stack
+        if stack and stack[-1] is self:
+            stack.pop()
+        _emit(self._reg, self.path, dt)
+        return False
+
+
+def span(name: str, registry: Optional[Registry] = None):
+    """A context manager timing one stage into the span series (nested
+    under the thread's enclosing span, if any)."""
+    reg = registry if registry is not None else default_registry()
+    if not reg.enabled:
+        return _NULL
+    return _Span(reg, name)
+
+
+def record(name: str, seconds: float,
+           registry: Optional[Registry] = None) -> None:
+    """Record one completed stage without a ``with`` block.  The name is
+    taken as a FULL path (no nesting prefix) — callers timing a loop body
+    manually own their naming."""
+    reg = registry if registry is not None else default_registry()
+    if not reg.enabled:
+        return
+    _emit(reg, name, seconds)
+
+
+def snapshot(registry: Optional[Registry] = None) -> dict:
+    """``{path: {"count": n, "total_s": s, "mean_ms": m}}`` — the span
+    slice of the registry, shaped for the ``/stats`` endpoint."""
+    reg = registry if registry is not None else default_registry()
+    walls = reg.counter(SECONDS).series()     # seconds first — see _emit
+    counts = reg.counter(COUNT).series()
+
+    def path_of(lbl: str) -> str:
+        # label block is span="<path>"
+        return lbl.split('"', 2)[1] if '"' in lbl else lbl
+
+    out = {}
+    for lbl, total in walls.items():
+        n = counts.get(lbl, 0.0)
+        out[path_of(lbl)] = {
+            "count": int(n),
+            "total_s": round(total, 6),
+            "mean_ms": round(total / n * 1e3, 3) if n else 0.0,
+        }
+    return out

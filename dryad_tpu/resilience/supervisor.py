@@ -34,6 +34,8 @@ import time
 from typing import Any, Optional
 
 from dryad_tpu.checkpoint import Checkpointer
+from dryad_tpu.obs.spans import record as record_span
+from dryad_tpu.obs.spans import span
 from dryad_tpu.resilience import faults as F
 from dryad_tpu.resilience.journal import RunJournal
 from dryad_tpu.resilience.policy import ChunkCapPolicy, RetryPolicy
@@ -176,9 +178,15 @@ def supervise_train(
             jevent("segment_start", attempt=n_faults,
                    resume_iteration=resume_iter, ch_max=chunk_cap.peek(),
                    checkpoint_every=every)
+            # segment wall via record(), NOT a with-span: a with-block here
+            # would prefix every nested with-span the trainer emits
+            # (train.fetch.* -> supervise.segment/train.fetch.*), splitting
+            # the train series across supervised/unsupervised naming
+            _t_seg = time.perf_counter()
             try:
                 booster = dryad.train(
-                    params, train_set, valid_sets, valid_names=valid_names,
+                    params, train_set, valid_sets,
+                    valid_names=valid_names,
                     backend=backend, checkpoint_dir=checkpoint_dir,
                     checkpoint_every=every, resume=True,
                     # resume_iter > 0 iff a checkpoint exists (they
@@ -187,8 +195,15 @@ def supervise_train(
                     init_booster=init_booster if resume_iter == 0 else None,
                     callback=marked_cb, mesh=mesh,
                     chunk_hook=hook, chunk_policy=chunk_cap, **kw)
+                record_span("supervise.segment",
+                            time.perf_counter() - _t_seg)
             except Exception as exc:  # noqa: BLE001 — classified just below
+                record_span("supervise.segment",
+                            time.perf_counter() - _t_seg)
+                _t_cl = time.perf_counter()
                 kind = F.classify_fault(exc, at_fetch=last["site"] == "fetch")
+                record_span("supervise.classify",
+                            time.perf_counter() - _t_cl)
                 ckpt_iter = latest_iteration()
                 jevent("fault", kind=kind, site=last["site"],
                        iteration=last["iteration"], resume_point=ckpt_iter,
@@ -253,7 +268,8 @@ def supervise_train(
                        sleep_s=sleep_s, checkpoint_every=new_every)
                 every = new_every
                 if sleep_s > 0:
-                    time.sleep(sleep_s)
+                    with span("supervise.backoff"):
+                        time.sleep(sleep_s)
                 continue
             wall = time.perf_counter() - t0
             jevent("complete", wall_s=round(wall, 3),

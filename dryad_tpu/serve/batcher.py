@@ -47,6 +47,8 @@ from typing import Optional
 
 import numpy as np
 
+from dryad_tpu.obs.spans import span
+
 
 class ServeOverloaded(RuntimeError):
     """The request queue is full — shed load upstream."""
@@ -77,7 +79,22 @@ class Request:
         self.abandoned = False
 
 
-_STOP = object()
+_STOP = object()          # pipeline-internal handoff sentinel only
+
+
+class _StopToken:
+    """Generation-stamped stop request on the public queue.  A token only
+    stops the worker while its generation is current: a start() issued
+    AFTER a stop() timed out (worker stuck in a stalled dispatch) bumps
+    the generation, leaving the still-queued token STALE — the unstuck
+    worker ignores it and keeps serving instead of dying with nothing
+    left to collect the queue.  An in-flight stop() is never cancelled
+    this way (see start())."""
+
+    __slots__ = ("gen",)
+
+    def __init__(self, gen: int):
+        self.gen = gen
 
 
 class MicroBatcher:
@@ -106,10 +123,22 @@ class MicroBatcher:
         self._q: queue.Queue = queue.Queue(maxsize=int(queue_size))
         self._thread: Optional[threading.Thread] = None
         self._lock = threading.Lock()
+        self._gen = 0
+        self._stop_timed_out = False
 
     # ---- lifecycle ---------------------------------------------------------
     def start(self) -> None:
         with self._lock:
+            if self._stop_timed_out:
+                # a previous stop() timed out with its token still queued
+                # behind the stuck dispatch: this start() is a deliberate
+                # reinstatement, so invalidate that token — the unstuck
+                # worker ignores it and keeps serving.  Only the timed-out
+                # case is cancellable: an IN-FLIGHT stop() (join pending)
+                # must survive predict()'s per-request auto-start, or any
+                # concurrent traffic would silently abort a shutdown.
+                self._gen += 1
+                self._stop_timed_out = False
             if self._thread is None or not self._thread.is_alive():
                 self._thread = threading.Thread(
                     target=self._run, daemon=True, name="dryad-serve-batcher")
@@ -121,14 +150,33 @@ class MicroBatcher:
         # (two dispatchers racing on the cache) while this one drains
         with self._lock:
             thread = self._thread
+            token = _StopToken(self._gen)
         if thread is None:
             return
         if thread.is_alive():
-            self._q.put(_STOP)
-            thread.join(timeout)
+            # bounded put: with the queue full AND the worker stuck in a
+            # stalled dispatch, a blocking put would wedge stop() before
+            # its join timeout could ever apply; on Full we fall through
+            # to the timed-out bookkeeping and a later stop() retries
+            deadline = time.monotonic() + timeout
+            try:
+                self._q.put(token, timeout=timeout)
+            except queue.Full:
+                pass
+            thread.join(max(0.0, deadline - time.monotonic()))
         with self._lock:
-            if self._thread is thread:
+            # clear the handle ONLY once the worker is really dead: on a
+            # join() timeout (worker stuck in a stalled device predict) a
+            # cleared handle would let the next start() race a second
+            # collector onto the same queue — the r8-flagged edge, pinned
+            # by test_stop_timeout_keeps_stuck_worker_handle
+            if self._thread is thread and not thread.is_alive():
                 self._thread = None
+                self._stop_timed_out = False
+            elif thread.is_alive():
+                # join timed out: remember it so a LATER start() may cancel
+                # the still-queued token (restart-after-stuck-stop)
+                self._stop_timed_out = True
 
     # ---- request path ------------------------------------------------------
     def submit(self, request: Request,
@@ -188,9 +236,11 @@ class MicroBatcher:
                 if downstream_full is not None and downstream_full():
                     continue                    # still no demand downstream
                 break
-            if nxt is _STOP:
-                stopping = True
-                break
+            if isinstance(nxt, _StopToken):
+                if self._stop_live(nxt):
+                    stopping = True
+                    break
+                continue        # stale: a start() since reinstated service
             batch.append(nxt)
             rows += nxt.rows.shape[0]
         if self.metrics is not None:
@@ -219,6 +269,10 @@ class MicroBatcher:
             req.error = error
             req.event.set()
 
+    def _stop_live(self, token: _StopToken) -> bool:
+        with self._lock:
+            return token.gen == self._gen
+
     def _run(self) -> None:
         if self.pipelined:
             self._run_pipeline()
@@ -228,12 +282,17 @@ class MicroBatcher:
     def _run_serial(self) -> None:
         while True:
             item = self._q.get()
-            if item is _STOP:
-                self._drain()
-                return
-            batch, stopping = self._collect(item)
+            if isinstance(item, _StopToken):
+                if self._stop_live(item):
+                    self._drain()
+                    return
+                continue        # stale: a start() since reinstated service
+            with span("serve.collect"):
+                batch, stopping = self._collect(item)
             try:
-                self._deliver(batch, self._dispatch(batch))
+                with span("serve.dispatch"):
+                    results = self._dispatch(batch)
+                self._deliver(batch, results)
             except BaseException as e:  # noqa: BLE001 — delivered to callers
                 self._fail(batch, e)
             if stopping:
@@ -252,7 +311,9 @@ class MicroBatcher:
                     return
                 batch, prepared = item
                 try:
-                    self._deliver(batch, self._execute(prepared))
+                    with span("serve.execute"):
+                        results = self._execute(prepared)
+                    self._deliver(batch, results)
                 except BaseException as e:  # noqa: BLE001 — to callers
                     self._fail(batch, e)
 
@@ -262,11 +323,16 @@ class MicroBatcher:
         stopping = False
         while not stopping:
             item = self._q.get()
-            if item is _STOP:
-                break
-            batch, stopping = self._collect(item, downstream_full=handoff.full)
+            if isinstance(item, _StopToken):
+                if self._stop_live(item):
+                    break
+                continue        # stale: a start() since reinstated service
+            with span("serve.collect"):
+                batch, stopping = self._collect(item,
+                                                downstream_full=handoff.full)
             try:
-                prepared = self._prepare(batch)
+                with span("serve.prepare"):
+                    prepared = self._prepare(batch)
             except BaseException as e:  # noqa: BLE001 — to callers
                 self._fail(batch, e)
                 continue
@@ -283,7 +349,7 @@ class MicroBatcher:
                 req = self._q.get_nowait()
             except queue.Empty:
                 return
-            if req is _STOP:
+            if isinstance(req, _StopToken):
                 continue
             req.error = ServeOverloaded("batcher stopped")
             req.event.set()

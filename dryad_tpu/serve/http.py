@@ -13,9 +13,17 @@ Endpoints (JSON in/out):
                               "name": null} → {"version": v}
     POST /models/activate    {"version": v}
     POST /models/rollback    → {"version": v}
+    GET  /metrics            → Prometheus text exposition of the shared
+                               telemetry registry (dryad_tpu/obs)
+    GET  /healthz            → {"ok": true} (always auth-exempt)
 
 Routing: ``version`` pins an exact registry version, ``model`` routes by
 registry name (multi-model co-serving); default is the active version.
+
+Bearer-token auth (``auth_token=`` / ``--auth-token`` / DRYAD_AUTH_TOKEN):
+when set, every endpoint except ``/healthz`` requires ``Authorization:
+Bearer <token>`` and answers 401 otherwise — the same scheme the
+standalone metrics exporter applies (obs/exporter.py owns the check).
 
 Structured request logging (off by default; ``log_requests=True`` or
 ``--log-requests`` on the CLI) emits one JSON line per request to
@@ -40,19 +48,34 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as np
 
+from dryad_tpu.obs.registry import default_registry
 from dryad_tpu.serve.batcher import ServeOverloaded, ServeTimeout
 
 
 class _Handler(BaseHTTPRequestHandler):
     # the PredictServer rides on the HTTP server object (see make_http_server)
     def _send(self, code: int, payload: dict) -> None:
-        body = json.dumps(payload).encode()
+        self._send_raw(code, json.dumps(payload).encode(), "application/json")
+
+    def _send_raw(self, code: int, body: bytes, ctype: str) -> None:
         self.send_response(code)
-        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)
         self._log_request(code)
+
+    def _authorized(self) -> bool:
+        """Bearer check for everything but /healthz; 401s on mismatch."""
+        from dryad_tpu.obs.exporter import authorized, send_unauthorized
+
+        if authorized(self, getattr(self.server, "auth_token", None)):
+            return True
+        # shared 401 with the metrics exporter (incl. WWW-Authenticate,
+        # which RFC 7235 requires and a hand-rolled response here dropped)
+        send_unauthorized(self)
+        self._log_request(401)
+        return False
 
     def _log_request(self, status: int) -> None:
         """One structured JSON line per completed request (flag-gated)."""
@@ -86,9 +109,19 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_GET(self):  # noqa: N802 — stdlib handler API
         self._req_t0 = time.perf_counter()
+        if self.path == "/healthz":
+            self._send(200, {"ok": True})     # liveness probes skip auth
+            return
+        if not self._authorized():
+            return
         server = self.server.predict_server
         if self.path == "/stats":
+            # the pre-obs snapshot shape, unchanged (acceptance-pinned):
+            # the unified registry view lives on /metrics instead
             self._send(200, server.stats())
+        elif self.path == "/metrics":
+            self._send_raw(200, self.server.obs_registry.exposition().encode(),
+                           "text/plain; version=0.0.4; charset=utf-8")
         elif self.path == "/models":
             self._send(200, {"active": server.registry.active_version,
                              "versions": server.registry.versions(),
@@ -98,6 +131,8 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_POST(self):  # noqa: N802 — stdlib handler API
         self._req_t0 = time.perf_counter()
+        if not self._authorized():
+            return
         server = self.server.predict_server
         try:
             body = self._read_json()
@@ -152,14 +187,20 @@ class _Handler(BaseHTTPRequestHandler):
 def make_http_server(predict_server, host: str = "127.0.0.1",
                      port: int = 8000, *, verbose: bool = False,
                      log_requests: bool = False,
-                     log_stream=None) -> ThreadingHTTPServer:
+                     log_stream=None, auth_token=None,
+                     obs_registry=None) -> ThreadingHTTPServer:
     """Bind (port 0 picks a free one: ``httpd.server_address``); caller
-    runs ``serve_forever()`` / ``shutdown()``."""
+    runs ``serve_forever()`` / ``shutdown()``.  ``auth_token`` turns on
+    bearer auth (``/healthz`` exempt); ``obs_registry`` backs ``/metrics``
+    (defaults to the process-wide registry serve already records into)."""
     httpd = ThreadingHTTPServer((host, port), _Handler)
     httpd.predict_server = predict_server
     httpd.verbose = verbose
     httpd.log_requests = log_requests
     httpd.log_stream = log_stream if log_stream is not None else sys.stderr
     httpd.log_lock = threading.Lock()
+    httpd.auth_token = auth_token
+    httpd.obs_registry = (obs_registry if obs_registry is not None
+                          else default_registry())
     predict_server.start()
     return httpd

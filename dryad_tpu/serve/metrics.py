@@ -13,13 +13,25 @@ attributed to a version (requests, rows, latencies, cache warmth,
 evictions/re-stages) is ALSO recorded under that version, so operators
 can see which resident model is earning its device memory.  The ledger
 lives here, NOT on the registry entry — eviction drops a model's staged
-arrays but must never drop its history (test-pinned)."""
+arrays but must never drop its history (test-pinned).
+
+Round 9: every recording is ALSO mirrored into the shared telemetry
+registry (``dryad_tpu/obs``) as ``dryad_serve_*`` series, so serving
+shows up on the unified ``/metrics``/``/stats`` pane next to training
+and resilience.  The LOCAL fields stay authoritative for ``snapshot()``
+— its shape and values are unchanged bit for bit (test-pinned): the
+process-wide registry is cumulative across server instances (Prometheus
+counter semantics), while a ``ServeMetrics`` instance is per-server.
+Latency percentiles keep the exact reservoir here; the registry carries
+the bucketed histogram for scrapers."""
 
 from __future__ import annotations
 
 import threading
 from collections import deque
 from typing import Optional
+
+from dryad_tpu.obs.registry import Registry, default_registry
 
 
 def _pct(lat: list, p: float) -> float:
@@ -63,8 +75,52 @@ class ModelStats:
 
 
 class ServeMetrics:
-    def __init__(self, latency_window: int = 4096):
+    def __init__(self, latency_window: int = 4096,
+                 registry: Optional[Registry] = None):
         self._lock = threading.Lock()
+        # shared-registry mirror: bound series handles so the hot path is
+        # one enabled-check per record when obs is disabled
+        reg = registry if registry is not None else default_registry()
+        self._obs = reg
+        self._obs_requests = reg.counter(
+            "dryad_serve_requests_total", "Completed predict requests")
+        self._obs_rows = reg.counter(
+            "dryad_serve_rows_total", "Rows predicted")
+        # per-version breakdowns live in their OWN families: a labeled
+        # series inside the totals family would make family-level PromQL
+        # (sum(dryad_serve_requests_total)) double-count every request
+        self._obs_requests_v = reg.counter(
+            "dryad_serve_requests_by_version_total",
+            "Completed predict requests by model version")
+        self._obs_rows_v = reg.counter(
+            "dryad_serve_rows_by_version_total",
+            "Rows predicted by model version")
+        self._obs_errors_v = reg.counter(
+            "dryad_serve_errors_by_version_total",
+            "Dispatch errors by model version")
+        self._obs_latency = reg.histogram(
+            "dryad_serve_request_latency_seconds",
+            "End-to-end request latency")
+        self._obs_batches = reg.counter(
+            "dryad_serve_batches_total", "Device dispatches")
+        self._obs_batch_rows = reg.counter(
+            "dryad_serve_batch_rows_total", "Rows across dispatches")
+        self._obs_cache_hits = reg.counter(
+            "dryad_serve_cache_hits_total", "Warm compiled-bucket hits")
+        self._obs_cache_compiles = reg.counter(
+            "dryad_serve_cache_compiles_total", "New compiled entries")
+        self._obs_timeouts = reg.counter(
+            "dryad_serve_timeouts_total", "Requests that gave up waiting")
+        self._obs_rejected = reg.counter(
+            "dryad_serve_rejected_total", "Requests shed by backpressure")
+        self._obs_errors = reg.counter(
+            "dryad_serve_errors_total", "Requests that raised in dispatch")
+        self._obs_evictions = reg.counter(
+            "dryad_serve_evictions_total", "Staged models evicted")
+        self._obs_restages = reg.counter(
+            "dryad_serve_restages_total", "Evicted models re-staged")
+        self._obs_queue_depth = reg.gauge(
+            "dryad_serve_queue_depth", "Last sampled request-queue depth")
         self._latencies = deque(maxlen=int(latency_window))
         # per-model reservoirs track the configured window but are capped
         # at 512 each — the model count is unbounded, the global window
@@ -106,12 +162,22 @@ class ServeMetrics:
                 ms.requests += 1
                 ms.rows += int(n_rows)
                 ms.latencies.append(float(latency_s))
+        if self._obs.enabled:
+            self._obs_requests.inc()
+            self._obs_rows.inc(int(n_rows))
+            self._obs_latency.observe(float(latency_s))
+            if version is not None:
+                self._obs_requests_v.labels(version=version).inc()
+                self._obs_rows_v.labels(version=version).inc(int(n_rows))
 
     def record_batch(self, rows: int, capacity: int) -> None:
         with self._lock:
             self.batches += 1
             self.batch_rows += int(rows)
             self.batch_capacity += int(capacity)
+        if self._obs.enabled:
+            self._obs_batches.inc()
+            self._obs_batch_rows.inc(int(rows))
 
     def record_cache(self, hit: bool, version: Optional[int] = None) -> None:
         with self._lock:
@@ -124,6 +190,7 @@ class ServeMetrics:
                 self.cache_compiles += 1
                 if ms is not None:
                     ms.cache_compiles += 1
+        (self._obs_cache_hits if hit else self._obs_cache_compiles).inc()
 
     def record_eviction(self, version: Optional[int] = None) -> None:
         with self._lock:
@@ -131,6 +198,7 @@ class ServeMetrics:
             ms = self._model(version)
             if ms is not None:
                 ms.evictions += 1
+        self._obs_evictions.inc()
 
     def record_restage(self, version: Optional[int] = None) -> None:
         with self._lock:
@@ -138,14 +206,17 @@ class ServeMetrics:
             ms = self._model(version)
             if ms is not None:
                 ms.restages += 1
+        self._obs_restages.inc()
 
     def record_timeout(self) -> None:
         with self._lock:
             self.timeouts += 1
+        self._obs_timeouts.inc()
 
     def record_rejected(self) -> None:
         with self._lock:
             self.rejected += 1
+        self._obs_rejected.inc()
 
     def record_error(self, version: Optional[int] = None) -> None:
         with self._lock:
@@ -153,11 +224,16 @@ class ServeMetrics:
             ms = self._model(version)
             if ms is not None:
                 ms.errors += 1
+        if self._obs.enabled:
+            self._obs_errors.inc()
+            if version is not None:
+                self._obs_errors_v.labels(version=version).inc()
 
     def sample_queue_depth(self, depth: int) -> None:
         with self._lock:
             self.queue_depth = int(depth)
             self.queue_depth_peak = max(self.queue_depth_peak, int(depth))
+        self._obs_queue_depth.set(int(depth))
 
     # ---- snapshot ----------------------------------------------------------
     def snapshot(self) -> dict:

@@ -32,15 +32,40 @@ if grep -nE 'np\.asarray|asnumpy|device_get|import jax' dryad_tpu/serve/batcher.
   exit 1
 fi
 
-# Resilience fetch lint (r8): the supervisor/journal layer must never
-# throttle or time anything on block_until_ready — it returns instantly
-# through this tunnel (STATUS r5 / CLAUDE.md measuring notes), so a
-# "wait" built on it is a no-op that would let the supervisor misjudge
-# run health.  Same rule the batcher lint enforces for serve/.
-if grep -rnE '\.block_until_ready\(' dryad_tpu/resilience/; then
-  echo "LINT FAIL: resilience/ uses block_until_ready (lies on the tunnel; use a real fetch)" >&2
+# Resilience fetch lint (r8, widened to obs/ in r9): the supervisor/
+# journal layer and the observability collectors must never throttle or
+# time anything on block_until_ready — it returns instantly through this
+# tunnel (STATUS r5 / CLAUDE.md measuring notes), so a "wait" built on it
+# is a no-op that would let the supervisor misjudge run health.  Same
+# rule the batcher lint enforces for serve/.
+if grep -rnE '\.block_until_ready\(' dryad_tpu/resilience/ dryad_tpu/obs/; then
+  echo "LINT FAIL: resilience//obs/ uses block_until_ready (lies on the tunnel; use a real fetch)" >&2
   exit 1
 fi
+
+# Observability device lint (r9): obs collectors are HOST-SIDE ONLY — they
+# may only record values the engine already fetched (CLAUDE.md's
+# never-fetch-per-iteration rule).  The whole package must stay jax-free:
+# no device fetches (device_get / addressable_data / np.asarray on device
+# buffers) and no jax import anywhere, snapshot path included — the
+# registry's "explicitly-annotated snapshot path" is annotated AND
+# jax-free by construction, so the lint is strict over the package.
+if grep -rnE 'import jax|device_get|addressable_data|np\.asarray|asnumpy' dryad_tpu/obs/; then
+  echo "LINT FAIL: dryad_tpu/obs/ grew a jax/device dependency — obs collectors are host-side only" >&2
+  exit 1
+fi
+
+# Observability smoke (r9): the CLI's live metrics endpoint — train 5
+# trees with --metrics-port, scrape /healthz + /stats + /metrics while
+# the run is up, assert span series non-empty and counters monotone.
+if ! env JAX_PLATFORMS=cpu DRYAD_OBS=1 \
+    PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}" \
+    python scripts/smoke_obs.py > /tmp/_obs_smoke.log 2>&1; then
+  echo "OBS SMOKE FAIL: scripts/smoke_obs.py (see /tmp/_obs_smoke.log)" >&2
+  tail -5 /tmp/_obs_smoke.log >&2
+  exit 1
+fi
+tail -1 /tmp/_obs_smoke.log
 
 # Supervisor smoke (r8): two injected faults (one fetch-death) through a
 # short supervised run — exactly-once resume per fault, chunk backoff to

@@ -1,0 +1,137 @@
+"""Observability smoke (scripts/ci.sh): the CLI's live metrics endpoint.
+
+Trains 5 trees through ``python -m dryad_tpu train --metrics-port`` (the
+CLI entry invoked in-process on a background thread) and scrapes the
+endpoint while the run is up:
+
+* ``/healthz`` answers (before the dataset is even loaded),
+* ``/stats`` serves non-empty span series from the training loop,
+* counters are monotone across two scrapes,
+* ``/metrics`` serves parseable Prometheus text.
+
+DRYAD_METRICS_HOLD_S keeps the endpoint up a few seconds past the run so
+the final scrape can never race a fast train; the scrape itself happens
+as soon as spans appear, normally DURING training.  Exit 0 on success,
+1 with a reason otherwise.
+"""
+
+import json
+import os
+import socket
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+
+import numpy as np
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _get_json(url: str):
+    return json.loads(urllib.request.urlopen(url, timeout=2).read())
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    # the hold only needs to cover the final scrapes if the train outruns
+    # them (<1 s of HTTP work); cmd_train's finally always sleeps the full
+    # hold, so every extra second here is unconditional CI wall
+    os.environ["DRYAD_METRICS_HOLD_S"] = "2"
+    from dryad_tpu.__main__ import main as cli_main
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(20_000, 10)).astype(np.float32)
+    y = (X[:, 0] + 0.5 * X[:, 1] + rng.normal(size=20_000) > 0).astype(
+        np.float32)
+    port = _free_port()
+    with tempfile.TemporaryDirectory() as td:
+        np.save(f"{td}/X.npy", X)
+        np.save(f"{td}/y.npy", y)
+        with open(f"{td}/cfg.json", "w") as f:
+            json.dump(dict(objective="binary", num_trees=5, num_leaves=31,
+                           max_bins=64), f)
+        rc: dict = {}
+
+        def run():
+            try:
+                rc["code"] = cli_main([
+                    "train", "--config", f"{td}/cfg.json",
+                    "--data", f"{td}/X.npy", "--label", f"{td}/y.npy",
+                    "--backend", "cpu", "--quiet",
+                    "--metrics-port", str(port)])
+            except BaseException as e:  # noqa: BLE001 — reported below
+                rc["error"] = e
+
+        thread = threading.Thread(target=run)
+        thread.start()
+        base = f"http://127.0.0.1:{port}"
+
+        deadline = time.monotonic() + 60
+        healthy = False
+        while time.monotonic() < deadline and thread.is_alive():
+            try:
+                healthy = _get_json(base + "/healthz")["ok"]
+                break
+            except Exception:
+                time.sleep(0.02)
+        if not healthy:
+            print(f"OBS SMOKE FAIL: /healthz never answered ({rc})")
+            thread.join(30)
+            return 1
+
+        snap1 = None
+        while time.monotonic() < deadline:
+            try:
+                snap = _get_json(base + "/stats")
+                if snap["spans"]:
+                    snap1 = snap
+                    break
+            except Exception:
+                pass
+            time.sleep(0.02)
+        if snap1 is None:
+            print(f"OBS SMOKE FAIL: span series never appeared ({rc})")
+            thread.join(30)
+            return 1
+
+        time.sleep(0.1)
+        snap2 = _get_json(base + "/stats")
+        metrics_text = urllib.request.urlopen(base + "/metrics",
+                                              timeout=2).read().decode()
+        thread.join(120)
+
+        if rc.get("code") != 0 or "error" in rc:
+            print(f"OBS SMOKE FAIL: CLI train failed ({rc})")
+            return 1
+        if "train.iteration" not in snap1["spans"]:
+            print(f"OBS SMOKE FAIL: no train.iteration span: "
+                  f"{sorted(snap1['spans'])}")
+            return 1
+        # monotone counters: every series present at scrape 1 is >= at 2
+        for name, series in snap1["counters"].items():
+            for lbl, v1 in series.items():
+                v2 = snap2["counters"].get(name, {}).get(lbl, -1)
+                if v2 < v1:
+                    print(f"OBS SMOKE FAIL: counter {name}{{{lbl}}} went "
+                          f"backwards ({v1} -> {v2})")
+                    return 1
+        if "# TYPE dryad_span_count_total counter" not in metrics_text:
+            print("OBS SMOKE FAIL: /metrics missing span families")
+            return 1
+        n_spans = len(snap2["spans"])
+        print(f"OBS SMOKE OK: {n_spans} span series, "
+              f"{len(snap2['counters'])} counter families, "
+              f"iters={snap2['gauges'].get('dryad_train_iteration', {}).get('', '?')}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -209,13 +209,16 @@ def test_batcher_backpressure_and_timeout():
 
 
 def test_stop_drains_stranded_requests():
-    """A request enqueued behind the stop sentinel must be failed, not left
+    """A request enqueued behind the stop token must be failed, not left
     waiting forever on a dead worker."""
-    from dryad_tpu.serve.batcher import _STOP
+    from dryad_tpu.serve.batcher import _StopToken
 
     batcher = MicroBatcher(lambda b: [None] * len(b), queue_size=4)
     stranded = Request(np.zeros((1, 2), np.uint8))
-    batcher._q.put(_STOP)
+    # stamped with the current generation (start() below leaves it alone —
+    # no timed-out stop pending), so the worker honors it as a live stop
+    # and drains what's queued behind it
+    batcher._q.put(_StopToken(batcher._gen))
     batcher._q.put(stranded)
     batcher.start()
     assert stranded.event.wait(5.0)
@@ -569,6 +572,156 @@ def test_http_round_trip(model):
         with pytest.raises(urllib.error.HTTPError) as err:
             post("/predict", {"rows": X[:2].tolist(), "version": 99})
         assert err.value.code == 400
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        server.stop()
+
+
+def test_stop_timeout_keeps_stuck_worker_handle():
+    """The r8-flagged stop() race: when join() times out because the worker
+    is stuck in a stalled dispatch, the thread handle must NOT be cleared —
+    a cleared handle would let the next start() race a SECOND collector
+    onto the same queue.  Once the worker really exits, stop() clears it."""
+    release = threading.Event()
+
+    def stuck_dispatch(batch):
+        release.wait(30.0)
+        return [np.zeros(r.rows.shape[0], np.float32) for r in batch]
+
+    batcher = MicroBatcher(stuck_dispatch, max_batch_rows=4, max_wait_ms=0.5,
+                           queue_size=4)
+    batcher.start()
+    req = Request(np.zeros((1, 3), np.uint8))
+    batcher._q.put_nowait(req)
+    deadline = time.monotonic() + 5.0
+    while not batcher._q.empty() and time.monotonic() < deadline:
+        time.sleep(0.005)          # worker has dequeued: now inside dispatch
+    worker = batcher._thread
+    assert worker is not None and worker.is_alive()
+
+    batcher.stop(timeout=0.05)     # join times out — worker still stuck
+    assert batcher._thread is worker, "handle cleared while worker alive"
+    batcher.start()                # must NOT spawn a second collector
+    assert batcher._thread is worker
+
+    release.set()
+    assert req.event.wait(5.0)     # the stuck dispatch completes delivery
+    batcher.stop(timeout=5.0)
+    assert batcher._thread is None
+
+
+def test_restart_after_stop_timeout_keeps_serving():
+    """start() after a timed-out stop() CANCELS the pending stop: the
+    queued stop token goes stale, so when the stuck dispatch finally
+    completes the worker ignores it and keeps collecting — without the
+    generation stamp it would honor the stale token, exit, and leave the
+    queue permanently collector-less (no path re-runs start())."""
+    entered = threading.Event()
+    release = threading.Event()
+    stuck_once = []
+
+    def dispatch(batch):
+        if not stuck_once:
+            stuck_once.append(1)
+            entered.set()
+            release.wait(30.0)
+        return [np.zeros(r.rows.shape[0], np.float32) for r in batch]
+
+    batcher = MicroBatcher(dispatch, max_batch_rows=4, max_wait_ms=0.5,
+                           queue_size=4)
+    batcher.start()
+    req = Request(np.zeros((1, 3), np.uint8))
+    batcher._q.put_nowait(req)
+    # synchronize on DISPATCH entry (not _q.empty(), which can observe the
+    # worker still inside _collect's coalesce window — a stop token eaten
+    # there latches stopping before start() can invalidate it)
+    assert entered.wait(5.0)       # worker is inside the stalled dispatch
+    worker = batcher._thread
+
+    batcher.stop(timeout=0.05)     # join times out; stop token stays queued
+    batcher.start()                # operator restart — must cancel the stop
+    release.set()
+    assert req.event.wait(5.0)
+
+    # the SAME worker must still be collecting: a fresh request round-trips
+    out = batcher.submit(Request(np.zeros((2, 3), np.uint8)), timeout=5.0)
+    assert out.shape == (2,)
+    assert batcher._thread is worker and worker.is_alive()
+    batcher.stop(timeout=5.0)      # un-cancelled stop still works
+    assert batcher._thread is None
+
+
+def test_plain_start_does_not_cancel_pending_stop():
+    """PredictServer.predict() auto-calls start() on every request, so a
+    start() against a live batcher with NO timed-out stop must not bump
+    the stop generation — otherwise any concurrent request would silently
+    cancel an operator shutdown and stop() would hang its full join
+    timeout with the collector leaked."""
+    batcher = MicroBatcher(
+        lambda b: [np.zeros(r.rows.shape[0], np.float32) for r in b],
+        max_batch_rows=4, max_wait_ms=0.5, queue_size=4)
+    batcher.start()
+    gen = batcher._gen
+    batcher.start()                # per-request auto-start: must be inert
+    batcher.start()
+    assert batcher._gen == gen
+    batcher.stop(timeout=5.0)      # the stop token is still honored
+    assert batcher._thread is None
+
+
+def test_http_bearer_auth_and_metrics_endpoint(model):
+    """--auth-token: 401 without/with a wrong bearer on every endpoint,
+    200 with the right one; /healthz stays open; /metrics exposes the
+    shared registry; the /stats snapshot shape is the pre-obs contract."""
+    import json
+    import urllib.error
+    import urllib.request
+
+    from dryad_tpu.serve.http import make_http_server
+
+    booster, X = model
+    server = PredictServer(backend="cpu", max_wait_ms=0.5)
+    server.registry.add(booster)
+    httpd = make_http_server(server, port=0, auth_token="tok3n")
+    port = httpd.server_address[1]
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{port}"
+
+    def get(path, token=None):
+        headers = {"Authorization": f"Bearer {token}"} if token else {}
+        return urllib.request.urlopen(
+            urllib.request.Request(base + path, headers=headers), timeout=10)
+
+    try:
+        assert json.loads(get("/healthz").read()) == {"ok": True}
+        for path in ("/stats", "/models", "/metrics"):
+            with pytest.raises(urllib.error.HTTPError) as err:
+                get(path)
+            assert err.value.code == 401
+        with pytest.raises(urllib.error.HTTPError) as err:
+            get("/stats", token="wrong")
+        assert err.value.code == 401
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(urllib.request.Request(
+                base + "/predict",
+                data=json.dumps({"rows": X[:2].tolist()}).encode(),
+                headers={"Content-Type": "application/json"}), timeout=10)
+        assert err.value.code == 401
+
+        req = urllib.request.Request(
+            base + "/predict",
+            data=json.dumps({"rows": X[:2].tolist()}).encode(),
+            headers={"Content-Type": "application/json",
+                     "Authorization": "Bearer tok3n"})
+        out = json.loads(urllib.request.urlopen(req, timeout=10).read())
+        assert np.array_equal(np.asarray(out["predictions"], np.float32),
+                              booster.predict(X[:2]))
+        stats = json.loads(get("/stats", token="tok3n").read())
+        assert stats["requests"] >= 1      # unchanged pre-obs snapshot shape
+        assert "counters" not in stats
+        text = get("/metrics", token="tok3n").read().decode()
+        assert "# TYPE dryad_serve_requests_total counter" in text
     finally:
         httpd.shutdown()
         httpd.server_close()
