@@ -36,8 +36,30 @@ import time
 import numpy as np
 
 
+def _timed_fori(fn, K: int, reps: int, *args):
+    """Shared probe scaffolding (CLAUDE.md timed-fori rules): K dependent
+    reps inside ONE jit, wall/K, ending in a REAL host fetch.  Each arm
+    runs ``reps`` timed programs and reports (min_ms, max/min - 1): tunnel
+    stalls only ever ADD time, so the min is the signal and the spread is
+    the suspect-capture flag (>5% = suspect)."""
+    import jax
+    import jax.numpy as jnp
+
+    def prog(s0, *a):
+        return jax.lax.fori_loop(0, K, lambda i, s: fn(s, *a), s0)
+
+    f = jax.jit(prog)
+    float(f(jnp.float32(0), *args))            # compile + warm, real fetch
+    walls = []
+    for r in range(reps):
+        t0 = time.perf_counter()
+        float(f(jnp.float32(1 + r), *args))
+        walls.append((time.perf_counter() - t0) / K * 1000)
+    return min(walls), max(walls) / min(walls) - 1
+
+
 def deep_level_probe(rows: int, P: int = 64, B: int = 256,
-                     F: int = 28, K: int = 3) -> dict | None:
+                     F: int = 28, K: int = 3, reps: int = 2) -> dict | None:
     """Per-arm wall of ONE deep level's data movement + smaller-children
     histogram: the wired leaf-ordered-layout pipeline (level_moves ->
     permute_records -> hist_from_layout) vs the legacy plan pipeline
@@ -69,16 +91,6 @@ def deep_level_probe(rows: int, P: int = 64, B: int = 256,
     slot_np = rng.integers(0, P, rows).astype(np.int32)
     half_np = rng.random(rows) < 0.5
 
-    def loop_time(fn, *args):
-        def prog(s0, *a):
-            return jax.lax.fori_loop(0, K, lambda i, s: fn(s, *a), s0)
-
-        f = jax.jit(prog)
-        float(f(jnp.float32(0), *args))          # compile + warm, real fetch
-        t0 = time.perf_counter()
-        float(f(jnp.float32(1), *args))
-        return (time.perf_counter() - t0) / K * 1000
-
     # ---- wired arm --------------------------------------------------------
     rec_nat = leafperm.make_layout_records(Xb, g, h)
     n_buf = leafperm.wired_tiles_bound(-(-rows // T), P)
@@ -97,15 +109,23 @@ def deep_level_probe(rows: int, P: int = 64, B: int = 256,
         # the grower's full per-level route rides in the arm: the
         # run->packed-word compose + ONE per-row small-table gather (the
         # dominant wired-only bookkeeping cost) and advance_runs — the
-        # probe must price the level the GROWER pays, not just the kernel
+        # probe must price the level the GROWER pays, not just the kernel.
+        # The run table is ROLLED by the carried scalar (whole units) and
+        # the gathered word steps the side threshold: a non-carried table
+        # would let XLA's while-loop LICM hoist the whole route gather out
+        # of the timed fori (the CLAUDE.md dead-input trap, r10)
+        si = s.astype(jnp.int32)
+        rs_i = jnp.roll(run_slot, si)
         w0 = ((jnp.uint32(1) << 31)
               | jnp.arange(P, dtype=jnp.uint32))   # per-run packed words
         tab = jnp.concatenate([w0, jnp.zeros((1,), jnp.uint32)])
-        rr = tab[jnp.minimum(run_slot, P)][
+        rr = tab[jnp.minimum(rs_i, P)][
             jnp.repeat(tile_run, T)]               # composed row gather
         live_bit = (rr >> 31) != 0
+        # per-run threshold steps stay strictly negative (half bound)
+        thr = -0.25 + 0.1 * smod + 0.1 * (rr & 1).astype(jnp.float32)
         side = jnp.where(valid & live_bit,
-                         (g_l > -0.15 + 0.1 * smod).astype(jnp.int32), 2)
+                         (g_l > thr).astype(jnp.int32), 2)
         pos, dstl, dstr, base_l, base_r, _ = leafperm.level_moves(
             tile_run, side, P)
         out = leafperm.permute_records(rec_lay, pos, dstl, dstr, n_buf)
@@ -120,7 +140,8 @@ def deep_level_probe(rows: int, P: int = 64, B: int = 256,
                 + hist[0, 0, 0, 0] * 1e-20
                 + (tr2[0] + rs2[0]).astype(jnp.float32) * 1e-20)
 
-    t_wired = loop_time(wired_step, rec_lay, tile_run, run_slot)
+    t_wired, sp_wired = _timed_fori(wired_step, K, reps,
+                                    rec_lay, tile_run, run_slot)
 
     # ---- legacy arm -------------------------------------------------------
     records = pallas_hist.make_records(Xb, g, h)
@@ -134,7 +155,11 @@ def deep_level_probe(rows: int, P: int = 64, B: int = 256,
     # bound (a binomial ~N/2 draw can exceed N//2 itself)
     sel_rows = int(cnt0.sum())
 
-    def legacy_step(s, sel0, cnt0_d, records):
+    # Xb/g/h ride as ARGUMENTS, never closures: closure arrays lower as
+    # jit constants and the tunneled remote compile rejects programs with
+    # >~tens-of-MB constants (HTTP 413 — CLAUDE.md lowering facts; at 10M
+    # rows the three arrays are ~360 MB)
+    def legacy_step(s, sel0, cnt0_d, records, Xb, g, h):
         si = s.astype(jnp.int32)
         sel = jnp.where(sel0 < P, (sel0 + si) % P, P)  # perturb the SORT KEY
         cnt = jnp.roll(cnt0_d, si)               # exact counts, rotated too
@@ -143,11 +168,136 @@ def deep_level_probe(rows: int, P: int = 64, B: int = 256,
             rows_bound=sel_rows, records=records, sel_counts=cnt)
         return s + 1.0 + hist[0, 0, 0, 0] * 1e-20
 
-    t_legacy = loop_time(legacy_step, sel0, cnt0_d, records)
+    t_legacy, sp_legacy = _timed_fori(legacy_step, K, reps,
+                                      sel0, cnt0_d, records, Xb, g, h)
     return {
         "deep_level_ms_wired": round(t_wired, 1),
         "deep_level_ms_legacy": round(t_legacy, 1),
+        "deep_level_spread_wired": round(sp_wired, 3),
+        "deep_level_spread_legacy": round(sp_legacy, 3),
         "deep_level_rows": rows,
+    }
+
+
+def leafwise_level_probe(rows: int, D: int = 7, B: int = 256,
+                         F: int = 28, K: int = 3,
+                         reps: int = 2) -> dict | None:
+    """Per-arm wall of ONE batched leaf-wise EXPANSION level's data
+    movement + smaller-children histogram, wired vs legacy — the r10
+    counterpart of ``deep_level_probe`` for the second consumer of the
+    layout.  The expansion differs from a levelwise deep level in its run
+    bookkeeping (heap-node ids with sentinel HN, run capacity NR = 2^D =
+    twice the candidate width, hence twice the mandated empty segments),
+    so the wired arm prices exactly the level the expansion fori pays at
+    its widest width P = 2^(D-1); the legacy arm is the per-level
+    sort+gather segmented pass the wiring deletes.
+
+    Same CLAUDE.md timed-fori rules as deep_level_probe (the perturbation
+    rotates the wired SIDE threshold / the legacy SORT KEY by whole
+    units; every timed program ends in a real host fetch), plus per-arm
+    spread: each arm runs ``reps`` timed programs, reports the MIN (tunnel
+    stalls only ever add time) and max/min-1 as the suspect-capture
+    signal (>5% = suspect, CLAUDE.md).  None on CPU — interpret-mode
+    kernel walls are meaningless."""
+    import jax
+    import jax.numpy as jnp
+
+    if jax.devices()[0].platform == "cpu":
+        return None
+    from dryad_tpu.engine import leafperm, pallas_hist
+    from dryad_tpu.engine.histogram import build_hist_segmented
+
+    T = leafperm._TILE_ROWS
+    P = 1 << (D - 1)                  # widest expansion level
+    NR = 1 << D                       # run capacity (leafwise wiring)
+    HN = 1 << (D + 1)                 # heap sentinel
+    rng = np.random.default_rng(17)
+    Xb = jnp.asarray(rng.integers(0, B, (rows, F), dtype=np.uint8))
+    g = jnp.asarray(rng.normal(size=rows).astype(np.float32))
+    h = jnp.asarray(rng.uniform(0.1, 1, rows).astype(np.float32))
+    slot_np = rng.integers(0, P, rows).astype(np.int32)
+    half_np = rng.random(rows) < 0.5
+
+    # ---- wired arm: the expansion level at heap-id bookkeeping ------------
+    rec_nat = leafperm.make_layout_records(Xb, g, h)
+    n_buf = leafperm.wired_tiles_bound(-(-rows // T), NR)
+    # thresholds stay strictly negative so the histogrammed left children
+    # provably cover < half the rows (shared half-bound rule)
+    n_sel = leafperm.wired_sel_tiles_bound(-(-rows // T), n_buf, P,
+                                           half=True)
+    rec_lay, tile_run, run_slot_p = leafperm.initial_layout(
+        rec_nat, jnp.asarray(slot_np), jnp.ones((P,), bool), P, n_buf)
+    # lift the (P,) slot table to the expansion's (NR,) heap-node table:
+    # level-(D-1) nodes are [P, 2P), unused run indices hold sentinel HN
+    run_slot = jnp.concatenate([
+        jnp.where(run_slot_p < P, P + run_slot_p, HN),
+        jnp.full((NR - P,), HN, jnp.int32)]).astype(jnp.int32)
+
+    def wired_step(s, rec_lay, tile_run, run_slot):
+        g_l, _, valid, _ = leafperm.unpack_layout_records(
+            rec_lay, F, jnp.uint8)
+        smod = s - jnp.floor(s / 2) * 2        # live: threshold alternates
+        # the grower's per-level route: node -> packed word composed at the
+        # (HN+1,) level, then ONE per-row small-table gather + advance_runs.
+        # Table ROLLED by the carried scalar and the gathered word steps
+        # the side threshold — a non-carried table would let while-loop
+        # LICM hoist the route gather out of the timed fori (the CLAUDE.md
+        # dead-input trap, r10; same fix as deep_level_probe)
+        si = s.astype(jnp.int32)
+        rs_i = jnp.roll(run_slot, si)
+        w0 = ((jnp.uint32(1) << 31)
+              | jnp.arange(HN + 1, dtype=jnp.uint32))
+        rr = w0[jnp.minimum(rs_i, HN)][
+            jnp.repeat(tile_run, T)]            # composed row gather
+        live_bit = (rr >> 31) != 0
+        # per-run threshold steps stay strictly negative (half bound)
+        thr = -0.25 + 0.1 * smod + 0.1 * (rr & 1).astype(jnp.float32)
+        side = jnp.where(valid & live_bit,
+                         (g_l > thr).astype(jnp.int32), 2)
+        pos, dstl, dstr, base_l, base_r, _ = leafperm.level_moves(
+            tile_run, side, NR)
+        out = leafperm.permute_records(rec_lay, pos, dstl, dstr, n_buf)
+        run_do = ((rs_i & 1) == 0) & (rs_i < HN)           # ~half split
+        ns2 = jnp.where(run_do, 2 * rs_i, rs_i)
+        tr2, rs2 = leafperm.advance_runs(ns2, run_do, 2 * rs_i + 1,
+                                         base_l, base_r, n_buf,
+                                         sentinel=HN)
+        hist = leafperm.hist_from_layout(
+            out, base_l[:P], base_l[1:P + 1] - base_l[:P], P, B, F,
+            jnp.uint8, n_sel)
+        return (s + 1.0 + out[0, 0].astype(jnp.float32) * 1e-20
+                + hist[0, 0, 0, 0] * 1e-20
+                + (tr2[0] + rs2[0]).astype(jnp.float32) * 1e-20)
+
+    t_wired, sp_wired = _timed_fori(wired_step, K, reps,
+                                    rec_lay, tile_run, run_slot)
+
+    # ---- legacy arm: the per-expansion-level sort+gather pass -------------
+    records = pallas_hist.make_records(Xb, g, h)
+    cnt0 = np.bincount(slot_np[half_np], minlength=P).astype(np.int32)
+    sel0 = jnp.asarray(np.where(half_np, slot_np, P).astype(np.int32))
+    cnt0_d = jnp.asarray(cnt0)
+    sel_rows = int(cnt0.sum())       # exact draw count (tile_plan contract)
+
+    # Xb/g/h as ARGUMENTS, never closures (HTTP 413 jit-constant rule —
+    # see deep_level_probe's legacy arm)
+    def legacy_step(s, sel0, cnt0_d, records, Xb, g, h):
+        si = s.astype(jnp.int32)
+        sel = jnp.where(sel0 < P, (sel0 + si) % P, P)  # perturb the SORT KEY
+        cnt = jnp.roll(cnt0_d, si)
+        hist = build_hist_segmented(
+            Xb, g, h, sel, P, B, backend="pallas",
+            rows_bound=sel_rows, records=records, sel_counts=cnt)
+        return s + 1.0 + hist[0, 0, 0, 0] * 1e-20
+
+    t_legacy, sp_legacy = _timed_fori(legacy_step, K, reps,
+                                      sel0, cnt0_d, records, Xb, g, h)
+    return {
+        "leafwise_level_ms_wired": round(t_wired, 1),
+        "leafwise_level_ms_legacy": round(t_legacy, 1),
+        "leafwise_level_spread_wired": round(sp_wired, 3),
+        "leafwise_level_spread_legacy": round(sp_legacy, 3),
+        "leafwise_level_rows": rows,
     }
 
 
@@ -339,6 +489,14 @@ def main() -> None:
     if os.environ.get("BENCH_DEEP", "1") != "0":
         probe_rows = out.get("rows_10m", rows)
         probe = deep_level_probe(probe_rows)
+        if probe:
+            out.update(probe)
+
+    # ---- wired-vs-legacy leaf-wise expansion-level walls (r10) --------------
+    # Same trend-not-point rule as BENCH_DEEP; BENCH_LEAFWISE=0 skips.
+    if os.environ.get("BENCH_LEAFWISE", "1") != "0":
+        probe_rows = out.get("rows_10m", rows)
+        probe = leafwise_level_probe(probe_rows)
         if probe:
             out.update(probe)
 

@@ -153,15 +153,18 @@ class Params:
     lambdarank_truncation: int = 30
     # Engine knobs (TPU path)
     hist_backend: str = "auto"   # auto | xla | pallas
-    # Deep-phase data movement for the level-wise grower: "auto" carries
-    # the leaf-ordered record layout through deep levels (no per-level
-    # sort / record gather) whenever the config admits it
-    # (engine/levelwise.deep_layout_supported); "legacy" forces the
-    # plan-based sort+gather path — the comparison arm for the on-device
-    # parity gate and benches.  Switching arms changes program/fusion
-    # shapes, so fp32 near-tie argmaxes may flip between them (the
-    # documented chunked-vs-dispatch tolerance class in engine/train.py);
-    # model quality is unaffected.
+    # Per-level data movement for BOTH level-synchronous growers
+    # (levelwise + the batched leaf-wise expansion): "auto" carries the
+    # leaf-ordered record layout through every level from the root (no
+    # per-level sort / record gather, no shallow->deep handoff) whenever
+    # the config admits it (engine/levelwise.deep_layout_supported; the
+    # leaf-wise expansion adds a run-capacity depth cap on top —
+    # engine/leafwise_fast.leafwise_layout_supported); "legacy" forces
+    # the plan-based sort+gather path — the comparison arm for the
+    # on-device parity gates and benches.  Switching arms changes
+    # program/fusion shapes, so fp32 near-tie argmaxes may flip between
+    # them (the documented chunked-vs-dispatch tolerance class in
+    # engine/train.py); model quality is unaffected.
     deep_layout: str = "auto"    # auto | legacy
     # Cap on boosting iterations fused into one device program (the chunked
     # dispatch path in engine/train.py).  0 = no cap beyond the calibrated

@@ -41,11 +41,17 @@ bandwidth-bound, not access-bound), and no per-level sort exists at all.
 Self-contained and bitwise-tested in interpret mode
 (tests/test_leafperm.py); ``scripts/exp_r5_perm.py`` measures it
 on-device against the sort+gather pair it replaces (51.4 vs
-164.1 ms/level at 10M).  WIRED into ``levelwise.py``'s deep phase in r6:
-the grower carries (rec, tile_run, run_slot) through its level fori
-state via ``initial_layout``/``advance_runs`` below, and
-``scripts/smoke_tpu.py --gate`` pins wired-vs-legacy tree equality on
-device.
+164.1 ms/level at 10M).  WIRED into ``levelwise.py``'s deep phase in r6
+and EVERYWHERE in r10: both level-synchronous growers
+(``levelwise.py`` — shallow AND deep levels — and the batched leaf-wise
+expansion in ``leafwise_fast.py``) carry (rec, tile_run, run_slot)
+through their level fori state.  The layout is now anchored at the ROOT
+(``natural_root_layout``: the natural-order record buffer IS a valid
+one-segment layout, out-of-bag rows encoded as sentinels), so the old
+shallow->deep handoff sort+gather per tree (``initial_layout``) is gone
+from the growers too — it remains as the probe/oracle constructor for
+mid-tree layouts (bench, tests).  ``scripts/smoke_tpu.py --gate`` pins
+wired-vs-legacy tree equality on device for both growers.
 """
 
 from __future__ import annotations
@@ -286,10 +292,16 @@ def tiles_bound(n_rows: int, n_parents: int, T: int = _TILE_ROWS) -> int:
 _REC_WB = 128
 
 
-def make_layout_records(Xb: jnp.ndarray, g: jnp.ndarray,
-                        h: jnp.ndarray) -> jnp.ndarray:
+def make_layout_records(Xb: jnp.ndarray, g: jnp.ndarray, h: jnp.ndarray,
+                        valid: jnp.ndarray | None = None) -> jnp.ndarray:
     """(N, _REC_WB) uint8 layout records in natural row order — the
-    root-segment initial layout (pad to tile multiples before use)."""
+    root-segment initial layout (pad to tile multiples before use).
+
+    ``valid`` (N,) bool marks rows that participate (the bag mask for a
+    root-anchored layout): rows outside it get valid flag 0 and are
+    DROPPED by the first level's move (the side derivation sends
+    flag-0 rows to the sentinel plane), so out-of-bag rows never ride a
+    permute past level 0."""
     N, F = Xb.shape
     nbytes = F * Xb.dtype.itemsize
     assert 9 + nbytes <= _REC_WB, "feature bytes exceed the record"
@@ -299,7 +311,8 @@ def make_layout_records(Xb: jnp.ndarray, g: jnp.ndarray,
         h.astype(jnp.float32), jnp.uint8).reshape(N, 4)
     xb = (jax.lax.bitcast_convert_type(Xb, jnp.uint8).reshape(N, nbytes)
           if Xb.dtype != jnp.uint8 else Xb)
-    flag = jnp.ones((N, 1), jnp.uint8)
+    flag = (jnp.ones((N, 1), jnp.uint8) if valid is None
+            else valid.astype(jnp.uint8).reshape(N, 1))
     rec = jnp.concatenate([gb, hb, flag, xb], axis=1)
     return jnp.pad(rec, ((0, 0), (0, _REC_WB - rec.shape[1])))
 
@@ -446,10 +459,46 @@ def wired_sel_tiles_bound(n_row_tiles: int, n_buf_tiles: int,
     return n_buf_tiles + 2 * num_cols
 
 
+def natural_root_layout(rec_nat: jnp.ndarray, num_runs: int,
+                        n_buf_tiles: int, first_slot: int = 0,
+                        sentinel: int | None = None,
+                        axis_name: str | None = None):
+    """Root-anchored layout (r10): the natural-order record buffer IS a
+    valid layout with ONE segment — run 0 owns every tile, rows the
+    caller marked invalid (``make_layout_records``' ``valid`` arg, i.e.
+    out-of-bag) are dropped by level 0's move.  NO sort, NO gather: this
+    replaces the shallow->deep ``initial_layout`` handoff entirely when
+    the layout is live from level 0.
+
+    Returns (rec_lay, tile_run, run_slot): records padded to
+    ``n_buf_tiles`` tiles, all tiles in run 0, and a (num_runs,) dense
+    run->slot table holding ``first_slot`` at run 0 and ``sentinel``
+    (default ``num_runs``) elsewhere.  Under ``shard_map`` pass
+    ``axis_name`` so the carried bookkeeping state enters the level loop
+    device-varying like the outputs that replace it (same vma rule as
+    permute_records' aliased zero init)."""
+    N = rec_nat.shape[0]
+    T = _TILE_ROWS
+    assert N <= n_buf_tiles * T, (N, n_buf_tiles)
+    rec_lay = jnp.pad(rec_nat, ((0, n_buf_tiles * T - N), (0, 0)))
+    sent = num_runs if sentinel is None else sentinel
+    tile_run = jnp.zeros((n_buf_tiles,), jnp.int32)
+    run_slot = jnp.full((num_runs,), sent, jnp.int32).at[0].set(first_slot)
+    if axis_name is not None:
+        tile_run = jax_compat.pcast_varying(tile_run, axis_name)
+        run_slot = jax_compat.pcast_varying(run_slot, axis_name)
+    return rec_lay, tile_run, run_slot
+
+
 def initial_layout(rec_nat: jnp.ndarray, sel: jnp.ndarray,
                    live: jnp.ndarray, num_slots: int, n_buf_tiles: int):
-    """The ONE per-tree handoff: group natural-order layout records by
-    leaf slot into the tile-aligned leaf-ordered layout.
+    """Mid-tree layout constructor: group natural-order layout records by
+    leaf slot into the tile-aligned leaf-ordered layout.  Was the r6
+    growers' shallow->deep handoff; since the r10 root anchoring
+    (``natural_root_layout``) the growers never call it — it remains the
+    bench probe's and the oracle tests' way to build a layout at an
+    arbitrary tree depth (one ``tile_plan`` stable sort + one full-N
+    record gather — exactly the pair the wired growers no longer pay).
 
     ``sel`` (N,) int32 in [0, L]; L drops the row (out-of-bag rows never
     enter the layout — their records would only ride dead weight through
@@ -485,7 +534,8 @@ def initial_layout(rec_nat: jnp.ndarray, sel: jnp.ndarray,
 
 def advance_runs(run_slot: jnp.ndarray, run_do: jnp.ndarray,
                  run_right: jnp.ndarray, base_l: jnp.ndarray,
-                 base_r: jnp.ndarray, n_buf_tiles: int):
+                 base_r: jnp.ndarray, n_buf_tiles: int,
+                 sentinel: int | None = None):
     """Next level's (tile_run, run_slot) after ``level_moves``.
 
     ``run_do`` (L,) marks runs whose slot split this level; ``run_right``
@@ -495,9 +545,19 @@ def advance_runs(run_slot: jnp.ndarray, run_do: jnp.ndarray,
     in run order).  Marking each kept segment's first tile and counting
     marks per tile yields the ascending tile->run map; everything between
     kept starts (empty mandatory segments, slack, the trailing buffer) is
-    absorbed into the preceding run."""
+    absorbed into the preceding run.
+
+    ``sentinel`` is the "unused run" slot value (default: the run
+    capacity L, the levelwise convention where slot ids < L).  The
+    batched leaf-wise grower stores heap NODE ids (which exceed its run
+    capacity) and passes sentinel = HN; when a kept run's slot id must
+    CHANGE across the level (leaf-wise: the left child's node is 2n, not
+    n), pre-apply that update to ``run_slot`` before calling — this
+    helper only reads liveness from it and writes the appended right
+    runs."""
     L = run_slot.shape[0]
-    R = jnp.sum((run_slot < L).astype(jnp.int32))
+    sent = L if sentinel is None else sentinel
+    R = jnp.sum((run_slot < sent).astype(jnp.int32))
     ridx = jnp.arange(L, dtype=jnp.int32)
     marks = jnp.zeros((n_buf_tiles,), jnp.int32)
     marks = marks.at[jnp.where(ridx < R, base_l[:L], n_buf_tiles)].add(

@@ -37,6 +37,21 @@ cannot be pre-expanded), so ``grow_any`` routes here only for
 Distribution contract matches levelwise.py: call under ``shard_map`` with
 rows sharded; the fused psum inside the histogram builders is the only
 collective; the selection runs replicated-identically on every shard.
+
+Layout-wired expansion (r10): when ``leafwise_layout_supported`` admits
+the config, the expansion fori carries the leaf-ordered record layout
+(engine/leafperm.py) exactly as levelwise does — anchored at the root
+(the natural-order record buffer, out-of-bag rows as sentinels), sides
+derived from the layout records via the same packed-word arithmetic as
+the natural-order partition, rows moved by the stable per-tile MXU
+compaction, smaller children histogrammed as contiguous tile runs.  The
+run bookkeeping stores heap NODE ids (``run_slot`` -> node): a split
+keeps the parent's run for the LEFT child (node 2n) and appends a run
+for the right (2n+1), so runs still ascend with tile position and
+``leafperm.advance_runs`` applies with sentinel HN.  The per-expansion-
+level sort + full-N record gather are gone from this path; the
+expansion≡sequential equivalence and the psum-only collective contract
+are untouched (test_leafwise_fast / test_leafperm_sharded).
 """
 
 from __future__ import annotations
@@ -95,6 +110,43 @@ def phase_plan(depth_cap: int):
     return d_switch, P_narrow, P_full
 
 
+# Run-capacity cap for the layout-wired expansion: the deepest move can
+# produce one segment per level-D heap node, so the dense run bookkeeping
+# is (2^D,)-wide and level_moves mandates >= 2*2^D + 2 tiles per level
+# (one per run index per region) — the same structural cost class as
+# levelwise's 512-leaf bound (2L+2 tiles).  At 2^D = 1024 that is ~1.05M
+# zero-sentinel rows per level; past it the mandated movement stops being
+# noise for any row count the expansion budget admits, while the
+# recoverable per-level sort+gather stays fixed (~164 ms/level at 10M) —
+# so deeper caps keep the legacy plan path (a written verdict, not a
+# TODO; the gate cannot consult N — same-program rule).
+_MAX_WIRED_SEGMENTS = 1024
+
+
+def leafwise_layout_supported(p: Params, num_features: int, total_bins: int,
+                              bin_itemsize: int,
+                              platform: str | None = None) -> bool:
+    """Static gate for the layout-wired batched leaf-wise expansion.
+
+    Rides levelwise's ``deep_layout_supported`` (one gate surface: same
+    record-width / bin / packed-word / backend exclusions and the
+    ``deep_layout="legacy"`` opt-out; its num_leaves <= 512 bound is
+    conservative here — leaf-wise runs are capped by expansion width,
+    not the leaf budget, but a second knob would just invite drift) plus
+    the expansion-width cap above.  Row-count free, like everything that
+    picks a histogram program (CLAUDE.md same-program rule)."""
+    from dryad_tpu.engine.levelwise import deep_layout_supported
+
+    if not deep_layout_supported(p, num_features, total_bins, bin_itemsize,
+                                 platform):
+        return False
+    # the expansion derives larger siblings by subtraction (supports()
+    # rejects non-subtraction configs before this gate is consulted)
+    if not p.hist_subtraction:
+        return False
+    return 0 < p.max_depth and (1 << p.max_depth) <= _MAX_WIRED_SEGMENTS
+
+
 def grow_tree_leafwise_batched(
     params: Params,
     total_bins: int,
@@ -124,10 +176,15 @@ def grow_tree_leafwise_batched(
 
     from dryad_tpu.engine.histogram import resolve_backend
 
+    # wired gate FIRST (r10): a layout-wired expansion never touches the
+    # plan-path record table or the natural-order tiles — skip both
+    use_layout = leafwise_layout_supported(p, F, B, Xb.dtype.itemsize,
+                                           platform)
+
     records = None
     nat_tiles = None
-    if resolve_backend(p.hist_backend, segmented=True,
-                       platform=platform) == "pallas":
+    if not use_layout and resolve_backend(p.hist_backend, segmented=True,
+                                          platform=platform) == "pallas":
         from dryad_tpu.engine import pallas_hist
 
         if pallas_hist.supports(B):
@@ -205,8 +262,39 @@ def grow_tree_leafwise_batched(
         "nd_lo": nd_lo, "nd_hi": nd_hi,
     }
 
+    # ---- wired (leaf-ordered layout) static plan (r10) -----------------------
+    # Run capacity NR = 2^D: the deepest move yields one segment per
+    # level-D heap node (leafwise_layout_supported caps it).  The shapes
+    # below come from the LOCAL row count, like every shard-local buffer.
+    from dryad_tpu.engine import leafperm
+
+    d_switch, P_narrow, _ = phase_plan(D)
+    NR = 1 << D
+    half_bound_ok = axis_name is None and N < (1 << 24)
+    n_buf_tiles = n_sel_narrow = n_sel_full = 0
+    if use_layout:
+        Tl = leafperm._TILE_ROWS
+        n_buf_tiles = leafperm.wired_tiles_bound(-(-N // Tl), NR)
+        # smaller children cover <= half the in-bag rows on a single
+        # device (min(left,right) <= parent/2, parents disjoint) — the
+        # same shared-bound rule as levelwise (see wired_sel_tiles_bound)
+        n_sel_narrow = leafperm.wired_sel_tiles_bound(
+            -(-N // Tl), n_buf_tiles, P_narrow, half=half_bound_ok)
+        n_sel_full = leafperm.wired_sel_tiles_bound(
+            -(-N // Tl), n_buf_tiles, Pf, half=half_bound_ok)
+        # root-anchored layout: the natural-order record buffer IS the
+        # root layout (run 0 -> heap node 1, sentinel HN elsewhere);
+        # out-of-bag rows enter sentinel-flagged and are dropped by level
+        # 0's move — no sort, no gather, no handoff
+        rec_nat = leafperm.make_layout_records(Xb, g, h, valid=bag_mask)
+        lay_rec, lay_tr, lay_ns = leafperm.natural_root_layout(
+            rec_nat, NR, n_buf_tiles, first_slot=1, sentinel=HN,
+            axis_name=axis_name)
+        exp_st = dict(exp_st, lay_rec=lay_rec, lay_tile_run=lay_tr,
+                      lay_run_slot=lay_ns)
+
     # ---- expansion: every valid split, level-synchronously -------------------
-    def make_level_body(P, use_nat=False):
+    def make_level_body(P, use_nat=False, use_layout=False, n_sel_tiles=0):
         def level_body(d, st):
             base = jnp.left_shift(jnp.int32(1), d)         # level-d heap base
             W = base                                        # level width
@@ -226,6 +314,7 @@ def grow_tree_leafwise_batched(
             # masked-reduce scheme as levelwise.py (measured there).
             rn = st["row_node"]
             valid_n = st["nd_gain"] > NEG_INF
+            rec_t = None
             if B <= (1 << 13):
                 cat_n = (is_cat_feat[jnp.maximum(st["nd_feature"], 0)]
                          if has_cat else jnp.zeros((HN,), bool))
@@ -237,19 +326,36 @@ def grow_tree_leafwise_batched(
                 rec_t = jnp.stack(
                     [w0_t, jnp.maximum(st["nd_feature"], 0).astype(jnp.uint32)],
                     axis=1)
-                rec_r = rec_t[rn]
-                w0r = rec_r[:, 0]
-                rf = rec_r[:, 1].astype(jnp.int32)
-                row_do = (w0r >> 31) != 0
-                bins_rf = levelwise.select_bins(Xb, rf)
-                go_left = bins_rf <= ((w0r >> 16)
-                                      & jnp.uint32(0x1FFF)).astype(jnp.int32)
-                if learn_missing:
-                    go_left &= ((w0r >> 30) & 1).astype(bool) | (bins_rf > 0)
-                if has_cat:
-                    cat_row = st["nd_catmask"][rn, jnp.minimum(bins_rf, Bc - 1)]
-                    go_left = jnp.where(((w0r >> 29) & 1).astype(bool),
-                                        cat_row, go_left)
+
+                def packed_route(nodes, bins_of, rr=None):
+                    """Per-row routing off the packed per-NODE table:
+                    (splits?, goes-left?).  Shared by the natural-order
+                    partition and the layout side derivation so the two
+                    can never disagree on a row (identical integer/bool
+                    arithmetic — levelwise.packed_route's convention).
+                    ``rr`` lets the caller pass a pre-composed per-row
+                    record (one small-table gather instead of two
+                    chained ones); ``nodes`` is then only consulted for
+                    the categorical bitset row."""
+                    if rr is None:
+                        rr = rec_t[nodes]                    # ONE gather
+                    w0r = rr[:, 0]
+                    rf = rr[:, 1].astype(jnp.int32)
+                    bins_rf = bins_of(rf)
+                    gl = bins_rf <= ((w0r >> 16)
+                                     & jnp.uint32(0x1FFF)).astype(jnp.int32)
+                    if learn_missing:
+                        gl &= ((w0r >> 30) & 1).astype(bool) | (bins_rf > 0)
+                    if has_cat:
+                        cat_row = st["nd_catmask"][
+                            jnp.minimum(nodes, HN - 1),
+                            jnp.minimum(bins_rf, Bc - 1)]
+                        gl = jnp.where(((w0r >> 29) & 1).astype(bool),
+                                       cat_row, gl)
+                    return ((w0r >> 31) != 0), gl
+
+                row_do, go_left = packed_route(
+                    rn, lambda rf: levelwise.select_bins(Xb, rf))
             else:
                 row_do = valid_n[rn]
                 rf = jnp.maximum(st["nd_feature"][rn], 0)
@@ -267,35 +373,106 @@ def grow_tree_leafwise_batched(
 
             # ---- one batched histogram pass for all smaller children -----
             left_smaller = CL <= CR
-            small_heap = 2 * idx + jnp.where(left_smaller, 0, 1)
-            colof = jnp.full((HN,), P, jnp.int32).at[
-                jnp.where(do, small_heap, HN)].set(jarr, mode="drop")
-            smallsel = jnp.where(bag_mask, colof[row_node], P)
-            bound_ok = axis_name is None and N < (1 << 24)
-            if use_nat:
-                from dryad_tpu.engine import pallas_hist
-
-                hist_small = pallas_hist.build_hist_small(
-                    nat_tiles, g, h, smallsel, P, B, F,
-                    axis_name=axis_name, platform=platform)
+            lay_new = None
+            if use_layout:
+                # WIRED level (r10): no per-level sort, no full-N record
+                # gather.  Sides come off the carried layout's records
+                # via the SAME packed_route arithmetic as the
+                # natural-order partition above; one stable per-tile MXU
+                # compaction moves the rows; the smaller children read
+                # back as contiguous tile runs of the new layout.
+                Tl = leafperm._TILE_ROWS
+                lay_rec = st["lay_rec"]
+                lay_tr = st["lay_tile_run"]
+                lay_ns = st["lay_run_slot"]           # run -> heap node
+                row_run = jnp.repeat(lay_tr, Tl)
+                # compose run -> packed word at the (NR,) level, then pay
+                # ONE per-row small-table gather (CLAUDE.md
+                # pack-the-lookups rule); sentinel runs (lay_ns = HN)
+                # compose to the zero pad row -> their rows route
+                # pass-through, and carry no valid rows anyway
+                rec_pad = jnp.concatenate(
+                    [rec_t, jnp.zeros((1, 2), jnp.uint32)])
+                rr_lay = rec_pad[jnp.minimum(lay_ns, HN)][row_run]
+                node_lay = lay_ns[row_run] if has_cat else None
+                _, _, valid_lay, xb_lay = leafperm.unpack_layout_records(
+                    lay_rec, F, Xb.dtype)
+                do_lay, left_lay = packed_route(
+                    node_lay, lambda rf: levelwise.select_bins(xb_lay, rf),
+                    rr=rr_lay)
+                side = jnp.where(
+                    valid_lay,
+                    jnp.where(do_lay & ~left_lay, 1, 0),
+                    2).astype(jnp.int32)
+                pos, dstl, dstr, base_l, base_r, _ = leafperm.level_moves(
+                    lay_tr, side, NR)
+                lay_rec = leafperm.permute_records(
+                    lay_rec, pos, dstl, dstr, lay_tr.shape[0],
+                    platform=platform, axis_name=axis_name)
+                # node -> run inverse BEFORE advancing (candidates are
+                # parents of this level's move); sentinel runs scatter
+                # past the (HN+1,) table so mode="drop" really drops them
+                node_run = jnp.full((HN + 1,), NR, jnp.int32).at[
+                    jnp.where(lay_ns < HN, lay_ns, HN + 1)].set(
+                        jnp.arange(NR, dtype=jnp.int32), mode="drop")
+                # a run's node carries a valid split only while that node
+                # is at the current level (the expansion splits it NOW) —
+                # left child keeps the run with node 2n, right child
+                # appends node 2n+1 (advance_runs' pre-update contract)
+                valid_tab = (rec_pad[:, 0] >> 31) != 0
+                run_do = valid_tab[jnp.minimum(lay_ns, HN)] & (lay_ns < HN)
+                ns2 = jnp.where(run_do, 2 * lay_ns, lay_ns)
+                lay_tr_new, lay_ns_new = leafperm.advance_runs(
+                    ns2, run_do, 2 * lay_ns + 1, base_l, base_r,
+                    lay_tr.shape[0], sentinel=HN)
+                lay_new = (lay_rec, lay_tr_new, lay_ns_new)
+                # smaller children = contiguous segments of the NEW layout
+                rj = node_run[idx]
+                rjc = jnp.minimum(rj, NR - 1)
+                lt_l = base_l[1:] - base_l[:-1]
+                lt_r = base_r[1:] - base_r[:-1]
+                sel_ok = do & (rj < NR)
+                seg_first = jnp.where(
+                    sel_ok,
+                    jnp.where(left_smaller, base_l[rjc], base_r[rjc]), 0)
+                seg_nt = jnp.where(
+                    sel_ok,
+                    jnp.where(left_smaller, lt_l[rjc], lt_r[rjc]), 0)
+                hist_small = leafperm.hist_from_layout(
+                    lay_rec, seg_first, seg_nt, P, B, F, Xb.dtype,
+                    n_sel_tiles, axis_name=axis_name, platform=platform)
             else:
-                # exact per-column counts (smaller-child C off the parent
-                # histogram) admit the pad-injected aligned sort — see
-                # levelwise.py / pallas_hist.tile_plan_aligned
-                small_cnt = (jnp.where(do, jnp.where(left_smaller, CL, CR),
-                                       0.0).astype(jnp.int32)
-                             if bound_ok else None)
-                hist_small = build_hist_segmented(
-                    Xb, g, h, smallsel, P, B,
-                    rows_per_chunk=p.rows_per_chunk, axis_name=axis_name,
-                    precision=p.hist_precision, backend=p.hist_backend,
-                    rows_bound=(N // 2 + 1) if bound_ok else None,
-                    platform=platform, records=records,
-                    sel_counts=small_cnt,
-                    # deep caps leave most expansion slots empty — exactly
-                    # where staged gather prefixes pay (see levelwise.py)
-                    stage_gather=L < Pf,
-                )
+                small_heap = 2 * idx + jnp.where(left_smaller, 0, 1)
+                colof = jnp.full((HN,), P, jnp.int32).at[
+                    jnp.where(do, small_heap, HN)].set(jarr, mode="drop")
+                smallsel = jnp.where(bag_mask, colof[row_node], P)
+                bound_ok = axis_name is None and N < (1 << 24)
+                if use_nat:
+                    from dryad_tpu.engine import pallas_hist
+
+                    hist_small = pallas_hist.build_hist_small(
+                        nat_tiles, g, h, smallsel, P, B, F,
+                        axis_name=axis_name, platform=platform)
+                else:
+                    # exact per-column counts (smaller-child C off the
+                    # parent histogram) admit the pad-injected aligned
+                    # sort inside build_hist_segmented — see levelwise.py
+                    small_cnt = (jnp.where(do,
+                                           jnp.where(left_smaller, CL, CR),
+                                           0.0).astype(jnp.int32)
+                                 if bound_ok else None)
+                    hist_small = build_hist_segmented(
+                        Xb, g, h, smallsel, P, B,
+                        rows_per_chunk=p.rows_per_chunk, axis_name=axis_name,
+                        precision=p.hist_precision, backend=p.hist_backend,
+                        rows_bound=(N // 2 + 1) if bound_ok else None,
+                        platform=platform, records=records,
+                        sel_counts=small_cnt,
+                        # deep caps leave most expansion slots empty —
+                        # exactly where staged gather prefixes pay (see
+                        # levelwise.py)
+                        stage_gather=L < Pf,
+                    )
             hist_large = st["hists"][jnp.minimum(jarr, Pf - 1)] - hist_small
             ls = left_smaller[:, None, None, None]
             hist_l = jnp.where(ls, hist_small, hist_large)
@@ -351,21 +528,25 @@ def grow_tree_leafwise_batched(
                 res.cat_mask, mode="drop")
             st_new["nd_lo"] = st["nd_lo"].at[cidx].set(ch_lo, mode="drop")
             st_new["nd_hi"] = st["nd_hi"].at[cidx].set(ch_hi, mode="drop")
+            if use_layout:
+                (st_new["lay_rec"], st_new["lay_tile_run"],
+                 st_new["lay_run_slot"]) = lay_new
             return st_new
         return level_body
 
-    d_switch, P_narrow, _ = phase_plan(D)
     exp_st = jax.lax.fori_loop(
         0, d_switch,
         make_level_body(P_narrow,
                         use_nat=nat_tiles is not None
-                        and P_narrow <= _nat_slots()),
+                        and P_narrow <= _nat_slots(),
+                        use_layout=use_layout, n_sel_tiles=n_sel_narrow),
         exp_st)
     if d_switch < D:
         exp_st = jax.lax.fori_loop(
             d_switch, D,
             make_level_body(Pf, use_nat=nat_tiles is not None
-                            and Pf <= _nat_slots()),
+                            and Pf <= _nat_slots(),
+                            use_layout=use_layout, n_sel_tiles=n_sel_full),
             exp_st)
 
     # ---- selection: replay grow_tree's slot machine on the gain tree ---------

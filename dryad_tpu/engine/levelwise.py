@@ -20,17 +20,21 @@ Distribution: identical contract to grower.py — call under ``shard_map``
 with rows sharded; the single per-level fused psum inside
 ``build_hist_multi`` is the only collective.
 
-Deep phase (r6, wired): levels past the shallow/deep switch carry the
-leaf-ordered record layout (engine/leafperm.py) through the level
-fori_loop state — sides derive from the layout records, one stable
-per-tile MXU compaction moves every row to its child segment, and the
-smaller children's histograms read the new layout as CONTIGUOUS tile
-runs.  The per-level packed ``(slot<<24 | row)`` sort and the full-N
-record gather are GONE from this path (measured 51.4 vs 164 ms/level at
-10M for the data movement they replaced); the plan-based path below
-remains only for configs the layout cannot take (see
-``deep_layout_supported``) and as the explicitly requested
-``deep_layout="legacy"`` comparison arm.
+Layout everywhere (r6 deep phase, r10 whole tree): when the gate admits
+(``deep_layout_supported``) the tree carries the leaf-ordered record
+layout (engine/leafperm.py) through the level fori_loop state from
+LEVEL 0 — the natural-order record buffer is the root layout
+(``leafperm.natural_root_layout``: one segment, out-of-bag rows as
+sentinels), sides derive from the layout records, one stable per-tile
+MXU compaction moves every row to its child segment, and the children's
+histograms read the new layout as CONTIGUOUS tile runs.  The per-level
+packed ``(slot<<24 | row)`` sort and the full-N record gather are GONE
+(measured 51.4 vs 164 ms/level at 10M for the data movement they
+replaced), and so is the r6 shallow->deep handoff sort+gather per tree
+— nothing on the wired path ever sorts rows.  The plan-based path below
+remains only for configs the layout cannot take (each exclusion's
+verdict is written in ``deep_layout_supported``) and as the explicitly
+requested ``deep_layout="legacy"`` comparison arm.
 """
 
 from __future__ import annotations
@@ -95,28 +99,50 @@ def select_bins(Xb: jnp.ndarray, rf: jnp.ndarray) -> jnp.ndarray:
 def deep_layout_supported(p: Params, num_features: int, total_bins: int,
                           bin_itemsize: int,
                           platform: str | None = None) -> bool:
-    """Static gate for the wired (leaf-ordered layout) deep phase.
+    """Static gate for the wired (leaf-ordered layout) level-wise grower
+    — since r10 the layout is live from LEVEL 0 (root-anchored), so this
+    gates the whole tree, not just the deep phase.
 
     A pure function of (params, feature/bin shape, platform) — NEVER of
     the row count, which under ``shard_map`` is the local shard and would
     let 1-shard and N-shard runs of the same data choose different
-    histogram programs (the CLAUDE.md same-program rule).  Configs outside
-    the gate keep the legacy plan path (sort + record gather), which is
-    the layout path's retirement condition: the legacy deep path can only
-    be deleted once every exclusion below is lifted or measured
-    irrelevant.  Exclusions:
+    histogram programs (the CLAUDE.md same-program rule).  Exclusion
+    verdicts (r10 retirement pass — each is either LIFTED with parity
+    tests or kept with the measurement that makes it irrelevant):
 
-    * non-Pallas histogram backends (the layout feeds the tile kernel);
-    * bins past the Pallas cap (``pallas_hist.supports``);
-    * ``hist_subtraction=False`` (the wired level histograms only the
-      smaller children; the dense both-children pass stays legacy);
-    * records wider than the 128-byte layout record
-      (9 + F*itemsize > _REC_WB — Epsilon-shaped data stays legacy);
-    * the exotic partition shapes that fall off the packed-word route
-      (bins > 8192 / leaves >= 65536 — the side derivation rides the same
-      packed per-slot table as the natural-order partition);
-    * leaf budgets past 512 (the dense run bookkeeping mandates 2L tiles
-      per level — past that the empty-segment overhead stops being noise);
+    * ``hist_subtraction=False`` — LIFTED (r10): the wired level
+      histograms BOTH children in one 2P-column ``hist_from_layout``
+      pass over the new layout's contiguous runs (every live row read
+      exactly once — cheaper than the legacy small-pass + full
+      ``build_hist_multi`` pair); parity pinned by
+      ``test_wired_no_subtraction_matches_legacy``.
+    * wide records (9 + F*itemsize > _REC_WB = 128 B) — KEPT, measured
+      irrelevant: the layout's win is the deleted per-level sort +
+      record gather, whose cost is access-bound and scales with N
+      (~164 ms/level at 10M with 128 B records ≈ ~7 ms/level at
+      Epsilon's 400k rows), while an Epsilon-shaped record (9 + 2000 B
+      -> 16x the granule) multiplies every level's MOVED bytes ~16x —
+      the compaction alone would cost more than the sort+gather it
+      replaces (scaling exp_r5_perm's 51.4 ms/level by 0.4/10 rows x
+      16x bytes ≈ 33 ms/level vs ~7 to win back).  Wide-feature shapes
+      already dodge the per-row gather via the partition reduce
+      (exp_r5_eps: 11.1 ms/pass at 400k x 2000), so there is no
+      ~110 ms/level to recover on this path.
+    * leaf budgets past 512 — KEPT, structural: the (L,)-dense run
+      bookkeeping mandates >= 2L+2 tiles per level (every unused run
+      index owns a mandatory tile per region — level_moves contract).
+      At L=512 that is ~525k zero-sentinel rows per level, ~5% of the
+      10M headline's movement; past 512 the mandated tiles grow
+      linearly in L while the recoverable sort+gather stays fixed at
+      ~164 ms/level, so the empty-segment movement stops being noise
+      for ANY row count the HBM budget admits (and the gate cannot
+      consult N — same-program rule above).
+    * non-Pallas histogram backends / bins past the Pallas cap
+      (``pallas_hist.supports``) — structural: the layout feeds the
+      tile kernel; there is no XLA consumer of a tile-aligned layout.
+    * bins > 8192 / leaves >= 65536 — structural: the side derivation
+      rides the same packed per-slot word as the natural-order
+      partition (13-bit threshold, 16-bit slot fields).
     * ``deep_layout="legacy"`` (explicit opt-out: smoke gate + bench
       comparison arms, and the escape hatch if wired drifts on device).
     """
@@ -129,8 +155,6 @@ def deep_layout_supported(p: Params, num_features: int, total_bins: int,
                        platform=platform) != "pallas":
         return False
     if not pallas_hist.supports(total_bins):
-        return False
-    if not p.hist_subtraction:
         return False
     L = p.effective_num_leaves
     if not (total_bins <= (1 << 13) and L < (1 << 16)):
@@ -182,6 +206,12 @@ def grow_tree_levelwise(
     depth_cap = p.max_depth
     assert depth_cap > 0, "levelwise growth requires max_depth > 0"
 
+    # wired gate FIRST (r10): a layout-wired tree is wired from level 0
+    # (root-anchored layout, no shallow->deep handoff) and never touches
+    # the plan-path record table or the natural-order tiles — skip
+    # building both (the record table alone is ~20 B/row of HBM)
+    use_layout = deep_layout_supported(p, F, B, Xb.dtype.itemsize, platform)
+
     # one per-TREE record table [g, h, X] for the Pallas levels: every
     # level's segmented histogram then pays ONE row gather instead of an X
     # gather + a g/h gather (pallas_hist.make_records)
@@ -189,8 +219,8 @@ def grow_tree_levelwise(
 
     records = None
     nat_tiles = None
-    if resolve_backend(p.hist_backend, segmented=True,
-                       platform=platform) == "pallas":
+    if not use_layout and resolve_backend(p.hist_backend, segmented=True,
+                                          platform=platform) == "pallas":
         from dryad_tpu.engine import pallas_hist
 
         if pallas_hist.supports(B):
@@ -287,30 +317,39 @@ def grow_tree_levelwise(
     d_switch, P_narrow, P_full = phase_plan(depth_cap, L,
                                             nat_tiles is not None)
 
-    # ---- wired deep phase (leaf-ordered layout) static plan ------------------
+    # ---- wired (leaf-ordered layout) static plan -----------------------------
     # The gate is row-count free (same program at every shard count); the
     # SHAPES below come from the local row count, as every shard-local
-    # buffer's do.
+    # buffer's do.  Since r10 the layout is live from level 0, so BOTH
+    # phases get a selection bound at their own candidate width.
     from dryad_tpu.engine import leafperm
 
-    use_layout = (d_switch < depth_cap
-                  and deep_layout_supported(p, F, B, Xb.dtype.itemsize,
-                                            platform))
     # the ONE exact-f32-counts / single-device predicate, shared by the
     # wired plan's half bound and the legacy arm's bound_ok below — the
     # two must never drift (an unsafe half-sized n_sel_tiles silently
     # truncates histograms, hist_from_layout contract)
     half_bound_ok = axis_name is None and N < (1 << 24)
-    n_buf_tiles = n_sel_tiles = 0
+    n_buf_tiles = n_sel_narrow = n_sel_full = 0
     if use_layout:
         Tl = leafperm._TILE_ROWS
         n_buf_tiles = leafperm.wired_tiles_bound(-(-N // Tl), L)
-        # smaller children cover <= half the (in-bag) rows on a single
-        # device (same argument as bound_ok below); under shard_map or
-        # past 2^24 rows no bound applies and the whole-layout tile count
-        # is the only safe cap (shared bound helper — see its doc)
-        n_sel_tiles = leafperm.wired_sel_tiles_bound(
-            -(-N // Tl), n_buf_tiles, P_full, half=half_bound_ok)
+        if p.hist_subtraction:
+            # smaller children cover <= half the (in-bag) rows on a single
+            # device (same argument as bound_ok below); under shard_map or
+            # past 2^24 rows no bound applies and the whole-layout tile
+            # count is the only safe cap (shared bound helper — see doc)
+            n_sel_narrow = leafperm.wired_sel_tiles_bound(
+                -(-N // Tl), n_buf_tiles, P_narrow, half=half_bound_ok)
+            n_sel_full = leafperm.wired_sel_tiles_bound(
+                -(-N // Tl), n_buf_tiles, P_full, half=half_bound_ok)
+        else:
+            # non-subtraction (r10 lift) histograms BOTH children in one
+            # 2P-column pass — the selection covers every live row, so
+            # only the whole-buffer bound applies
+            n_sel_narrow = leafperm.wired_sel_tiles_bound(
+                -(-N // Tl), n_buf_tiles, 2 * P_narrow, half=False)
+            n_sel_full = leafperm.wired_sel_tiles_bound(
+                -(-N // Tl), n_buf_tiles, 2 * P_full, half=False)
 
     st = {
         "row_slot": row_slot, "slot_node": slot_node, "slot_gain": slot_gain,
@@ -326,7 +365,7 @@ def grow_tree_levelwise(
         "num_nodes": num_nodes,
         "splits_done": splits_done, "max_depth": max_depth,
     }
-    def make_level_body(P, use_nat=False, use_layout=False):
+    def make_level_body(P, use_nat=False, use_layout=False, n_sel_tiles=0):
         def level_body(d, st):
             (row_slot, slot_node, slot_gain, slot_G, slot_H, slot_C, slot_depth,
              slot_lo, slot_hi,
@@ -471,16 +510,15 @@ def grow_tree_levelwise(
 
             # ---- one batched histogram pass for all smaller children ------------
             left_smaller = CL <= CR
-            small_slot = jnp.where(left_smaller, sj, right_slot)
-            large_slot = jnp.where(left_smaller, right_slot, sj)
             if use_layout:
-                # WIRED deep level (r6): no per-level sort, no full-N
-                # record gather.  Sides come straight off the carried
-                # leaf-ordered layout's records via the SAME packed_route
-                # arithmetic the natural-order partition used above (the
-                # two agree on every row — identical integer/bool math),
-                # one stable per-tile MXU compaction moves the rows, and
-                # the smaller children read back as contiguous tile runs.
+                # WIRED level (r6 deep phase, r10 everywhere): no
+                # per-level sort, no full-N record gather.  Sides come
+                # straight off the carried leaf-ordered layout's records
+                # via the SAME packed_route arithmetic the natural-order
+                # partition used above (the two agree on every row —
+                # identical integer/bool math), one stable per-tile MXU
+                # compaction moves the rows, and the children read back
+                # as contiguous tile runs.
                 lay_rec = st["lay_rec"]
                 lay_tr = st["lay_tile_run"]
                 lay_rs = st["lay_run_slot"]
@@ -524,24 +562,47 @@ def grow_tree_levelwise(
                 lay_tr_new, lay_rs_new = leafperm.advance_runs(
                     lay_rs, run_do, run_right, base_l, base_r,
                     lay_tr.shape[0])
-                # smaller children = contiguous segments of the NEW layout
+                # children = contiguous segments of the NEW layout
                 rj = slot_run[jnp.minimum(sj, L)]
                 rjc = jnp.minimum(rj, L - 1)
                 lt_l = base_l[1:] - base_l[:-1]
                 lt_r = base_r[1:] - base_r[:-1]
                 sel_ok = do & (rj < L)
-                seg_first = jnp.where(
-                    sel_ok,
-                    jnp.where(left_smaller, base_l[rjc], base_r[rjc]), 0)
-                seg_nt = jnp.where(
-                    sel_ok,
-                    jnp.where(left_smaller, lt_l[rjc], lt_r[rjc]), 0)
-                hist_small = leafperm.hist_from_layout(
-                    lay_rec, seg_first, seg_nt, P, B, F, Xb.dtype,
-                    n_sel_tiles, axis_name=axis_name, platform=platform)
+                if p.hist_subtraction:
+                    seg_first = jnp.where(
+                        sel_ok,
+                        jnp.where(left_smaller, base_l[rjc], base_r[rjc]), 0)
+                    seg_nt = jnp.where(
+                        sel_ok,
+                        jnp.where(left_smaller, lt_l[rjc], lt_r[rjc]), 0)
+                    hist_small = leafperm.hist_from_layout(
+                        lay_rec, seg_first, seg_nt, P, B, F, Xb.dtype,
+                        n_sel_tiles, axis_name=axis_name, platform=platform)
+                    hist_large = hists[sj] - hist_small
+                    ls = left_smaller[:, None, None, None]
+                    hist_l = jnp.where(ls, hist_small, hist_large)
+                    hist_r = jnp.where(ls, hist_large, hist_small)
+                else:
+                    # non-subtraction lift (r10): BOTH children in ONE
+                    # 2P-column pass — columns [left 0..P-1 | right
+                    # P..2P-1], every live row read exactly once (the
+                    # legacy arm pays a small pass + a full
+                    # build_hist_multi)
+                    segf2 = jnp.concatenate([
+                        jnp.where(sel_ok, base_l[rjc], 0),
+                        jnp.where(sel_ok, base_r[rjc], 0)])
+                    segn2 = jnp.concatenate([
+                        jnp.where(sel_ok, lt_l[rjc], 0),
+                        jnp.where(sel_ok, lt_r[rjc], 0)])
+                    h2 = leafperm.hist_from_layout(
+                        lay_rec, segf2, segn2, 2 * P, B, F, Xb.dtype,
+                        n_sel_tiles, axis_name=axis_name, platform=platform)
+                    hist_l, hist_r = h2[:P], h2[P:]
                 st = dict(st, lay_rec=lay_rec, lay_tile_run=lay_tr_new,
                           lay_run_slot=lay_rs_new)
             else:
+                small_slot = jnp.where(left_smaller, sj, right_slot)
+                large_slot = jnp.where(left_smaller, right_slot, sj)
                 # non-do candidates scatter to L+1 (out of bounds, dropped);
                 # out-of-bag rows are excluded by the explicit bag_mask gate
                 # below — row_slot itself stays in [0, L-1] for every row
@@ -553,59 +614,61 @@ def grow_tree_levelwise(
                 # partitioned but never accumulated
                 smallsel = jnp.where(bag_mask,
                                      colof[jnp.minimum(row_slot, L)], P)
-            # Single device, smaller children cover at most half the rows
-            # (min(left,right) <= parent/2, parents disjoint) -> half the tile
-            # grid.  Under shard_map the smaller child is chosen on GLOBAL
-            # counts and one shard's share of it may exceed half that shard, so
-            # no bound applies there; ditto above 2^24 rows, where the fp32
-            # histogram counts backing the smaller-child choice stop being exact.
-            bound_ok = half_bound_ok
-            if use_layout:
-                pass                                   # hist_small above
-            elif use_nat:
-                from dryad_tpu.engine import pallas_hist
+                # Single device, smaller children cover at most half the
+                # rows (min(left,right) <= parent/2, parents disjoint) ->
+                # half the tile grid.  Under shard_map the smaller child is
+                # chosen on GLOBAL counts and one shard's share of it may
+                # exceed half that shard, so no bound applies there; ditto
+                # above 2^24 rows, where the fp32 histogram counts backing
+                # the smaller-child choice stop being exact.
+                bound_ok = half_bound_ok
+                if use_nat:
+                    from dryad_tpu.engine import pallas_hist
 
-                hist_small = pallas_hist.build_hist_small(
-                    nat_tiles, g, h, smallsel, P, B, F,
-                    axis_name=axis_name, platform=platform)
-            else:
-                # exact per-column counts (smaller-child C off the parent
-                # histogram, integer-exact in f32 below 2**24) admit the
-                # pad-injected aligned sort inside build_hist_segmented —
-                # the plan's alignment gather drops out; single-device
-                # only, where the counts describe the whole selection
-                small_cnt = (jnp.where(do, jnp.where(left_smaller, CL, CR),
-                                       0.0).astype(jnp.int32)
-                             if bound_ok else None)
-                hist_small = build_hist_segmented(
-                    Xb, g, h, smallsel, P, B,
-                    rows_per_chunk=p.rows_per_chunk, axis_name=axis_name,
-                    precision=p.hist_precision, backend=p.hist_backend,
-                    rows_bound=(N // 2 + 1) if bound_ok else None,
-                    platform=platform, records=records,
-                    sel_counts=small_cnt,
-                    # staged prefixes only pay when the leaf budget caps
-                    # deep levels (fills provably collapse); a full tree
-                    # keeps every prefix ~100% and the extra gather
-                    # branches only bloat (remote) compile
-                    stage_gather=(L - 1) < (1 << (depth_cap - 1)),
-                )
-            if p.hist_subtraction:
-                hist_large = hists[sj] - hist_small
-            else:
-                largesel = jnp.full((L + 1,), P, jnp.int32).at[
-                    jnp.where(do, large_slot, L + 1)].set(
-                        jnp.arange(P, dtype=jnp.int32), mode="drop")
-                hist_large = build_hist_multi(
-                    Xb, g, h,
-                    jnp.where(bag_mask, largesel[jnp.minimum(row_slot, L)], P),
-                    P, B,
-                    rows_per_chunk=p.rows_per_chunk, axis_name=axis_name,
-                precision=p.hist_precision,
-                )
-            ls = left_smaller[:, None, None, None]
-            hist_l = jnp.where(ls, hist_small, hist_large)
-            hist_r = jnp.where(ls, hist_large, hist_small)
+                    hist_small = pallas_hist.build_hist_small(
+                        nat_tiles, g, h, smallsel, P, B, F,
+                        axis_name=axis_name, platform=platform)
+                else:
+                    # exact per-column counts (smaller-child C off the
+                    # parent histogram, integer-exact in f32 below 2**24)
+                    # admit the pad-injected aligned sort inside
+                    # build_hist_segmented — the plan's alignment gather
+                    # drops out; single-device only, where the counts
+                    # describe the whole selection
+                    small_cnt = (jnp.where(do,
+                                           jnp.where(left_smaller, CL, CR),
+                                           0.0).astype(jnp.int32)
+                                 if bound_ok else None)
+                    hist_small = build_hist_segmented(
+                        Xb, g, h, smallsel, P, B,
+                        rows_per_chunk=p.rows_per_chunk, axis_name=axis_name,
+                        precision=p.hist_precision, backend=p.hist_backend,
+                        rows_bound=(N // 2 + 1) if bound_ok else None,
+                        platform=platform, records=records,
+                        sel_counts=small_cnt,
+                        # staged prefixes only pay when the leaf budget caps
+                        # deep levels (fills provably collapse); a full tree
+                        # keeps every prefix ~100% and the extra gather
+                        # branches only bloat (remote) compile
+                        stage_gather=(L - 1) < (1 << (depth_cap - 1)),
+                    )
+                if p.hist_subtraction:
+                    hist_large = hists[sj] - hist_small
+                else:
+                    largesel = jnp.full((L + 1,), P, jnp.int32).at[
+                        jnp.where(do, large_slot, L + 1)].set(
+                            jnp.arange(P, dtype=jnp.int32), mode="drop")
+                    hist_large = build_hist_multi(
+                        Xb, g, h,
+                        jnp.where(bag_mask,
+                                  largesel[jnp.minimum(row_slot, L)], P),
+                        P, B,
+                        rows_per_chunk=p.rows_per_chunk, axis_name=axis_name,
+                        precision=p.hist_precision,
+                    )
+                ls = left_smaller[:, None, None, None]
+                hist_l = jnp.where(ls, hist_small, hist_large)
+                hist_r = jnp.where(ls, hist_large, hist_small)
             hists = hists.at[jnp.where(do, sj, L)].set(hist_l, mode="drop")
             hists = hists.at[jnp.where(do, right_slot, L)].set(hist_r, mode="drop")
 
@@ -673,36 +736,34 @@ def grow_tree_levelwise(
             return out
         return level_body
 
+    if use_layout:
+        # ---- root-anchored layout (r10): live from level 0 ------------------
+        # The natural-order record buffer IS the root layout (one
+        # segment, no sort, no gather); out-of-bag rows enter as
+        # sentinel-flagged records and are dropped by level 0's move.
+        # The shallow->deep handoff sort+gather per tree is GONE — the
+        # natural-order row_slot (still maintained above for the final
+        # row_leaf) keeps routing out-of-bag rows.
+        rec_nat = leafperm.make_layout_records(Xb, g, h, valid=bag_mask)
+        lay_rec, lay_tr, lay_rs = leafperm.natural_root_layout(
+            rec_nat, L, n_buf_tiles, axis_name=axis_name)
+        st = dict(st, lay_rec=lay_rec, lay_tile_run=lay_tr,
+                  lay_run_slot=lay_rs)
     st = jax.lax.fori_loop(
         0, d_switch,
         make_level_body(P_narrow,
                         use_nat=nat_tiles is not None
-                        and P_narrow <= pallas_hist_NAT_SLOTS()),
+                        and P_narrow <= pallas_hist_NAT_SLOTS(),
+                        use_layout=use_layout, n_sel_tiles=n_sel_narrow),
         st)
     if d_switch < depth_cap:
-        if use_layout:
-            # ---- the ONE shallow->deep handoff conversion -------------------
-            # Group the (bag-gated) rows by their depth-d_switch slot into
-            # the tile-aligned leaf-ordered layout: one stable sort + one
-            # full-N record gather PER TREE, amortized over every deep
-            # level (the legacy path paid both per LEVEL).  Out-of-bag
-            # rows never enter the layout — the natural-order row_slot
-            # (still maintained above for the final row_leaf) keeps
-            # routing them.
-            rec_nat = leafperm.make_layout_records(Xb, g, h)
-            sel_h = jnp.where(bag_mask, st["row_slot"], L).astype(jnp.int32)
-            live = st["slot_node"] >= 0
-            lay_rec, lay_tr, lay_rs = leafperm.initial_layout(
-                rec_nat, sel_h, live, L, n_buf_tiles)
-            st = dict(st, lay_rec=lay_rec, lay_tile_run=lay_tr,
-                      lay_run_slot=lay_rs)
         st = jax.lax.fori_loop(
             d_switch, depth_cap,
             make_level_body(P_full,
                             use_nat=not use_layout
                             and nat_tiles is not None
                             and P_full <= pallas_hist_NAT_SLOTS(),
-                            use_layout=use_layout),
+                            use_layout=use_layout, n_sel_tiles=n_sel_full),
             st)
 
     # ---- finalize leaf values + node bitsets (shared helpers) ----------------

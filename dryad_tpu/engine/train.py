@@ -346,13 +346,29 @@ def _comm_stats(p, F: int, B: int, K: int, n_shards: int,
         # the nat gate sees the PADDED global matrix (shard shapes), the
         # leafwise envelope below the UNPADDED N (grower.py rule)
         gate_rows = padded_rows if padded_rows is not None else num_rows
-        nat_live = (gate_rows is not None
+        # r10: a layout-wired tree never builds the nat tiles (the wired
+        # gate is consulted FIRST in grow_tree_levelwise), so its phase
+        # plan runs nat_live=False — mirror that here or the accounted
+        # d_switch/widths drift from the executed program
+        use_layout = levelwise.deep_layout_supported(p, F, B, bin_bytes,
+                                                     platform)
+        nat_live = (not use_layout
+                    and gate_rows is not None
                     and resolve_backend(p.hist_backend, segmented=True,
                                         platform=platform) == "pallas"
                     and pallas_hist.supports(B)
                     and pallas_hist.nat_gate_admits(gate_rows, F, bin_bytes))
         d_switch, P_narrow, P_full = levelwise.phase_plan(D, L, nat_live)
         widths = [P_narrow] * d_switch + [P_full] * (D - d_switch)
+        level_calls = len(widths)
+        if not p.hist_subtraction:
+            # both children are histogrammed (no subtraction): the wired
+            # path (r10 lift) pays ONE 2P-column hist_from_layout psum
+            # per level, the legacy path a P-column small pass PLUS a
+            # P-column build_hist_multi — same bytes, different calls
+            widths = [2 * w for w in widths]
+            if not use_layout:
+                level_calls = 2 * level_calls
     else:
         from dryad_tpu.engine import leafwise_fast
 
@@ -363,13 +379,14 @@ def _comm_stats(p, F: int, B: int, K: int, n_shards: int,
             widths = [P_narrow] * d_switch + [Pf] * (D - d_switch)
         else:
             widths = [1] * (L - 1)          # one masked pass per split
+        level_calls = len(widths)
     per_tree = fb + sum(w * fb for w in widths)   # root + levels
     # multiclass shared-plan roots fold the K root passes into ONE psum of
     # the (K, 3, F, B) classes-builder output (same bytes, fewer calls)
     root_calls = 1 if (shared_roots and K > 1) else K
     return {
         "n_shards": int(n_shards),
-        "psum_calls_per_iter": root_calls + len(widths) * K,
+        "psum_calls_per_iter": root_calls + level_calls * K,
         "psum_bytes_per_iter": per_tree * K,
     }
 

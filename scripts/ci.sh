@@ -7,13 +7,15 @@
 #
 cd "$(dirname "$0")/.." || exit 1
 
-# Wired-deep-phase lint (r6): engine/levelwise.py must never reach back to
-# the per-level sort helpers directly — the wired path's whole point is
-# that tile_plan/tile_plan_aligned are gone from the deep levels (the
-# legacy fallback reaches them only through build_hist_segmented).  A
-# direct reference here means the sort quietly re-grew; fail fast.
-if grep -nE 'tile_plan' dryad_tpu/engine/levelwise.py; then
-  echo "LINT FAIL: engine/levelwise.py references the per-level sort helper (tile_plan*)" >&2
+# Wired-grower lint (r6, widened to the batched leaf-wise grower in r10):
+# neither level-synchronous grower may reach back to the per-level sort
+# helpers directly — the wired path's whole point is that
+# tile_plan/tile_plan_aligned are gone from the growers (the legacy
+# fallback reaches them only through build_hist_segmented).  A direct
+# reference here means the deleted per-level sort/gather quietly re-grew;
+# fail fast.
+if grep -nE 'tile_plan' dryad_tpu/engine/levelwise.py dryad_tpu/engine/leafwise_fast.py; then
+  echo "LINT FAIL: a wired grower references the per-level sort helper (tile_plan*)" >&2
   exit 1
 fi
 

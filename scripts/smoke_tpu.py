@@ -148,8 +148,9 @@ def smoke_pallas_natural_order():
 
 
 def smoke_leafperm_wired_parity():
-    """Wired deep phase (leaf-ordered layout carried through levelwise's
-    level fori state) vs the legacy sort+gather path ON THE REAL DEVICE:
+    """Wired levelwise grower (leaf-ordered layout carried through the
+    level fori state, root-anchored since r10 so EVERY level is wired)
+    vs the legacy sort+gather path ON THE REAL DEVICE:
     bitwise-identical tree structures on the tie-free gate fixture, leaf
     values to fp32 tolerance (post-permute layouts regroup per-tile f32
     histogram sums at ulp level — the documented tolerance class).  The
@@ -180,15 +181,59 @@ def smoke_leafperm_wired_parity():
         "gate fixture no longer admits the wired path"
     d_switch, _, _ = phase_plan(p_w.max_depth, p_w.effective_num_leaves,
                                 True)
-    assert d_switch < p_w.max_depth, "fixture has no deep phase"
+    assert d_switch < p_w.max_depth, "fixture exercises only one fori phase"
     b_w = train_device(p_w, ds)
     b_l = train_device(make_params(dict(base, deep_layout="legacy")), ds)
     for k in ("feature", "threshold", "left", "right", "is_cat"):
         np.testing.assert_array_equal(
             b_w.tree_arrays()[k], b_l.tree_arrays()[k],
-            err_msg=f"wired vs legacy deep phase: {k!r}")
+            err_msg=f"wired vs legacy levelwise: {k!r}")
     np.testing.assert_allclose(b_w.value, b_l.value, atol=1e-5)
-    print("leafperm wired deep phase: trees bitwise vs legacy on device")
+    print("leafperm wired levelwise: trees bitwise vs legacy on device")
+
+
+def smoke_leafwise_wired_parity():
+    """Layout-wired batched leaf-wise expansion vs the legacy expansion ON
+    THE REAL DEVICE: bitwise-identical trees on the tie-free fixture, leaf
+    values to fp32 tolerance (same tolerance class as the levelwise smoke
+    above — post-permute layouts regroup per-tile f32 partial sums).  The
+    leaf-wise wiring's hardware-only risks are its own: heap-node run
+    bookkeeping with sentinel HN and run capacity 2^D drive the same DMA
+    movement kernel through different scalar prefetch values, which
+    interpret-mode CI cannot vouch for."""
+    import jax
+    import numpy as np
+
+    import dryad_tpu as dryad
+    from dryad_tpu.config import make_params
+    from dryad_tpu.datasets import higgs_like
+    from dryad_tpu.engine.leafwise_fast import (
+        leafwise_layout_supported, supports,
+    )
+    from dryad_tpu.engine.train import train_device
+
+    if jax.devices()[0].platform == "cpu":
+        print("leafwise wired parity: skipped (no accelerator attached)")
+        return
+    X, y = higgs_like(50_000, seed=43)
+    ds = dryad.Dataset(X, y, max_bins=64)
+    base = dict(objective="binary", num_trees=4, num_leaves=128,
+                max_bins=64, growth="leafwise", max_depth=8)
+    p_w = make_params(base)
+    B = int(ds.mapper.total_bins)
+    F = ds.X_binned.shape[1]
+    assert supports(p_w, F, B, ds.X_binned.shape[0]), \
+        "fixture no longer takes the batched expansion"
+    assert leafwise_layout_supported(p_w, F, B, ds.X_binned.dtype.itemsize), \
+        "gate fixture no longer admits the wired leaf-wise path"
+    b_w = train_device(p_w, ds)
+    b_l = train_device(make_params(dict(base, deep_layout="legacy")), ds)
+    for k in ("feature", "threshold", "left", "right", "is_cat"):
+        np.testing.assert_array_equal(
+            b_w.tree_arrays()[k], b_l.tree_arrays()[k],
+            err_msg=f"wired vs legacy leafwise expansion: {k!r}")
+    np.testing.assert_allclose(b_w.value, b_l.value, atol=1e-5)
+    print("leafwise wired expansion: trees bitwise vs legacy on device")
 
 
 def smoke_train_parity():
@@ -233,6 +278,7 @@ _ALL_SMOKES = [
     smoke_pallas_wide_segment_count,
     smoke_pallas_natural_order,
     smoke_leafperm_wired_parity,
+    smoke_leafwise_wired_parity,
 ]
 
 

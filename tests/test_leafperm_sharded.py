@@ -1,5 +1,6 @@
-"""Wired deep phase (leaf-ordered layout) under shard_map: N-shard
-training must reproduce 1-shard training past the shallow/deep handoff.
+"""Wired growers (leaf-ordered layout, r10: root-anchored and live from
+level 0 in BOTH level-synchronous growers) under shard_map: N-shard
+training must reproduce 1-shard training through every wired level.
 
 The wired path keeps every layout strictly shard-local (each shard
 permutes its own rows into its own tile-aligned buffer); the ONLY
@@ -28,9 +29,9 @@ from dryad_tpu.datasets import higgs_like
 # parity pins below are single-device and must survive a
 # `-m 'not distributed'` run.
 
-# depth 6 > d_switch 5 (nat pass live at these sizes) with P_full = 32
-# candidates: the deep phase runs at least one wired level per tree
-_DEEP = dict(objective="binary", num_trees=3, num_leaves=64, max_bins=32,
+# depth 6 > d_switch (both fori phases traced) with P_full = 32
+# candidates: the tree runs wired from the root through both phase widths
+_DEEP = dict(objective="binary", num_trees=2, num_leaves=64, max_bins=32,
              growth="depthwise", max_depth=6, hist_backend="pallas")
 
 
@@ -80,9 +81,10 @@ def test_sharded_wired_deep_phase_parity(mesh):
 
 @pytest.mark.distributed
 def test_sharded_wired_with_padding_and_bagging(mesh):
-    """Mesh-padded rows (N % 8 != 0) and out-of-bag rows must never enter
-    the layout (they are dropped at the handoff, not carried as dead
-    weight) — sharded trees still match single-device."""
+    """Mesh-padded rows (N % 8 != 0) and out-of-bag rows enter the
+    root-anchored layout sentinel-flagged and are dropped by level 0's
+    move (never carried as dead weight) — sharded trees still match
+    single-device."""
     from dryad_tpu.engine.train import train_device
 
     # seed chosen tie-free: deep bagged levels on this shape carry a few
@@ -94,6 +96,72 @@ def test_sharded_wired_with_padding_and_bagging(mesh):
     p = make_params(dict(_DEEP, num_trees=2, subsample=0.7, seed=3,
                          min_data_in_leaf=5))
     assert _gate_active(p, ds)
+    b1 = train_device(p, ds)
+    b8 = train_device(p, ds, mesh=mesh)
+    np.testing.assert_array_equal(b1.feature, b8.feature)
+    np.testing.assert_array_equal(b1.threshold, b8.threshold)
+
+
+# batched leaf-wise expansion (r10 wiring): heap-node run bookkeeping with
+# sentinel HN instead of leaf slots, run capacity 2^D — the second consumer
+# of the carried layout
+_LEAF = dict(objective="binary", num_trees=2, num_leaves=48, max_bins=32,
+             growth="leafwise", max_depth=6, hist_backend="pallas")
+
+
+def _leaf_gate_active(p, ds):
+    from dryad_tpu.engine.leafwise_fast import (
+        leafwise_layout_supported, supports,
+    )
+
+    F = ds.X_binned.shape[1]
+    B = int(ds.mapper.total_bins)
+    return (supports(p, F, B, ds.X_binned.shape[0])
+            and leafwise_layout_supported(p, F, B,
+                                          ds.X_binned.dtype.itemsize, "cpu"))
+
+
+def test_leafwise_gate_admits_fixture():
+    """The leaf-wise fixtures below must exercise the wired expansion —
+    same canary as test_wired_gate_admits_fixture for the levelwise file."""
+    X, y = higgs_like(1024, seed=47)
+    ds = dryad.Dataset(X, y, max_bins=32)
+    assert _leaf_gate_active(make_params(_LEAF), ds)
+
+
+@pytest.mark.distributed
+def test_sharded_wired_leafwise_parity(mesh):
+    """N-shard ≡ 1-shard through the WIRED batched leaf-wise expansion:
+    each shard carries its own root-anchored layout; the fused psum inside
+    the histogram builders stays the only collective."""
+    from dryad_tpu.engine.train import train_device
+
+    X, y = higgs_like(4096, seed=47)
+    ds = dryad.Dataset(X, y, max_bins=32)
+    p = make_params(_LEAF)
+    assert _leaf_gate_active(p, ds)
+    b1 = train_device(p, ds)
+    b8 = train_device(p, ds, mesh=mesh)
+    for k in ("feature", "threshold", "left", "right", "is_cat"):
+        np.testing.assert_array_equal(
+            b1.tree_arrays()[k], b8.tree_arrays()[k],
+            err_msg=f"wired leafwise: sharded vs single-device {k!r}")
+    np.testing.assert_allclose(b1.value, b8.value, atol=1e-3)
+
+
+@pytest.mark.distributed
+def test_sharded_wired_leafwise_padding_and_bagging(mesh):
+    """Mesh-padded rows (N % 8 != 0) and out-of-bag rows enter the
+    root-anchored layout as sentinel-flagged records and are dropped by
+    level 0's move — sharded wired leaf-wise trees still match
+    single-device (wired vs legacy single-device parity lives in
+    test_leafwise_fast.py::test_wired_batched_equals_legacy_batched)."""
+    from dryad_tpu.engine.train import train_device
+
+    X, y = higgs_like(4001, seed=43)
+    ds = dryad.Dataset(X, y, max_bins=32)
+    p = make_params(dict(_LEAF, subsample=0.7, seed=3, min_data_in_leaf=5))
+    assert _leaf_gate_active(p, ds)
     b1 = train_device(p, ds)
     b8 = train_device(p, ds, mesh=mesh)
     np.testing.assert_array_equal(b1.feature, b8.feature)
@@ -137,7 +205,7 @@ def test_wired_cat_missing_multiclass_matches_legacy():
     y = (((X[:, 0] > 0) | (np.nan_to_num(X[:, 3]) > 6)).astype(np.float32)
          + (X[:, 1] > 1))
     ds = dryad.Dataset(X, y, max_bins=32, categorical_features=[3])
-    base = dict(objective="multiclass", num_class=3, num_trees=2,
+    base = dict(objective="multiclass", num_class=3, num_trees=1,
                 num_leaves=64, max_bins=32, growth="depthwise", max_depth=6,
                 hist_backend="pallas", categorical_features=[3])
     bw = train_device(make_params(base), ds)
@@ -146,6 +214,43 @@ def test_wired_cat_missing_multiclass_matches_legacy():
               "cat_bitset", "default_left"):
         np.testing.assert_array_equal(
             bw.tree_arrays()[k], bl.tree_arrays()[k], err_msg=k)
+    np.testing.assert_allclose(bw.value, bl.value, atol=1e-5)
+
+
+def test_wired_no_subtraction_matches_legacy():
+    """The r10 exclusion LIFT: ``hist_subtraction=False`` now rides the
+    wired path too — the level histograms BOTH children in one 2P-column
+    ``hist_from_layout`` pass over the new layout's contiguous runs
+    instead of falling back to the legacy small-pass + full
+    ``build_hist_multi`` pair.  Cited by name in
+    ``deep_layout_supported``'s verdict list; pins the gate edge AND
+    tree parity vs the legacy arm.
+
+    Seed chosen tie-free: the wired no-subtraction arm is the only one
+    summing BOTH children in post-permute layout order (legacy sums in
+    natural order, the subtraction arms derive the large child by
+    parent-minus-small), so its grad/hess sums sit an ulp apart from
+    every other arm's and deep near-tie argmaxes can flip (seeds 59/47
+    flip 1-2 deep nodes, cascading; 43/29/53/61/7 are clean — the
+    documented program-shape tolerance class, counts stay exact per
+    test_leafperm's hist_from_layout oracles)."""
+    from dryad_tpu.engine.levelwise import deep_layout_supported
+    from dryad_tpu.engine.train import train_device
+
+    X, y = higgs_like(4096, seed=43)
+    ds = dryad.Dataset(X, y, max_bins=32)
+    base = dict(_DEEP, hist_subtraction=False)
+    p_w = make_params(base)
+    assert deep_layout_supported(p_w, ds.X_binned.shape[1],
+                                 int(ds.mapper.total_bins),
+                                 ds.X_binned.dtype.itemsize, "cpu"), \
+        "the hist_subtraction=False exclusion regressed (r10 lift)"
+    bw = train_device(p_w, ds)
+    bl = train_device(make_params(dict(base, deep_layout="legacy")), ds)
+    for k in ("feature", "threshold", "left", "right"):
+        np.testing.assert_array_equal(
+            bw.tree_arrays()[k], bl.tree_arrays()[k],
+            err_msg=f"wired (no-subtraction) vs legacy {k!r}")
     np.testing.assert_allclose(bw.value, bl.value, atol=1e-5)
 
 

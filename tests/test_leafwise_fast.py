@@ -111,6 +111,104 @@ def test_batched_equals_sequential_cat_and_missing():
     _assert_same_tree(seq, bat)
 
 
+def _wired_params(extra=None, **kw):
+    """A config the layout gate ADMITS on forced-CPU CI: interpret-mode
+    Pallas (hist_backend="pallas") + a depth within the run-capacity cap."""
+    base = dict(objective="l2", num_leaves=31, max_depth=6,
+                growth="leafwise", min_data_in_leaf=20,
+                hist_backend="pallas")
+    base.update(extra or {})
+    base.update(kw)
+    return make_params(base)
+
+
+def test_wired_gate_admits_fixture():
+    """The fixtures below must actually exercise the layout-wired
+    expansion — if the gate stops admitting them, this file would
+    silently test the legacy path.  Also pins the gate's own edges:
+    legacy opt-out, the run-capacity depth cap, and the XLA backend."""
+    from dryad_tpu.engine.leafwise_fast import leafwise_layout_supported
+
+    p = _wired_params()
+    assert leafwise_layout_supported(p, 8, 32, 1, "cpu")
+    assert not leafwise_layout_supported(
+        p.replace(deep_layout="legacy"), 8, 32, 1, "cpu")
+    # run-capacity cap: 2^max_depth must fit the dense run bookkeeping
+    assert leafwise_layout_supported(
+        _wired_params(num_leaves=512, max_depth=10), 8, 32, 1, "cpu")
+    assert not leafwise_layout_supported(
+        _wired_params(num_leaves=512, max_depth=11), 8, 32, 1, "cpu")
+    # CPU 'auto' resolves to XLA -> no tile layout to feed
+    assert not leafwise_layout_supported(
+        _wired_params(hist_backend="auto"), 8, 32, 1, "cpu")
+
+
+@pytest.mark.parametrize("leaves,depth,lm", [(31, 5, False), (15, 7, False),
+                                             (63, 6, True)])
+def test_wired_batched_equals_sequential(leaves, depth, lm):
+    """Layout-wired expansion (r10) ≡ sequential leaf-wise, tree for tree
+    incl. node numbering — the same equivalence the legacy expansion pins,
+    now with sides derived from the carried layout records and histograms
+    read as contiguous tile runs."""
+    from dryad_tpu.engine.leafwise_fast import leafwise_layout_supported
+
+    Xb, g, h, bag, fmask, iscat = _fixture()
+    p = _wired_params(num_leaves=leaves, max_depth=depth)
+    assert leafwise_layout_supported(p, Xb.shape[1], 32, 1, "cpu")
+    seq = grow_tree(p, 32, Xb, g, h, bag, fmask, iscat, learn_missing=lm)
+    bat = grow_tree_leafwise_batched(p, 32, Xb, g, h, bag, fmask, iscat,
+                                     learn_missing=lm, platform="cpu")
+    _assert_same_tree(seq, bat)
+
+
+def test_wired_batched_equals_legacy_batched():
+    """Wired vs legacy batched expansion on the tie-free fixture: bitwise
+    tree structures AND row_leaf (both derive sides from the same packed
+    arithmetic; only the histogram/movement programs differ)."""
+    Xb, g, h, bag, fmask, iscat = _fixture()
+    p_w = _wired_params()
+    bat_w = grow_tree_leafwise_batched(p_w, 32, Xb, g, h, bag, fmask, iscat,
+                                       platform="cpu")
+    bat_l = grow_tree_leafwise_batched(p_w.replace(deep_layout="legacy"),
+                                       32, Xb, g, h, bag, fmask, iscat,
+                                       platform="cpu")
+    for key in ("feature", "threshold", "left", "right", "default_left",
+                "is_cat", "cat_bitset", "row_leaf"):
+        np.testing.assert_array_equal(np.asarray(bat_w[key]),
+                                      np.asarray(bat_l[key]), err_msg=key)
+    np.testing.assert_allclose(np.asarray(bat_w["value"]),
+                               np.asarray(bat_l["value"]), rtol=1e-4,
+                               atol=2e-6)
+
+
+def test_wired_batched_cat_and_missing_equals_sequential():
+    """The wired side derivation's categorical-bitset and learned-missing
+    branches (packed_route bits 29/30 against heap-node tables) — the
+    interaction most likely to regress silently, now over the carried
+    layout records."""
+    rng = np.random.default_rng(11)
+    n, f, b = 20_000, 8, 32
+    Xb_np = rng.integers(1, b, size=(n, f), dtype=np.uint8)
+    miss = rng.random((n, f)) < 0.25
+    miss[:, 0] = False
+    miss[:, 3] = False
+    Xb_np[miss] = 0
+    Xb = jnp.asarray(Xb_np)
+    yv = rng.normal(size=n)
+    g = jnp.asarray((yv + rng.normal(size=n) * 0.1).astype(np.float32))
+    h = jnp.asarray(rng.uniform(0.5, 1.5, size=n).astype(np.float32))
+    bag = jnp.asarray(rng.random(n) < 0.9)
+    fmask = jnp.ones((f,), bool)
+    iscat = jnp.zeros((f,), bool).at[0].set(True).at[3].set(True)
+    p = _wired_params()
+    seq = grow_tree(p, b, Xb, g, h, bag, fmask, iscat, has_cat=True,
+                    learn_missing=True)
+    bat = grow_tree_leafwise_batched(p, b, Xb, g, h, bag, fmask, iscat,
+                                     has_cat=True, learn_missing=True,
+                                     platform="cpu")
+    _assert_same_tree(seq, bat)
+
+
 def test_effective_depth_policy():
     """max_depth=-1 maps to min(ceil(log2(L))+4, 14) under 'auto' whenever
     the batched grower can take the config; 'exact' and infeasible shapes

@@ -150,7 +150,12 @@ def test_leafwise_pallas_matches_xla_trees():
 
     X, y = higgs_like(4000, seed=13)
     ds = dryad.Dataset(X, y, max_bins=32)
-    base = dict(objective="binary", num_trees=4, num_leaves=15, max_bins=32)
+    # explicit max_depth bounds the wired expansion's run capacity (r10:
+    # the pallas arm rides the layout-wired path; at the auto-policy's
+    # depth 8 its 2^D-run buffer is pathological under interpret mode —
+    # ~130 s for this 4k-row fixture vs ~25 s at depth 6, same coverage)
+    base = dict(objective="binary", num_trees=2, num_leaves=15, max_bins=32,
+                max_depth=6)
     b_xla = dryad.train(dict(base, hist_backend="xla"), ds, backend="tpu")
     b_pl = dryad.train(dict(base, hist_backend="pallas"), ds, backend="tpu")
     np.testing.assert_array_equal(b_xla.feature, b_pl.feature)
