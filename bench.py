@@ -41,7 +41,9 @@ def _timed_fori(fn, K: int, reps: int, *args):
     reps inside ONE jit, wall/K, ending in a REAL host fetch.  Each arm
     runs ``reps`` timed programs and reports (min_ms, max/min - 1): tunnel
     stalls only ever ADD time, so the min is the signal and the spread is
-    the suspect-capture flag (>5% = suspect)."""
+    the suspect-capture flag (>5% = suspect).  The fetch-and-perturbation
+    discipline is machine-checked since r11 (dryadlint rules
+    ``bench-real-fetch`` / ``dead-perturbation`` — dryad_tpu/analysis)."""
     import jax
     import jax.numpy as jnp
 

@@ -1,0 +1,41 @@
+"""Static analysis for dryad-tpu's measured invariants (round 11).
+
+Two layers, one CLI (``python -m dryad_tpu.analysis --ci``):
+
+* **dryadlint** (``lint.py`` + ``rules.py``) — a stdlib-``ast`` rule engine
+  over the repo's source tree.  Each rule encodes one of the hand-enforced
+  disciplines that used to live in ``scripts/ci.sh`` grep blocks or only
+  in CLAUDE.md prose: host-fetch bans in serve/resilience/obs (including
+  TRANSITIVE jax-freedom for ``dryad_tpu/obs/``), row-sort/``tile_plan``
+  bans in the wired growers, large-array jit-closure constants (the
+  HTTP-413 class), and bench-timing hygiene (timed fori programs must end
+  in a real host fetch; perturbations that integer-rounding turns into
+  dead inputs are flagged).  Violations are waivable per line with::
+
+      # dryadlint: disable=RULE -- reason
+
+  (the reason is mandatory; waivers are counted and reported).
+
+* **jaxpr auditor** (``jaxpr_audit.py`` + ``digests.py``) — traces the
+  growers, histogram builders and sharded predict with ABSTRACT inputs on
+  CPU (tracing never compiles, so even the Pallas/TPU programs trace
+  anywhere) and walks the closed jaxprs: a trip-count-weighted collective
+  census cross-checked against ``engine.train._comm_stats`` on every arm,
+  an N-row sort/gather census on the wired layout path, u8/u16 tile-dtype
+  discipline at kernel boundaries, and canonicalized per-arm program
+  digests pinned by committed goldens so fusion-shape drift (the
+  argmax-flip class) fails CI instead of surfacing as a mysterious
+  cross-arm divergence.
+
+This package is imported by tests and the CLI only — nothing in the
+training/serving path depends on it.
+"""
+
+from dryad_tpu.analysis.lint import (  # noqa: F401
+    LintReport,
+    Rule,
+    Violation,
+    Waiver,
+    registry,
+    run_lint,
+)

@@ -1,0 +1,128 @@
+"""CLI: ``python -m dryad_tpu.analysis [--ci|--lint|--audit] [...]``.
+
+Exit codes (scripts/ci.sh keys off them):
+
+    0  everything passed
+    2  dryadlint violations (or malformed waivers)
+    3  jaxpr audit invariant failure (collective census / _comm_stats
+       mismatch, row-sort contract, kernel dtype discipline)
+    4  program-digest drift vs the committed goldens
+    5  internal error (a rule or an arm crashed — never "pass by crash")
+
+``--update-goldens`` re-traces every arm and rewrites
+``dryad_tpu/analysis/goldens/program_digests.json``; run it when a program
+change is INTENTIONAL and commit the diff — the review of that diff is
+the human half of the fusion-shape tripwire.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+
+def _force_cpu_env():
+    """The audit traces on CPU with 8 virtual devices, exactly like the
+    test suite (tests/conftest.py) — set the env BEFORE jax imports."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m dryad_tpu.analysis",
+        description="dryadlint + jaxpr auditor (see dryad_tpu/analysis)")
+    ap.add_argument("--ci", action="store_true",
+                    help="run both layers (what scripts/ci.sh runs)")
+    ap.add_argument("--lint", action="store_true", help="dryadlint only")
+    ap.add_argument("--audit", action="store_true", help="jaxpr audit only")
+    ap.add_argument("--update-goldens", action="store_true",
+                    help="re-trace arms and rewrite the digest goldens")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalog and exit")
+    ap.add_argument("--rule", action="append", default=None,
+                    help="restrict lint to the named rule(s)")
+    ap.add_argument("--arm", action="append", default=None,
+                    help="restrict the audit to the named arm(s)")
+    ap.add_argument("--root", default=None,
+                    help="repo root (default: the package's parent)")
+    ap.add_argument("--goldens", default=None,
+                    help="goldens path override (tests use a tmp file)")
+    ap.add_argument("-q", "--quiet", action="store_true")
+    args = ap.parse_args(argv)
+
+    root = args.root or os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+    if args.list_rules:
+        from dryad_tpu.analysis.lint import registry
+
+        for name, rule in sorted(registry().items()):
+            print(f"{name:24s} {rule.doc}")
+            print(f"{'':24s}   targets: {', '.join(rule.targets)}")
+        return 0
+
+    do_lint = args.ci or args.lint or not (args.audit or args.update_goldens)
+    do_audit = args.ci or args.audit or args.update_goldens
+
+    rc = 0
+    try:
+        if do_lint:
+            from dryad_tpu.analysis.lint import run_lint
+
+            report = run_lint(root, rule_names=args.rule)
+            for v in report.violations:
+                print("VIOLATION", v.format())
+            for e in report.errors:
+                print("ERROR", e)
+            if not args.quiet:
+                for v, w in report.waived:
+                    print(f"waived   {v.path}:{v.line} [{v.rule}] -- "
+                          f"{w.reason}")
+            print(report.summary())
+            if not report.ok:
+                rc = max(rc, 2)
+
+        if do_audit:
+            _force_cpu_env()
+            from dryad_tpu.analysis.jaxpr_audit import run_audit
+
+            audit = run_audit(arm_names=args.arm,
+                              goldens_path=args.goldens,
+                              update_goldens=args.update_goldens)
+            for arm in audit.arms:
+                c = arm.census
+                line = (f"arm {arm.name}: psum={c.collectives.get('psum', 0)}"
+                        f"/{arm.expected_psums} "
+                        f"global_sorts={c.global_row_sorts} "
+                        f"local_sorts={c.local_row_sorts} "
+                        f"row_gathers={c.row_gathers} "
+                        f"digest={arm.digest[:12]}")
+                print(line)
+                for f in arm.failures:
+                    print("  INVARIANT FAIL:", f)
+            for d in audit.drift:
+                print("DIGEST DRIFT:", d)
+            print(audit.summary())
+            if args.update_goldens:
+                from dryad_tpu.analysis.digests import GOLDENS_PATH
+
+                print("goldens written:", args.goldens or GOLDENS_PATH)
+            if not audit.ok:
+                rc = max(rc, 3)
+            elif not audit.drift_ok:
+                rc = max(rc, 4)
+    except Exception:
+        import traceback
+
+        traceback.print_exc()
+        return 5
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

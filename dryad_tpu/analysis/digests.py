@@ -1,0 +1,160 @@
+"""Canonical program digests for traced arms, plus the goldens store.
+
+Why digest jaxprs at all: the repo's bitterest divergence class is
+FUSION-SHAPE drift — "any pass that replaces another must run the SAME
+program on every path, or 1-shard vs N-shard near-tie argmaxes flip"
+(CLAUDE.md lowering facts; the roots_sharded and chunked-dispatch
+incidents).  The program a near-tie depends on is the traced IR, so a
+canonical digest of each arm's closed jaxpr pins it: an innocent-looking
+refactor that changes the traced program for ONE arm but not its peers
+fails CI with a digest diff instead of surfacing months later as a
+mysterious cross-arm parity flake.
+
+The digest is STRUCTURAL, not textual: primitive names, abstract values,
+and a cleaned param representation are hashed in program order.  The
+pretty-printer's cosmetics (var naming, whitespace, source locations that
+``name_and_src_info`` embeds) never reach the hash — a pure line move in
+pallas_hist.py must not churn digests — and neither do runtime object
+addresses or hash-seed-dependent set orderings.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import re
+from typing import Optional
+
+GOLDENS_PATH = os.path.join(os.path.dirname(__file__), "goldens",
+                            "program_digests.json")
+
+_ADDR_RE = re.compile(r"0x[0-9a-fA-F]+")
+_SRC_RE = re.compile(r" at [^\s()\[\]{}]+:\d+")
+_PATH_RE = re.compile(r"(/[\w.\-]+)+/dryad_tpu/")
+
+
+def _clean(text: str) -> str:
+    text = _ADDR_RE.sub("0xADDR", text)
+    text = _SRC_RE.sub("", text)
+    text = _PATH_RE.sub("dryad_tpu/", text)
+    return text
+
+
+def _param_repr(value) -> str:
+    """Deterministic repr for an eqn param: sets sorted (their iteration
+    order is hash-seed dependent), addresses and source lines stripped,
+    callables reduced to their qualname."""
+    if isinstance(value, (frozenset, set)):
+        return "{" + ",".join(sorted(_param_repr(v) for v in value)) + "}"
+    if isinstance(value, dict):
+        return "{" + ",".join(
+            f"{_param_repr(k)}:{_param_repr(v)}"
+            for k, v in sorted(value.items(), key=lambda kv: repr(kv[0])))\
+            + "}"
+    if isinstance(value, (tuple, list)):
+        return "(" + ",".join(_param_repr(v) for v in value) + ")"
+    if callable(value) and not isinstance(value, type):
+        return getattr(value, "__qualname__", getattr(value, "__name__",
+                                                      type(value).__name__))
+    return _clean(repr(value))
+
+
+def _is_jaxpr(v) -> bool:
+    return hasattr(v, "eqns") and hasattr(v, "invars")
+
+
+def _as_jaxpr(v):
+    # ClosedJaxpr wraps .jaxpr/.consts; plain Jaxpr has .eqns directly
+    if hasattr(v, "jaxpr") and _is_jaxpr(getattr(v, "jaxpr")):
+        return v.jaxpr, list(getattr(v, "consts", ()))
+    if _is_jaxpr(v):
+        return v, []
+    return None, []
+
+
+def iter_sub_jaxprs(eqn):
+    """(param_name, jaxpr, consts) for every jaxpr-valued param of an eqn
+    (tuples of branches included — lax.cond)."""
+    for key, value in eqn.params.items():
+        candidates = value if isinstance(value, (tuple, list)) else (value,)
+        for i, v in enumerate(candidates):
+            j, consts = _as_jaxpr(v)
+            if j is not None:
+                yield (f"{key}[{i}]" if isinstance(value, (tuple, list))
+                       else key), j, consts
+
+
+def canonical_digest(closed_jaxpr) -> str:
+    """sha256 over the structural content of a (closed) jaxpr."""
+    h = hashlib.sha256()
+
+    def upd(s: str):
+        h.update(s.encode())
+        h.update(b"\x00")
+
+    def const_sig(c):
+        shape = getattr(c, "shape", None)
+        dtype = getattr(c, "dtype", None)
+        if shape is None:
+            return _param_repr(c)
+        sig = f"const[{dtype}{tuple(shape)}]"
+        try:
+            nbytes = getattr(c, "nbytes", 1 << 30)
+            if nbytes <= 4096:
+                sig += hashlib.sha256(bytes(memoryview(
+                    __import__("numpy").ascontiguousarray(c)))).hexdigest()[:8]
+        except Exception:
+            pass
+        return sig
+
+    def walk(jaxpr, consts):
+        upd("jaxpr")
+        for v in jaxpr.invars:
+            upd(str(v.aval))
+        for c in consts:
+            upd(const_sig(c))
+        for eqn in jaxpr.eqns:
+            upd(eqn.primitive.name)
+            for iv in eqn.invars:
+                # Literals carry BOTH .val and .aval — the value is the
+                # program content (x*2 vs x*3 must digest differently)
+                if hasattr(iv, "val"):
+                    upd(f"lit:{_param_repr(iv.val)}:{getattr(iv, 'aval', '')}")
+                elif hasattr(iv, "aval"):
+                    upd(str(iv.aval))
+                else:
+                    upd(_param_repr(iv))
+            for ov in eqn.outvars:
+                upd(str(ov.aval))
+            sub_keys = set()
+            for key, j, j_consts in iter_sub_jaxprs(eqn):
+                sub_keys.add(key.split("[")[0])
+                upd(f"sub:{key}")
+                walk(j, j_consts)
+            for key in sorted(eqn.params):
+                if key in sub_keys:
+                    continue
+                upd(f"{key}={_param_repr(eqn.params[key])}")
+        upd("end")
+
+    j, c = _as_jaxpr(closed_jaxpr)
+    walk(j, c or list(getattr(closed_jaxpr, "consts", ())))
+    return h.hexdigest()[:32]
+
+
+def load_goldens(path: Optional[str] = None) -> dict:
+    path = path or GOLDENS_PATH
+    if not os.path.exists(path):
+        return {}
+    with open(path, encoding="utf-8") as f:
+        return json.load(f)
+
+
+def save_goldens(data: dict, path: Optional[str] = None) -> str:
+    path = path or GOLDENS_PATH
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(data, f, indent=1, sort_keys=True)
+        f.write("\n")
+    return path

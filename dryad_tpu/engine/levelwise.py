@@ -385,6 +385,7 @@ def grow_tree_levelwise(
             at_level = (slot_depth == d) & (slot_gain > NEG_INF) & (slot_node >= 0)
             # gain-descending order, stable => lowest slot id wins ties, exactly
             # the CPU trainer's repeated first-max argmax sequence
+            # dryadlint: disable=wired-grower-sort -- (L,)-slot gain ranking, L <= 512; not a row sort (rows never sort on the wired path)
             order = jnp.argsort(jnp.where(at_level, -slot_gain, jnp.inf), stable=True)
             cand = order[:P].astype(jnp.int32)
             budget_left = (L - 1) - splits_done

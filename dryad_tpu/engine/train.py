@@ -182,6 +182,49 @@ _grads_jit = partial(jax.jit,
                                       "rank_S"))(_grads_body)
 
 
+def _grow_iteration(p, B, has_cat, mesh, platform, learn_missing, out, score,
+                    Xb, y, g_all, h_all, bag_i, fmask_i, is_cat_feat, it, K,
+                    bmask=None, n_rows=None, renew_alpha=None):
+    """GOSS amplification + shared-plan multiclass roots + the K class
+    trees of ONE boosting iteration (``it`` is the traced global iteration
+    id; tree slots ``it*K + k``).  The single assembly shared by the
+    chunked device loop and ``audit_iteration_fn`` — the jaxpr auditor's
+    arms audit the trained program BY CONSTRUCTION, not a replica."""
+    if p.boosting == "goss":
+        # device-drawn uniforms (bit-identical to the host generator)
+        # make GOSS chunkable: no per-iteration upload, same selection
+        u = _goss_uniform_dev(p.seed, it, score.shape[0])
+        g_all, h_all, bag_i = _goss_body(p, n_rows, g_all, h_all, u, bag_i)
+    roots = None
+    if K > 1 and _shared_roots_ok(p, platform):
+        # shared-plan multiclass roots: all K trees' root histograms in
+        # one matmul pass (2K+1 weight rows — histogram.py).  The mesh
+        # path runs the SAME builder under shard_map: the (2K+1)-row
+        # MXU lowering is fusion-sensitive (measured NOT bitwise vs the
+        # 3-row pass on device), so both paths must share one program
+        # or near-tie root argmaxes could differ 1-shard vs N-shard.
+        if mesh is not None:
+            from dryad_tpu.engine.distributed import roots_sharded
+
+            roots = roots_sharded(mesh, Xb, g_all, h_all, bag_i, B,
+                                  p.rows_per_chunk, p.hist_precision)
+        else:
+            from dryad_tpu.engine.histogram import build_hist_classes
+
+            roots = build_hist_classes(
+                Xb, g_all, h_all, bag_i, B,
+                rows_per_chunk=p.rows_per_chunk,
+                precision=p.hist_precision)
+    for k in range(K):
+        t = it * K + k
+        out, score = _step_body(
+            p, B, has_cat, mesh, platform, learn_missing, out, score,
+            Xb, g_all, h_all, bag_i, fmask_i, is_cat_feat, t, k,
+            root_hist=None if roots is None else roots[k], bmask=bmask,
+            n_rows=n_rows, y=y, renew_alpha=renew_alpha)
+    return out, score
+
+
 @partial(jax.jit,
          static_argnames=("p", "B", "has_cat", "mesh", "platform",
                           "learn_missing", "N", "K", "pad", "rank_Q",
@@ -236,38 +279,10 @@ def _chunk_jit(p, B, has_cat, mesh, platform, learn_missing, N, K, pad,
                    if p.boosting == "rf" else score)
         g_all, h_all = _grads_body(p, N, K, pad, score_g, y, weight, qoff,
                                    rank_row, rank_col, rank_Q, rank_S)
-        if p.boosting == "goss":
-            # device-drawn uniforms (bit-identical to the host generator)
-            # make GOSS chunkable: no per-iteration upload, same selection
-            u = _goss_uniform_dev(p.seed, it0 + i, score.shape[0])
-            g_all, h_all, bag_i = _goss_body(p, N, g_all, h_all, u, bag_i)
-        roots = None
-        if K > 1 and _shared_roots_ok(p, platform):
-            # shared-plan multiclass roots: all K trees' root histograms in
-            # one matmul pass (2K+1 weight rows — histogram.py).  The mesh
-            # path runs the SAME builder under shard_map: the (2K+1)-row
-            # MXU lowering is fusion-sensitive (measured NOT bitwise vs the
-            # 3-row pass on device), so both paths must share one program
-            # or near-tie root argmaxes could differ 1-shard vs N-shard.
-            if mesh is not None:
-                from dryad_tpu.engine.distributed import roots_sharded
-
-                roots = roots_sharded(mesh, Xb, g_all, h_all, bag_i, B,
-                                      p.rows_per_chunk, p.hist_precision)
-            else:
-                from dryad_tpu.engine.histogram import build_hist_classes
-
-                roots = build_hist_classes(
-                    Xb, g_all, h_all, bag_i, B,
-                    rows_per_chunk=p.rows_per_chunk,
-                    precision=p.hist_precision)
-        for k in range(K):
-            t = (it0 + i) * K + k
-            out, score = _step_body(
-                p, B, has_cat, mesh, platform, learn_missing, out, score,
-                Xb, g_all, h_all, bag_i, fmask_i, is_cat_feat, t, k,
-                root_hist=None if roots is None else roots[k], bmask=bmask,
-                n_rows=N, y=y, renew_alpha=renew_alpha)
+        out, score = _grow_iteration(
+            p, B, has_cat, mesh, platform, learn_missing, out, score, Xb, y,
+            g_all, h_all, bag_i, fmask_i, is_cat_feat, it0 + i, K,
+            bmask=bmask, n_rows=N, renew_alpha=renew_alpha)
 
         if n_valid:
             new_vs = []
@@ -389,6 +404,37 @@ def _comm_stats(p, F: int, B: int, K: int, n_shards: int,
         "psum_calls_per_iter": root_calls + level_calls * K,
         "psum_bytes_per_iter": per_tree * K,
     }
+
+
+def audit_iteration_fn(p, B, has_cat, mesh, platform, N, K=1, pad=0,
+                       learn_missing=False, renew_alpha=None):
+    """One whole boosting iteration as a pure traceable function — the
+    jaxpr auditor's census hook (dryad_tpu/analysis/jaxpr_audit.py).
+
+    Assembled from the SAME ``_grads_body`` / ``_goss_body`` /
+    ``_step_body`` (plus the shared-plan multiclass root logic of
+    ``_chunk_jit``) that the trainer dispatches, so the audited IR IS the
+    trained program — a hand-maintained replica would drift exactly the
+    way the grep lints this subsystem replaces did.  The returned function
+    takes ``(out, score, Xb, y, bag, fmask, is_cat_feat)`` device arrays
+    (abstract ``ShapeDtypeStruct`` values under ``jax.make_jaxpr``) and
+    returns the updated ``(out, score)``; with ``mesh`` set the growers
+    run under ``shard_map`` exactly as ``train_device`` runs them.
+    Restricted to the arms the auditor traces: no lambdarank plan, no
+    weights, no DART — those ride the per-iteration dispatch path whose
+    collectives this same accounting already covers."""
+
+    def fn(out, score, Xb, y, bag, fmask, is_cat_feat):
+        g_all, h_all = _grads_body(p, N, K, pad, score, y, None, None,
+                                   None, None, 0, 0)
+        # iteration id traced (jnp.int32) exactly as the chunked loop's
+        # it0 + i is — same program class, same dynamic tree-slot writes
+        return _grow_iteration(
+            p, B, has_cat, mesh, platform, learn_missing, out, score, Xb, y,
+            g_all, h_all, bag, fmask, is_cat_feat, jnp.int32(0), K,
+            n_rows=N, renew_alpha=renew_alpha)
+
+    return fn
 
 
 def _shared_roots_ok(p, platform) -> bool:

@@ -7,55 +7,27 @@
 #
 cd "$(dirname "$0")/.." || exit 1
 
-# Wired-grower lint (r6, widened to the batched leaf-wise grower in r10):
-# neither level-synchronous grower may reach back to the per-level sort
-# helpers directly — the wired path's whole point is that
-# tile_plan/tile_plan_aligned are gone from the growers (the legacy
-# fallback reaches them only through build_hist_segmented).  A direct
-# reference here means the deleted per-level sort/gather quietly re-grew;
-# fail fast.
-if grep -nE 'tile_plan' dryad_tpu/engine/levelwise.py dryad_tpu/engine/leafwise_fast.py; then
-  echo "LINT FAIL: a wired grower references the per-level sort helper (tile_plan*)" >&2
+# Static analysis (r11): dryadlint + the jaxpr auditor replace the r6-r10
+# grep lints (wired-grower tile_plan/row-sort ban, serve/resilience/obs
+# block_until_ready bans, the batcher fetch ban, the obs jax-freedom check
+# — now TRANSITIVE over imports, not a text match) and add the invariants
+# greps never could: the trip-weighted collective census cross-checked
+# against train._comm_stats on every grower arm, the wired-path zero-row-
+# sort contract, kernel-boundary u8/u16 tile discipline, and committed
+# program digests that catch fusion-shape drift (the argmax-flip class).
+# Exit codes: 2 = lint, 3 = IR invariant, 4 = digest drift, 5 = crash.
+# Intentional program changes: python -m dryad_tpu.analysis --update-goldens
+# and commit the goldens diff.  CPU-only (traces, never compiles).
+env JAX_PLATFORMS=cpu \
+    PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}" \
+    python -m dryad_tpu.analysis --ci -q > /tmp/_analysis.log 2>&1
+analysis_rc=$?
+if [ $analysis_rc -ne 0 ]; then
+  echo "ANALYSIS FAIL (exit $analysis_rc): python -m dryad_tpu.analysis --ci (see /tmp/_analysis.log)" >&2
+  tail -15 /tmp/_analysis.log >&2
   exit 1
 fi
-
-# Serving dispatch-loop lint (r7): the batcher must never touch the
-# device result itself — the ONE real host fetch per chunk lives in the
-# cache's execute stage (np.asarray on the raw scores).  A fetch growing
-# back into the collect/dispatch loop would serialize the overlapped
-# pipeline (and block_until_ready returns instantly on the tunnel, so it
-# is banned everywhere in serve/ — CLAUDE.md measuring notes).
-if grep -rnE '\.block_until_ready\(' dryad_tpu/serve/; then
-  echo "LINT FAIL: serve/ uses block_until_ready (lies on the tunnel; use a real fetch)" >&2
-  exit 1
-fi
-if grep -nE 'np\.asarray|asnumpy|device_get|import jax' dryad_tpu/serve/batcher.py; then
-  echo "LINT FAIL: serve/batcher.py grew a device fetch — the single result fetch belongs in cache.execute_raw" >&2
-  exit 1
-fi
-
-# Resilience fetch lint (r8, widened to obs/ in r9): the supervisor/
-# journal layer and the observability collectors must never throttle or
-# time anything on block_until_ready — it returns instantly through this
-# tunnel (STATUS r5 / CLAUDE.md measuring notes), so a "wait" built on it
-# is a no-op that would let the supervisor misjudge run health.  Same
-# rule the batcher lint enforces for serve/.
-if grep -rnE '\.block_until_ready\(' dryad_tpu/resilience/ dryad_tpu/obs/; then
-  echo "LINT FAIL: resilience//obs/ uses block_until_ready (lies on the tunnel; use a real fetch)" >&2
-  exit 1
-fi
-
-# Observability device lint (r9): obs collectors are HOST-SIDE ONLY — they
-# may only record values the engine already fetched (CLAUDE.md's
-# never-fetch-per-iteration rule).  The whole package must stay jax-free:
-# no device fetches (device_get / addressable_data / np.asarray on device
-# buffers) and no jax import anywhere, snapshot path included — the
-# registry's "explicitly-annotated snapshot path" is annotated AND
-# jax-free by construction, so the lint is strict over the package.
-if grep -rnE 'import jax|device_get|addressable_data|np\.asarray|asnumpy' dryad_tpu/obs/; then
-  echo "LINT FAIL: dryad_tpu/obs/ grew a jax/device dependency — obs collectors are host-side only" >&2
-  exit 1
-fi
+tail -2 /tmp/_analysis.log
 
 # Observability smoke (r9): the CLI's live metrics endpoint — train 5
 # trees with --metrics-port, scrape /healthz + /stats + /metrics while

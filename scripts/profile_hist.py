@@ -4,6 +4,8 @@ Times each device stage of the levelwise grower in isolation on the
 Higgs-200k shape (N=200k, F=28, B=256): single-leaf histogram, per-level
 segmented histogram (P=128), split scan, argsort, predict traversal.
 """
+# dryadlint: disable-file=no-block-until-ready -- r2-era stage probe; per-call walls recorded in BENCH_r01/r02, superseded by the timed-fori doctrine (bench._timed_fori)
+# dryadlint: disable-file=jit-closure-constant -- r2-era probe: 200k-shape closures stay well under the ~tens-of-MB HTTP-413 limit; kept verbatim for provenance
 from __future__ import annotations
 
 import time

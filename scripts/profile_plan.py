@@ -9,6 +9,7 @@ fori-loop methodology, to pick the round-3 data-movement levers.
 
 Usage: PYTHONPATH=... python scripts/profile_plan.py [rows] [P] [reps]
 """
+# dryadlint: disable-file=no-block-until-ready -- r3-era setup materialization, results recorded in BENCH_r03/STATUS; timed regions use the fori doctrine
 
 import sys
 import time

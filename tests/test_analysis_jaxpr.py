@@ -1,0 +1,337 @@
+"""jaxpr auditor (dryad_tpu/analysis layer 2): the collective/sort census
+over the real grower arms, the _comm_stats cross-check, kernel dtype
+discipline, and the digest tripwire — including the mutation direction
+(a program with an EXTRA collective or sort must be caught).
+
+Everything here traces with abstract inputs on the 8 fake CPU devices;
+nothing compiles or runs, so the module stays cheap relative to the
+training fixtures around it.
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from dryad_tpu.analysis.digests import canonical_digest
+from dryad_tpu.analysis.jaxpr_audit import (
+    ARMS,
+    Census,
+    census_jaxpr,
+    kernel_dtype_violations,
+    run_audit,
+    trace_arm,
+)
+from dryad_tpu.engine.distributed import AXIS, make_mesh
+from dryad_tpu.engine.jax_compat import shard_map
+
+pytestmark = pytest.mark.distributed
+
+
+@pytest.fixture(scope="module")
+def audit_report():
+    assert len(jax.devices()) >= 8, "conftest must provide 8 virtual devices"
+    return run_audit()
+
+
+def _arm(report, name):
+    return next(a for a in report.arms if a.name == name)
+
+
+# ---------------------------------------------------------------------------
+# the documented invariants, arm by arm
+
+def test_all_arms_pass_invariants(audit_report):
+    for arm in audit_report.arms:
+        assert arm.ok, f"{arm.name}: {arm.failures}"
+
+
+def test_psum_census_matches_comm_stats_every_arm(audit_report):
+    """The accounting (_comm_stats) and the traced program must agree —
+    this is the cross-check that retires hand-maintained drift."""
+    for arm in audit_report.arms:
+        assert arm.census.collectives.get("psum", 0) == arm.expected_psums, \
+            arm.name
+
+
+def test_wired_paths_sort_free(audit_report):
+    """'Nothing on the wired path sorts rows' (r10) — now machine-checked."""
+    for name in ("levelwise_wired", "leafwise_wired"):
+        c = _arm(audit_report, name).census
+        assert c.global_row_sorts == 0 and c.local_row_sorts == 0, name
+
+
+def test_legacy_arm_keeps_its_tile_plan_sorts(audit_report):
+    """The comparison arm must keep sorting — if the legacy path silently
+    stopped sorting it is no longer the program the bench compares."""
+    c = _arm(audit_report, "levelwise_legacy").census
+    assert c.local_row_sorts > 0
+    assert c.global_row_sorts == 0
+
+
+def test_goss_adds_exactly_one_global_sort(audit_report):
+    assert _arm(audit_report, "goss_iteration").census.global_row_sorts == 1
+
+
+def test_renewal_adds_exactly_one_global_sort(audit_report):
+    assert _arm(audit_report,
+                "renewal_iteration").census.global_row_sorts == 1
+
+
+def test_sharded_predict_collective_free(audit_report):
+    c = _arm(audit_report, "sharded_predict").census
+    assert not c.collectives
+    assert c.global_row_sorts == 0 and c.local_row_sorts == 0
+
+
+def test_only_psum_collectives_anywhere(audit_report):
+    for arm in audit_report.arms:
+        extra = {k: v for k, v in arm.census.collectives.items()
+                 if k != "psum"}
+        assert not extra, (arm.name, extra)
+
+
+def test_wired_kernels_present_and_u8(audit_report):
+    """The wired arms must actually run the layout kernels (the gates
+    admitted) and every kernel's dominant integer operand stays u8/u16."""
+    for name in ("levelwise_wired", "leafwise_wired"):
+        c = _arm(audit_report, name).census
+        assert "_hist_kernel" in c.pallas_kernels, name
+        assert "_perm_kernel" in c.pallas_kernels, name
+        assert not kernel_dtype_violations(c), name
+
+
+def test_digests_match_committed_goldens(audit_report):
+    assert audit_report.drift_ok, audit_report.drift
+
+
+# ---------------------------------------------------------------------------
+# census machinery: weighting, nesting, mutation direction
+
+def _mesh8():
+    return make_mesh(jax.devices()[:8])
+
+
+def test_census_weights_scan_trip_counts():
+    mesh = _mesh8()
+
+    def inner(x):
+        def body(i, c):
+            return c + jax.lax.psum(x.sum() * i, AXIS)
+
+        return jax.lax.fori_loop(0, 5, body, jnp.float32(0))
+
+    fn = shard_map(inner, mesh=mesh, in_specs=(P(AXIS),), out_specs=P())
+    closed = jax.make_jaxpr(fn)(jax.ShapeDtypeStruct((64,), jnp.float32))
+    c = census_jaxpr(closed, row_threshold=8)
+    assert c.collectives["psum"] == 5
+
+
+def test_census_seeded_extra_psum_is_counted():
+    """Mutation check: a second collective sneaking into a builder-shaped
+    program must move the census (and thus fail the _comm_stats check)."""
+    mesh = _mesh8()
+
+    def one(x):
+        return jax.lax.psum(x.sum(), AXIS)
+
+    def two(x):
+        return jax.lax.psum(x.sum(), AXIS) + jax.lax.psum(x.max(), AXIS)
+
+    def trace(f):
+        fn = shard_map(f, mesh=mesh, in_specs=(P(AXIS),), out_specs=P())
+        return census_jaxpr(jax.make_jaxpr(fn)(
+            jax.ShapeDtypeStruct((64,), jnp.float32)), 8)
+
+    assert trace(one).collectives["psum"] == 1
+    assert trace(two).collectives["psum"] == 2
+
+
+def test_census_splits_global_vs_shard_local_sorts():
+    mesh = _mesh8()
+    N = 512
+
+    def local_sorting(x):
+        return jnp.sort(x)     # sorts the SHARD
+
+    fn = shard_map(local_sorting, mesh=mesh, in_specs=(P(AXIS),),
+                   out_specs=P(AXIS))
+
+    def global_sorting(x):
+        return jnp.sort(fn(x))  # sorts the GLOBAL array
+
+    closed = jax.make_jaxpr(global_sorting)(
+        jax.ShapeDtypeStruct((N,), jnp.float32))
+    c = census_jaxpr(closed, row_threshold=N // 8)
+    assert c.local_row_sorts == 1
+    assert c.global_row_sorts == 1
+
+
+def test_census_ignores_slot_scale_sorts():
+    def f(gains, rows):
+        return jnp.argsort(gains), rows * 2   # (31,) slot sort only
+
+    closed = jax.make_jaxpr(f)(jax.ShapeDtypeStruct((31,), jnp.float32),
+                               jax.ShapeDtypeStruct((4096,), jnp.float32))
+    c = census_jaxpr(closed, row_threshold=512)
+    assert c.global_row_sorts == 0 and c.local_row_sorts == 0
+
+
+def test_kernel_dtype_rule_flags_i32_tiles():
+    c = Census(collectives=Counter())
+    c.pallas_kernels["_hist_kernel"] = {
+        "(int32(4,),int32(4, 512, 128),bfloat16(4, 8, 512))"}
+    bad = kernel_dtype_violations(c)
+    assert bad and "int32" in bad[0]
+
+
+def test_kernel_dtype_rule_accepts_u8_tiles():
+    c = Census(collectives=Counter())
+    c.pallas_kernels["_hist_kernel"] = {
+        "(int32(4,),uint8(4, 512, 128),bfloat16(4, 8, 512))"}
+    assert not kernel_dtype_violations(c)
+
+
+# ---------------------------------------------------------------------------
+# digests
+
+def test_digest_stable_across_retrace():
+    def f(x):
+        return jnp.cumsum(x * 2)
+
+    a = canonical_digest(jax.make_jaxpr(f)(
+        jax.ShapeDtypeStruct((128,), jnp.float32)))
+    b = canonical_digest(jax.make_jaxpr(f)(
+        jax.ShapeDtypeStruct((128,), jnp.float32)))
+    assert a == b
+
+
+def test_digest_moves_when_program_changes():
+    def f(x):
+        return jnp.cumsum(x * 2)
+
+    def g(x):
+        return jnp.cumsum(x * 3)   # literal change
+
+    def h(x):
+        return jnp.cumsum(x + x)   # op change
+
+    sds = jax.ShapeDtypeStruct((128,), jnp.float32)
+    d = {canonical_digest(jax.make_jaxpr(fn)(sds)) for fn in (f, g, h)}
+    assert len(d) == 3
+
+
+def test_goldens_corruption_is_reported(tmp_path, audit_report):
+    """The CI failure path: a stale/foreign golden must surface as drift
+    (exit 4 in the CLI), never silently pass."""
+    import json
+
+    from dryad_tpu.analysis.digests import load_goldens, save_goldens
+    from dryad_tpu.analysis.jaxpr_audit import run_audit as run
+
+    gpath = str(tmp_path / "goldens.json")
+    data = json.loads(json.dumps(load_goldens()))   # deep copy of committed
+    data["arms"]["sharded_predict"]["digest"] = "not-the-digest"
+    save_goldens(data, gpath)
+    rep = run(arm_names=["sharded_predict"], goldens_path=gpath)
+    assert rep.ok and not rep.drift_ok
+    assert "digest" in rep.drift[0]
+
+
+def test_update_goldens_roundtrip(tmp_path):
+    gpath = str(tmp_path / "goldens.json")
+    run_audit(arm_names=["sharded_predict"], goldens_path=gpath,
+              update_goldens=True)
+    rep = run_audit(arm_names=["sharded_predict"], goldens_path=gpath)
+    assert rep.ok and rep.drift_ok
+
+
+# ---------------------------------------------------------------------------
+# the traced arm IS the trained program (spot anchor)
+
+def test_wired_arm_gates_really_admit():
+    """Guard against the silent-skip failure mode: if a fixture config
+    stopped passing deep_layout_supported, the 'wired' arm would quietly
+    trace the legacy program and the zero-sort check would pin nothing."""
+    from dryad_tpu.config import make_params
+    from dryad_tpu.engine.levelwise import deep_layout_supported
+    from dryad_tpu.engine.leafwise_fast import leafwise_layout_supported
+
+    p = make_params(dict(objective="binary", num_trees=1, num_leaves=127,
+                         max_depth=7, growth="depthwise", max_bins=32,
+                         hist_backend="pallas")).validate()
+    assert deep_layout_supported(p, 8, 32, 1, "tpu")
+    pl = make_params(dict(objective="binary", num_trees=1, num_leaves=31,
+                          max_depth=5, growth="leafwise", max_bins=32,
+                          hist_backend="pallas")).validate()
+    assert leafwise_layout_supported(pl, 8, 32, 1, "tpu")
+
+
+def test_single_arm_trace_smoke():
+    rep = trace_arm("sharded_predict")
+    assert rep.ok and rep.digest
+    assert set(ARMS) >= {"levelwise_wired", "levelwise_legacy",
+                         "leafwise_wired", "goss_iteration",
+                         "renewal_iteration", "multiclass_shared_roots",
+                         "sharded_predict"}
+
+
+def test_update_goldens_subset_merges_not_clobbers(tmp_path):
+    """--arm X --update-goldens must refresh X's pin ONLY: wiping the
+    other arms' goldens would force a full unreviewed re-baseline."""
+    from dryad_tpu.analysis.digests import load_goldens
+
+    gpath = str(tmp_path / "goldens.json")
+    run_audit(arm_names=["sharded_predict"], goldens_path=gpath,
+              update_goldens=True)
+    run_audit(arm_names=["renewal_iteration"], goldens_path=gpath,
+              update_goldens=True)
+    arms = load_goldens(gpath)["arms"]
+    assert set(arms) == {"sharded_predict", "renewal_iteration"}
+    rep = run_audit(arm_names=["sharded_predict", "renewal_iteration"],
+                    goldens_path=gpath)
+    assert rep.ok and rep.drift_ok
+
+
+def test_env_change_reported_as_rebaseline_not_code_drift(tmp_path):
+    import json
+
+    from dryad_tpu.analysis.digests import load_goldens, save_goldens
+
+    gpath = str(tmp_path / "goldens.json")
+    run_audit(arm_names=["sharded_predict"], goldens_path=gpath,
+              update_goldens=True)
+    data = json.loads(json.dumps(load_goldens(gpath)))
+    data["jax_version"] = "0.0.1"
+    save_goldens(data, gpath)
+    rep = run_audit(arm_names=["sharded_predict"], goldens_path=gpath)
+    assert not rep.drift_ok
+    assert "re-baseline" in rep.drift[0]
+
+
+def test_update_goldens_refuses_on_invariant_failure(tmp_path, monkeypatch):
+    """Review r11: --update-goldens must never pin a program that fails
+    its own invariants."""
+    import os
+
+    import dryad_tpu.analysis.jaxpr_audit as ja
+
+    real = ja.trace_arm
+
+    def broken(name):
+        rep = real(name)
+        rep.failures.append("seeded failure")
+        return rep
+
+    monkeypatch.setattr(ja, "trace_arm", broken)
+    gpath = str(tmp_path / "goldens.json")
+    rep = ja.run_audit(arm_names=["sharded_predict"], goldens_path=gpath,
+                       update_goldens=True)
+    assert not rep.ok
+    assert not os.path.exists(gpath)
+    assert any("refusing" in d for d in rep.drift)
