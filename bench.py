@@ -372,6 +372,19 @@ def main() -> None:
         "trees_timed": trees,
     }
 
+    # ---- artifact stamp (r12: the trend ledger keys history off data) -------
+    # schema_version + git rev + device kind in the JSON itself, so
+    # obs/trends.py never parses filenames; the reader stays tolerant of
+    # the unstamped r1-r7 artifacts
+    import jax as _jax
+
+    from dryad_tpu.obs.trends import artifact_stamp
+
+    _dev = _jax.devices()[0]
+    out.update(artifact_stamp(
+        device_kind=getattr(_dev, "device_kind", None) or _dev.platform,
+        root=os.path.dirname(os.path.abspath(__file__))))
+
     # ---- supervisor overhead (r8: the wrapper must be free on the hot path)
     # supervised vs direct short run, NO faults, BOTH arms checkpointed the
     # same way so the delta isolates the supervisor wrapper itself

@@ -115,10 +115,15 @@ def cmd_train(args) -> int:
                          f"{os.environ['DRYAD_METRICS_HOLD_S']!r}")
     if args.metrics_port is not None:
         from dryad_tpu.obs import JournalTail, start_exporter
+        from dryad_tpu.obs.trends import stats_provider
 
+        # the bench trend ledger rides /stats (r12): when the cwd holds a
+        # committed BENCH_r*.json history the report appears under
+        # "bench_trends"; with no files it serves an empty ok report
         exporter = start_exporter(host=args.metrics_host,
                                   port=args.metrics_port,
-                                  auth_token=args.auth_token)
+                                  auth_token=args.auth_token,
+                                  extra_stats=[stats_provider()])
         if not args.quiet:
             print(f"metrics on http://{exporter.host}:{exporter.port}  "
                   "(GET /stats, /metrics, /healthz)")
@@ -261,6 +266,15 @@ def cmd_serve(args) -> int:
             alias = f" (name {name!r})" if name else ""
             print(f"loaded {path} -> version {version}{alias}")
 
+    if args.warmup:
+        # compile every (version, bucket) program up front AND arm the
+        # recompile tripwire: from here on an unexpected compile degrades
+        # /healthz instead of silently stalling traffic (obs/tripwire.py)
+        touched = server.warmup()
+        if not args.quiet:
+            print(f"warmed {touched} (version, bucket) programs; "
+                  "recompile tripwire armed")
+
     if args.request:
         # one-shot mode: run a single request through the FULL serving
         # stack (bucketed compiled predict + micro-batcher) and exit —
@@ -387,6 +401,10 @@ def main(argv=None) -> int:
     s.add_argument("--device-budget-mb", type=int, default=0,
                    help="staged-model memory budget; 0 = unlimited "
                         "(LRU eviction, active version pinned)")
+    s.add_argument("--warmup", action="store_true",
+                   help="compile every (version, bucket) predict program "
+                        "at startup and arm the recompile tripwire "
+                        "(unexpected compiles then degrade /healthz)")
     s.add_argument("--log-requests", action="store_true",
                    help="structured JSON request log on stderr")
     s.add_argument("--auth-token", default=os.environ.get("DRYAD_AUTH_TOKEN"),

@@ -20,6 +20,10 @@ jit-closure-constant   big arrays captured by jit closures become program
 bench-real-fetch       timed fori programs end in a REAL host fetch
 dead-perturbation      a perturbation consumed only through integer rounding
                        is a dead input — XLA hoists the stage (2x-fast lies)
+introspect-compile-only  cost_analysis/memory_analysis/AOT-compile() live in
+                       engine/introspect.py ONLY, and never in a loop or a
+                       traced (fori/scan) body — the recompile tripwire
+                       must never become a per-iteration host sync (r12)
 =====================  =====================================================
 """
 
@@ -556,4 +560,103 @@ register(Rule(
     doc="perturbations must survive integer rounding to reach the stage",
     targets=("bench.py", "scripts/*.py", "dryad_tpu/engine/**"),
     check=_check_dead_perturbation,
+))
+
+
+# ---------------------------------------------------------------------------
+# introspect-compile-only (r12)
+#
+# Compiled-program introspection (lowered cost_analysis, AOT compile +
+# memory_analysis) is measured work: a lower() re-traces the program and
+# an AOT compile() pays a FULL backend compile (verified on this jax: AOT
+# does not share the jit executable cache).  Those calls are legal ONLY
+# inside engine/introspect.py — the whitelisted compile-boundary module,
+# which memoizes per program key — and NEVER inside a loop body or a
+# function traced by fori_loop/scan (where they would become a
+# per-iteration host sync, the exact class CLAUDE.md's never-fetch rule
+# bans).  introspect.capture() itself is memoized and loop-safe on the
+# HOST side, but must not appear in a traced body either.
+
+_INTROSPECT_PATH = "dryad_tpu/engine/introspect.py"
+_INTROSPECT_ATTRS = {"cost_analysis", "memory_analysis"}
+
+
+def _is_aot_compile(call: ast.Call) -> bool:
+    """``<expr>.compile()`` with no arguments — the AOT form; re.compile
+    and friends always take the pattern/source argument."""
+    return (isinstance(call.func, ast.Attribute)
+            and call.func.attr == "compile"
+            and not call.args and not call.keywords)
+
+
+def _traced_body_fns(tree: ast.AST) -> list:
+    """Function nodes passed to lax loop combinators — their bodies are
+    TRACED per loop trip, so host-side introspection inside them is a
+    per-iteration sync (or a trace error) by construction."""
+    names: set[str] = set()
+    fns: list = []
+    for call in _calls(tree):
+        nm = dotted(call.func) or ""
+        if nm.rsplit(".", 1)[-1] in ("fori_loop", "scan", "while_loop"):
+            for arg in call.args:
+                if isinstance(arg, ast.Lambda):
+                    fns.append(arg)
+                elif isinstance(arg, ast.Name):
+                    names.add(arg.id)
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node.name in names:
+            fns.append(node)
+    return fns
+
+
+def _check_introspect_sites(path, src, tree):
+    out = []
+    in_introspect = path == _INTROSPECT_PATH
+    if not in_introspect:
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Attribute) \
+                    and node.attr in _INTROSPECT_ATTRS:
+                out.append(Violation(
+                    "introspect-compile-only", path, node.lineno,
+                    f".{node.attr} outside engine/introspect.py — compiled-"
+                    "program introspection re-traces (and for memory, "
+                    "recompiles); only the memoized compile-boundary "
+                    "module may pay that"))
+            if isinstance(node, ast.Call) and _is_aot_compile(node):
+                out.append(Violation(
+                    "introspect-compile-only", path, node.lineno,
+                    "zero-arg .compile() outside engine/introspect.py — "
+                    "AOT compile does NOT share the jit executable cache "
+                    "(measured, r12): this pays a full second backend "
+                    "compile; route introspection through "
+                    "introspect.capture"))
+    # traced fori/scan bodies may never introspect, ANYWHERE (and inside
+    # introspect.py itself the expensive calls stay out of host loops too)
+    hot_regions: list = list(_traced_body_fns(tree))
+    if in_introspect:
+        hot_regions += [n for n in ast.walk(tree)
+                        if isinstance(n, (ast.For, ast.While))]
+    for region in hot_regions:
+        for call in _calls(region):
+            nm = dotted(call.func) or ""
+            leaf = nm.rsplit(".", 1)[-1]
+            bad = (leaf in _INTROSPECT_ATTRS or _is_aot_compile(call)
+                   or nm.endswith("introspect.capture"))
+            if bad:
+                out.append(Violation(
+                    "introspect-compile-only", path, call.lineno,
+                    f"{nm or leaf}(...) inside a loop/traced body — the "
+                    "tripwire must never become a per-iteration host "
+                    "sync; introspect at the compile boundary only"))
+    return out
+
+
+register(Rule(
+    name="introspect-compile-only",
+    doc="program introspection lives in engine/introspect.py, never in "
+        "loops or traced bodies",
+    targets=("dryad_tpu/engine/**", "dryad_tpu/serve/**",
+             "dryad_tpu/resilience/**", "dryad_tpu/obs/**"),
+    check=_check_introspect_sites,
 ))

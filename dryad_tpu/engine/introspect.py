@@ -1,0 +1,179 @@
+"""Compiled-program introspection at compile boundaries (``dryad_prog_*``).
+
+Per-call host timing lies through the axon tunnel (CLAUDE.md measuring
+rules), so the only trustworthy per-program telemetry is what the
+compiler itself reports.  This module captures it — and it lives HERE,
+in the engine, because it touches jax: the obs package is jax-free by
+lint, and its registry contract says collectors only record values the
+engine already fetched.  Everything recorded is a host scalar.
+
+What happens at a compile boundary (``capture(family, key, jit_fn,
+*args, **kwargs)``, called by engine/train.py, engine/predict.py and
+serve/cache.py right before the FIRST dispatch of a program):
+
+* ``dryad_prog_flops`` / ``dryad_prog_bytes_accessed`` gauges from
+  ``jit_fn.lower(...).cost_analysis()`` — tracing + MLIR emission only,
+  NO XLA compile, so the capture can never double a 70–120 s remote
+  tunnel compile.  Measured on this container (jax 0.4.37): AOT
+  ``lower().compile()`` does NOT share the executable cache with the
+  normal call path — the call recompiles — which is why introspection
+  must never compile on the dispatch path.
+* ``dryad_prog_memory_bytes{kind=temp|argument|output}`` from
+  ``compiled.memory_analysis()`` — this one NEEDS a real compile, so it
+  is opt-in (``DRYAD_PROG_MEMORY=1``): a second local compile is cheap
+  on the CPU backend (tests, the acceptance drill) and deliberate
+  anywhere else.
+* ``dryad_prog_compiles_total{program=...}`` via the recompile tripwire
+  (obs/tripwire.py) — every boundary notes its program key there, so an
+  armed family (serve after warmup, train after the first chunk) turns
+  a NEW key into ``dryad_recompile_unexpected_total`` + a degraded
+  ``/healthz``.
+* ``dryad_prog_backend_compiles_total`` /
+  ``dryad_prog_compile_seconds_total`` from a ``jax.monitoring``
+  duration listener on the backend-compile event — the compile walls the
+  runtime actually paid, process-wide, attributed to the boundary family
+  that was active on the compiling thread (best-effort sticky label;
+  compiles outside any declared boundary land on ``program="other"``).
+
+Cost model: captures are memoized per (family, key) process-wide, so a
+warm re-run (bench arms, repeated serve traffic) pays NOTHING — exactly
+mirroring the jit executable cache.  Every entry point returns after one
+``enabled`` check when the registry is disabled (the zero-cost
+contract), and a capture failure increments
+``dryad_prog_capture_errors_total`` instead of breaking the dispatch.
+
+dryadlint's ``introspect-compile-only`` rule pins the discipline: the
+``cost_analysis``/``memory_analysis``/AOT-``compile()`` calls below are
+the ONLY legal sites, and nothing here may be called from a loop body —
+the tripwire must never become a per-iteration host sync.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Optional
+
+from dryad_tpu.obs.registry import default_registry
+from dryad_tpu.obs.tripwire import default_tripwire
+
+_seen: set = set()               # (family, key) already introspected
+_seen_lock = threading.Lock()
+_tls = threading.local()         # .program — sticky compile attribution
+_listener_lock = threading.Lock()
+_listener_installed = False
+
+#: the jax.monitoring event real XLA compiles emit (verified on 0.4.37)
+_COMPILE_EVENT_SUFFIX = "backend_compile_duration"
+
+
+def memory_capture_enabled() -> bool:
+    """Peak-memory capture costs one extra LOCAL compile per program —
+    opt-in only (never silently doubles a tunnel compile)."""
+    return os.environ.get("DRYAD_PROG_MEMORY", "0") == "1"
+
+
+def _on_compile_duration(name: str, secs: float, **kw) -> None:
+    if not name.endswith(_COMPILE_EVENT_SUFFIX):
+        return
+    reg = default_registry()
+    if not reg.enabled:
+        return
+    program = getattr(_tls, "program", None) or "other"
+    reg.counter("dryad_prog_backend_compiles_total",
+                "Real XLA backend compiles by boundary family").labels(
+        program=program).inc()
+    reg.counter("dryad_prog_compile_seconds_total",
+                "XLA backend compile wall by boundary family").labels(
+        program=program).inc(float(secs))
+
+
+def _install_listener() -> None:
+    global _listener_installed
+    if _listener_installed:
+        return
+    with _listener_lock:
+        if _listener_installed:
+            return
+        import jax.monitoring
+
+        jax.monitoring.register_event_duration_secs_listener(
+            _on_compile_duration)
+        _listener_installed = True
+
+
+def seen(family: str, key) -> bool:
+    with _seen_lock:
+        return (family, key) in _seen
+
+
+def reset_seen() -> None:
+    """Forget the process memo (tests re-capture after clear_caches)."""
+    with _seen_lock:
+        _seen.clear()
+
+
+def capture(family: str, key, jit_fn, *args,
+            labels: Optional[dict] = None, note_tripwire: bool = True,
+            **kwargs) -> bool:
+    """Introspect one compile boundary; returns True when (family, key)
+    was new and a capture ran.  ``jit_fn``/``args``/``kwargs`` must be
+    EXACTLY what the caller is about to dispatch — the lowering is the
+    program the jit call will compile.  Observation-only: the jit call
+    path, and therefore every traced program, is untouched (the jaxpr
+    auditor's digests are the proof)."""
+    reg = default_registry()
+    if not reg.enabled:
+        return False
+    if os.environ.get("DRYAD_PROG", "1") == "0":
+        # operational kill switch: the capture's lower() doubles a
+        # program's TRACE cost (never its compile) — skippable where even
+        # that matters, without disabling the rest of the registry
+        return False
+    _install_listener()
+    # sticky attribution for the compile the caller is about to trigger
+    _tls.program = family
+    if note_tripwire:
+        default_tripwire().note_compile(family, key)
+    with _seen_lock:
+        if (family, key) in _seen:
+            return False
+        _seen.add((family, key))
+    lbl = dict(labels or {})
+    lbl["program"] = family
+    try:
+        t0 = time.perf_counter()
+        lowered = jit_fn.lower(*args, **kwargs)
+        cost = lowered.cost_analysis()
+        d = cost[0] if isinstance(cost, (list, tuple)) else (cost or {})
+        if "flops" in d:
+            reg.gauge("dryad_prog_flops",
+                      "Compiler flops estimate per program").labels(
+                **lbl).set(float(d["flops"]))
+        if "bytes accessed" in d:
+            reg.gauge("dryad_prog_bytes_accessed",
+                      "Compiler bytes-accessed estimate per program").labels(
+                **lbl).set(float(d["bytes accessed"]))
+        if memory_capture_enabled():
+            compiled = lowered.compile()
+            ma = compiled.memory_analysis()
+            mem = reg.gauge("dryad_prog_memory_bytes",
+                            "Compiled-program memory estimate by kind")
+            for kind, attr in (("temp", "temp_size_in_bytes"),
+                               ("argument", "argument_size_in_bytes"),
+                               ("output", "output_size_in_bytes")):
+                val = getattr(ma, attr, None)
+                if val is not None:
+                    mem.labels(kind=kind, **lbl).set(float(val))
+        reg.counter("dryad_prog_captures_total",
+                    "Successful compile-boundary introspections").labels(
+            program=family).inc()
+        reg.gauge("dryad_prog_capture_seconds",
+                  "Wall of the last introspection per family").labels(
+            program=family).set(round(time.perf_counter() - t0, 4))
+    except Exception:   # noqa: BLE001 — introspection must never break
+        reg.counter("dryad_prog_capture_errors_total",   # the dispatch
+                    "Compile-boundary introspections that raised").labels(
+            program=family).inc()
+    return True

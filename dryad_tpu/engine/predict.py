@@ -132,9 +132,20 @@ def predict_binned_sharded(booster, Xb, num_iteration: Optional[int] = None,
     else:
         Xp = Xb
     Xp = _jax.device_put(Xp, NamedSharding(mesh, P(dist.AXIS, None)))
-    fn = sharded_accumulate_fn(mesh, max(booster.max_depth_seen, 1))
+    depth = max(booster.max_depth_seen, 1)
+    fn = sharded_accumulate_fn(mesh, depth)
+    init_j = jnp.asarray(init)
+    # compile-boundary introspection (r12): dryad_prog_* for the sharded
+    # predict program; memoized per shape, observation-only
+    from dryad_tpu.engine import introspect
+
+    introspect.capture(
+        "predict", ("sharded", n_shards, n_iter, booster.num_outputs,
+                    Xp.shape, depth),
+        fn, trees, Xp, init_j,
+        labels={"arm": "sharded", "shards": n_shards})
     # np.asarray is the result-edge gather AND the one real host fetch
-    raw = np.asarray(fn(trees, Xp, jnp.asarray(init)))[:n]
+    raw = np.asarray(fn(trees, Xp, init_j))[:n]
     if booster.params.boosting == "rf" and n_iter > 0:
         from dryad_tpu.cpu.predict import rf_average
 
@@ -175,7 +186,16 @@ def predict_binned_device(
     trees_np, init, n_iter = stage_trees(booster, num_iteration)
     trees = {k: jnp.asarray(v) for k, v in trees_np.items()}
     Xb = jnp.asarray(Xb)
-    raw = _accumulate(trees, Xb, jnp.asarray(init), max(booster.max_depth_seen, 1))
+    depth = max(booster.max_depth_seen, 1)
+    init_j = jnp.asarray(init)
+    # compile-boundary introspection (r12) — memoized per shape
+    from dryad_tpu.engine import introspect
+
+    introspect.capture(
+        "predict", ("single", n_iter, booster.num_outputs, Xb.shape, depth),
+        _accumulate, trees, Xb, init_j, depth,
+        labels={"arm": "single", "shards": 1})
+    raw = _accumulate(trees, Xb, init_j, depth)
     if booster.params.boosting == "rf" and n_iter > 0:
         # rf averaging runs ON HOST via the ONE shared transform (device
         # FMA fusion is 1 ulp off — see cpu/predict.rf_average); the

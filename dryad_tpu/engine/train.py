@@ -38,6 +38,12 @@ from dryad_tpu.cpu.trainer import (
     update_best,
 )
 from dryad_tpu.dataset import Dataset
+
+# compile-boundary introspection (r12): dryad_prog_* cost/memory capture
+# + the recompile-tripwire key notes.  Called ONLY at compile boundaries
+# (dryadlint introspect-compile-only); observation-only — the traced
+# programs are untouched (the analysis goldens are the proof)
+from dryad_tpu.engine import introspect
 from dryad_tpu.engine.grower import grow_any
 from dryad_tpu.engine.predict import _accumulate, tree_leaves
 from dryad_tpu.objectives import get_objective
@@ -48,6 +54,13 @@ from dryad_tpu.objectives import get_objective
 from dryad_tpu.obs.registry import default_registry
 from dryad_tpu.obs.spans import record as record_span
 from dryad_tpu.obs.spans import span
+from dryad_tpu.obs.tripwire import default_tripwire
+
+# fetch-stall watchdog (r12): every REAL device->host fetch below is
+# bracketed so the in-flight age is a live gauge and a stall flips
+# /healthz BEFORE the ~60 s tunnel kill (STATUS r5).  Null context when
+# obs is disabled.
+from dryad_tpu.obs.watchdog import watch_fetch
 
 _TREE_KEYS = ("feature", "threshold", "left", "right", "value", "is_cat",
               "cat_bitset", "gain", "default_left", "cover")
@@ -1076,6 +1089,13 @@ def train_device(
         # family lookup); bound on FIRST enabled use — eager binding would
         # register the families on a disabled registry
         _obs_chunks = _obs_iter = None
+        # recompile tripwire (r12): a fresh run legitimately compiles its
+        # chunk program once; after the first dispatch the family is ARMED
+        # and any NEW program key (a mid-run p_key change — nothing may
+        # cause one) fires dryad_recompile_unexpected_total + /healthz
+        _tw = default_tripwire()
+        _tw.begin_program("train.chunk")
+        _shards_lbl = mesh.devices.size if mesh is not None else 1
 
         it = start_iter
         while it < total_iters:
@@ -1138,15 +1158,34 @@ def train_device(
                     bag_bits = jnp.asarray(bb) if bb is not None else None
                     fmask_chunk = jnp.asarray(fm) if fm is not None else None
 
-            (out, score, vscores_t, eval_buf, eval_its,
-             eval_cnt) = _chunk_jit(
+            _chunk_args = (
                 p_key, B, has_cat, mesh, plat, learn_missing, N, K, pad,
                 rank_Q, rank_S, out, score, Xb, y, weight, ones_rows,
                 ones_feat, is_cat_feat, qoff_j, rank_row, rank_col,
                 jnp.int32(it), jnp.int32(n), bmask, bag_bits, fmask_chunk,
                 metric_names, p.ndcg_at, p.eval_period, total_iters,
                 vXbs_t, vys_t, vqids_t, vscores_t, eval_buf, eval_its,
-                eval_cnt, init_arr=init_dev, renew_alpha=renew_a)
+                eval_cnt)
+            if _obs.enabled:
+                # compile-boundary introspection: the first chunk of a new
+                # program key lowers (NO compile) for dryad_prog_* cost
+                # series and notes the key on the tripwire; warm chunks
+                # cost one memo lookup.  The key is the chunk jit's static
+                # signature, so a changed program mid-run is caught here.
+                introspect.capture(
+                    "train.chunk",
+                    ("chunk", p_key, B, has_cat, plat, N, K, pad,
+                     metric_names, p.eval_period, total_iters, renew_a),
+                    _chunk_jit, *_chunk_args, init_arr=init_dev,
+                    renew_alpha=renew_a,
+                    labels={"growth": p.growth, "shards": _shards_lbl})
+            (out, score, vscores_t, eval_buf, eval_its,
+             eval_cnt) = _chunk_jit(*_chunk_args, init_arr=init_dev,
+                                    renew_alpha=renew_a)
+            # expected-compile budget spent: arm every chunk (idempotent;
+            # a key-less family stays inert, so a mid-run enable() arms
+            # cleanly at the first ENABLED chunk instead of false-firing)
+            _tw.arm("train.chunk")
             if _t_ch is not None:
                 # async site: this is host dispatch wall (masks + enqueue),
                 # not device execution — the fetch spans carry that
@@ -1165,13 +1204,17 @@ def train_device(
             if not calibrated:
                 # drain the pipeline: chunk 0 absorbs compile, chunk 1 is
                 # the measurement
-                if chunk_hook is not None:
-                    chunk_hook("fetch", it)
-                # deliberately NOT timed as a fetch span: block_until_ready
-                # returns instantly through the tunnel (CLAUDE.md), so a
-                # span here would advertise a ~0 fetch wall that never
-                # happened — the real-fetch sites below carry that series
-                jax.block_until_ready(out["max_depth"])
+                with watch_fetch("calibrate", it):
+                    if chunk_hook is not None:
+                        chunk_hook("fetch", it)
+                    # deliberately NOT timed as a fetch span:
+                    # block_until_ready returns instantly through the
+                    # tunnel (CLAUDE.md), so a span here would advertise a
+                    # ~0 fetch wall that never happened — the real-fetch
+                    # sites below carry that series.  (The watchdog wrap
+                    # is different: it times only the in-flight AGE, and
+                    # an injected stall in the hook must be visible.)
+                    jax.block_until_ready(out["max_depth"])
                 now = _time.perf_counter()
                 if chunk_idx == 1 and t_mark is not None:
                     per_iter = max((now - t_mark) / n, 1e-4)
@@ -1205,10 +1248,11 @@ def train_device(
                     # chunk's, so a tunnel kill here journals against the
                     # work that actually stalled
                     fetch_it, fetch_arr = inflight.pop(0)
-                    if chunk_hook is not None:
-                        chunk_hook("fetch", fetch_it)
-                    with span("train.fetch.runahead"):
-                        jax.device_get(fetch_arr[:1])
+                    with watch_fetch("runahead", fetch_it):
+                        if chunk_hook is not None:
+                            chunk_hook("fetch", fetch_it)
+                        with span("train.fetch.runahead"):
+                            jax.device_get(fetch_arr[:1])
             chunk_idx += 1
 
             evs = eval_iters_in(it, it + n)
@@ -1218,11 +1262,12 @@ def train_device(
                 # one small fetch per chunk: the values feed early stopping
                 # and live callbacks (the chunk ended ON the eval boundary,
                 # so stopping here is iteration-exact)
-                if chunk_hook is not None:
-                    chunk_hook("fetch", it)
-                with span("train.fetch.eval"):
-                    vals = np.asarray(jax.device_get(
-                        eval_buf[host_cnt - len(evs):host_cnt]))
+                with watch_fetch("eval", it):
+                    if chunk_hook is not None:
+                        chunk_hook("fetch", it)
+                    with span("train.fetch.eval"):
+                        vals = np.asarray(jax.device_get(
+                            eval_buf[host_cnt - len(evs):host_cnt]))
                 _, higher0, _ = evaluators[0]
                 val_rows = dict(zip(evs, vals))
                 for j in range(it, it + n):
@@ -1252,17 +1297,19 @@ def train_device(
             if checkpointer is not None and checkpointer.due(it):
                 # _materialize is a real bulk fetch — the site the tunnel's
                 # >1-min-pending kills surface at (STATUS r5)
-                if chunk_hook is not None:
-                    chunk_hook("fetch", it)
-                with span("train.fetch.checkpoint"):
-                    if valids and not sync_eval:
-                        flush_chunk_evals(host_cnt)
-                    ckpt = _materialize(p, data.mapper, out, it * K, init,
-                                        max_depth_prev, best_iteration,
-                                        best_value, stale)
-                    if eval_history is not None:  # carried from resume
-                        ckpt.train_state["eval_history"] = eval_history
-                    checkpointer.save(ckpt, it)
+                with watch_fetch("checkpoint", it):
+                    if chunk_hook is not None:
+                        chunk_hook("fetch", it)
+                    with span("train.fetch.checkpoint"):
+                        if valids and not sync_eval:
+                            flush_chunk_evals(host_cnt)
+                        ckpt = _materialize(p, data.mapper, out, it * K,
+                                            init, max_depth_prev,
+                                            best_iteration, best_value,
+                                            stale)
+                        if eval_history is not None:  # carried from resume
+                            ckpt.train_state["eval_history"] = eval_history
+                        checkpointer.save(ckpt, it)
             if chunk_policy is not None:
                 # "clean" = dispatched + all due host work done; the async
                 # run-ahead means device completion trails <= 2 chunks, so
@@ -1277,14 +1324,15 @@ def train_device(
 
         # hook BEFORE the deferred-eval flush: that flush is itself a bulk
         # fetch, and a tunnel kill inside it must attribute to a fetch site
-        if chunk_hook is not None:
-            chunk_hook("fetch", total_iters)
-        with span("train.fetch.final"):
-            if valids and not sync_eval:
-                flush_chunk_evals(host_cnt)
-            booster = _materialize(p, data.mapper, out, total_iters * K,
-                                   init, max_depth_prev, best_iteration,
-                                   best_value, stale)
+        with watch_fetch("final", total_iters):
+            if chunk_hook is not None:
+                chunk_hook("fetch", total_iters)
+            with span("train.fetch.final"):
+                if valids and not sync_eval:
+                    flush_chunk_evals(host_cnt)
+                booster = _materialize(p, data.mapper, out, total_iters * K,
+                                       init, max_depth_prev, best_iteration,
+                                       best_value, stale)
         if eval_history is not None:
             booster.train_state["eval_history"] = eval_history
         if comm is not None:
@@ -1299,6 +1347,12 @@ def train_device(
 
     _obs = default_registry()
     _obs_iter = None    # bound on first enabled use (see chunked path)
+    # recompile tripwire, per-iteration arm: the step program is fixed
+    # after the first iteration — except under DART, whose drop iterations
+    # legitimately alternate the value_scale variant, so DART never arms
+    _tw = default_tripwire()
+    _tw.begin_program("train.step")
+    _shards_lbl = mesh.devices.size if mesh is not None else 1
     for it in range(start_iter, T // K):
         # a checkpoint taken AT the early-stop boundary restores stale >=
         # rounds; growing anything past it would diverge from the stopped run
@@ -1366,6 +1420,21 @@ def train_device(
             # columns simply never win the split scan
             roots = _roots_jit(B, p.rows_per_chunk, p.hist_precision, mesh,
                                Xb, g_all, h_all, bag)
+        if _obs.enabled:
+            # compile boundary of the per-iteration step program (one memo
+            # lookup on warm iterations); the tripwire key carries the
+            # value_scale variant so DART's two legitimate step programs
+            # stay distinct keys instead of false-firing
+            introspect.capture(
+                "train.step",
+                ("step", p_key, B, has_cat, plat, N, K, renew_a,
+                 value_scale is not None),
+                _step_jit, p_key, B, has_cat, mesh, plat, learn_missing,
+                out, score, Xb, g_all, h_all, bag, fmask, is_cat_feat,
+                it * K, 0, None if roots is None else roots[0], bmask,
+                n_rows=N, value_scale=value_scale, y=y, renew_alpha=renew_a,
+                labels={"growth": p.growth, "shards": _shards_lbl,
+                        "arm": "per_iteration"})
         for k in range(K):
             t = it * K + k
             out, score = step(out, score, g_all, h_all, bag, fmask, t, k,
@@ -1377,6 +1446,11 @@ def train_device(
                         _apply_valid_jit(out, t, vXb, vscores[vi][:, k],
                                          out["max_depth"][t])
                     )
+        if p.boosting != "dart":
+            # idempotent per-iteration arm (key-less families stay inert —
+            # see the chunked path); DART never arms: drop iterations
+            # legitimately alternate the value_scale program variant
+            _tw.arm("train.step")
         if value_scale is not None:
             # DART drop iteration: rebuild carried scores as the replay-sum
             # over the CURRENT (rescaled) value table — the construction a
@@ -1414,10 +1488,11 @@ def train_device(
             if not sync_eval:
                 deferred.append((it, vals_dev))
             else:
-                if chunk_hook is not None:
-                    chunk_hook("fetch", it)
-                with span("train.fetch.eval"):
-                    vals = jax.device_get(vals_dev)  # ONE fetch for all sets
+                with watch_fetch("eval", it):
+                    if chunk_hook is not None:
+                        chunk_hook("fetch", it)
+                    with span("train.fetch.eval"):
+                        vals = jax.device_get(vals_dev)  # ONE fetch, all sets
                 for vi, ((vname, _), (mname, higher, _)) in enumerate(
                         zip(valids, evaluators)):
                     value = float(vals[vi])
@@ -1433,16 +1508,17 @@ def train_device(
         if callback is not None:
             callback(it, info)
         if checkpointer is not None and checkpointer.due(it + 1):
-            if chunk_hook is not None:
-                chunk_hook("fetch", it + 1)
-            with span("train.fetch.checkpoint"):
-                flush_deferred()
-                ckpt = _materialize(p, data.mapper, out, (it + 1) * K, init,
-                                    max_depth_prev, best_iteration,
-                                    best_value, stale)
-                if eval_history is not None:
-                    ckpt.train_state["eval_history"] = eval_history
-                checkpointer.save(ckpt, it + 1)
+            with watch_fetch("checkpoint", it + 1):
+                if chunk_hook is not None:
+                    chunk_hook("fetch", it + 1)
+                with span("train.fetch.checkpoint"):
+                    flush_deferred()
+                    ckpt = _materialize(p, data.mapper, out, (it + 1) * K,
+                                        init, max_depth_prev,
+                                        best_iteration, best_value, stale)
+                    if eval_history is not None:
+                        ckpt.train_state["eval_history"] = eval_history
+                    checkpointer.save(ckpt, it + 1)
         if _t_it is not None:
             # async dispatch: this is the iteration's HOST dispatch wall
             record_span("train.iteration", _time.perf_counter() - _t_it)
@@ -1458,14 +1534,16 @@ def train_device(
     # deferred evals: one final bulk fetch + replay; the full per-set
     # history lands on the booster (train_state["eval_history"]) since no
     # callback saw the values live
-    if chunk_hook is not None:
-        chunk_hook("fetch", T // K)
-    with span("train.fetch.final"):
-        flush_deferred()
+    with watch_fetch("final", T // K):
+        if chunk_hook is not None:
+            chunk_hook("fetch", T // K)
+        with span("train.fetch.final"):
+            flush_deferred()
 
-        # ---- the single end-of-training fetch --------------------------------
-        booster = _materialize(p, data.mapper, out, T, init, max_depth_prev,
-                               best_iteration, best_value, stale)
+            # ---- the single end-of-training fetch ----------------------------
+            booster = _materialize(p, data.mapper, out, T, init,
+                                   max_depth_prev, best_iteration,
+                                   best_value, stale)
     if eval_history is not None:
         booster.train_state["eval_history"] = eval_history
     if comm is not None:

@@ -6,6 +6,15 @@ into per-stage series; an stdlib HTTP exporter serves ``/metrics``
 (Prometheus text), ``/stats`` (JSON), and ``/healthz``; and a journal
 tail folds the supervised-run flight recorder into live series.
 
+r12 adds the device-truth layer: a process health state behind
+``/healthz`` (health.py), the fetch-stall watchdog
+(``dryad_fetch_*`` — watchdog.py), the recompile tripwire
+(``dryad_recompile_unexpected_total`` — tripwire.py), and the bench
+trend ledger over the committed ``BENCH_r*.json`` history (trends.py).
+The compiled-program cost/memory capture that FEEDS ``dryad_prog_*``
+lives OUTSIDE this package (engine/introspect.py): it touches jax, and
+obs collectors only record values the engine already fetched.
+
 Hard contracts (see registry.py / scripts/ci.sh):
 
 * host-side only — nothing here may touch jax or fetch from a device;
@@ -20,6 +29,7 @@ Hard contracts (see registry.py / scripts/ci.sh):
 """
 
 from dryad_tpu.obs.exporter import MetricsExporter, start_exporter
+from dryad_tpu.obs.health import HealthState, default_health, healthz_payload
 from dryad_tpu.obs.journal_tail import JournalTail
 from dryad_tpu.obs.registry import (
     Registry,
@@ -27,6 +37,13 @@ from dryad_tpu.obs.registry import (
     set_default_registry,
 )
 from dryad_tpu.obs.spans import record, span
+from dryad_tpu.obs.tripwire import RecompileTripwire, default_tripwire
+from dryad_tpu.obs.watchdog import (
+    FetchWatchdog,
+    default_watchdog,
+    set_default_watchdog,
+    watch_fetch,
+)
 
 __all__ = [
     "Registry",
@@ -37,4 +54,13 @@ __all__ = [
     "MetricsExporter",
     "start_exporter",
     "JournalTail",
+    "HealthState",
+    "default_health",
+    "healthz_payload",
+    "FetchWatchdog",
+    "default_watchdog",
+    "set_default_watchdog",
+    "watch_fetch",
+    "RecompileTripwire",
+    "default_tripwire",
 ]

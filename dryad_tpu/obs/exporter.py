@@ -6,8 +6,11 @@ which mounts the same handlers next to /predict — serve/http.py):
     GET /metrics   Prometheus text exposition (registry.exposition())
     GET /stats     JSON: uptime, span summary, counters/gauges/histograms
                    (+ any extra_stats providers merged in)
-    GET /healthz   {"ok": true} — ALWAYS auth-exempt (probes must not
-                   need credentials)
+    GET /healthz   200 {"ok": true} while the process health state is
+                   clean, 503 {"ok": false, "degraded": [...]} while any
+                   subsystem holds a degradation (fetch stall, unexpected
+                   recompile — obs/health.py).  ALWAYS auth-exempt
+                   (probes must not need credentials)
 
 Bearer-token auth: when ``auth_token`` is set every endpoint except
 /healthz requires ``Authorization: Bearer <token>`` and answers 401
@@ -29,6 +32,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Optional, Sequence
 
 from dryad_tpu.obs import spans
+from dryad_tpu.obs.health import healthz_payload
 from dryad_tpu.obs.registry import Registry, default_registry
 
 
@@ -67,7 +71,8 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_GET(self):  # noqa: N802 — stdlib handler API
         if self.path == "/healthz":
-            self._send(200, b'{"ok": true}', "application/json")
+            code, body = healthz_payload()
+            self._send(code, json.dumps(body).encode(), "application/json")
             return
         if not authorized(self, self.server.auth_token):
             send_unauthorized(self)

@@ -1,0 +1,104 @@
+"""Process health state behind ``/healthz`` (train exporter AND serve).
+
+r9's ``/healthz`` was a liveness ping only — it said "the HTTP thread is
+alive", never "the run is healthy".  r12 makes it a DEGRADATION surface:
+subsystems raise named degradation reasons (a fetch pending past the
+stall threshold, an unexpected recompile after warmup) and clear them on
+recovery; ``/healthz`` answers 200 ``{"ok": true}`` while the reason set
+is empty and 503 ``{"ok": false, "degraded": [...]}`` otherwise, so a
+probe sees a hang while it is still recoverable (STATUS r5: fetches
+pending >~1 min die — by the time the supervisor classifies the corpse,
+the probe window is long gone).
+
+Contracts (the obs package rules, registry.py):
+
+* host-side only — reasons are strings set by code that already knows the
+  condition; nothing here touches jax;
+* zero-cost when disabled is N/A by construction: nothing records per
+  iteration — ``degrade``/``clear`` fire on rare state TRANSITIONS, and
+  reads happen only when a probe asks.
+
+The degradation set also mirrors into the registry as the
+``dryad_health_degraded{reason=...}`` gauge (1 while degraded, 0 after
+recovery) so scrapers that only see ``/metrics`` get the same signal.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from dryad_tpu.obs.registry import Registry, default_registry
+
+
+class HealthState:
+    """A named set of active degradation reasons, mirrored to a gauge."""
+
+    def __init__(self, registry: Optional[Registry] = None):
+        self._lock = threading.Lock()
+        self._reasons: dict[str, str] = {}   # reason -> detail
+        self._registry = registry
+
+    def _reg(self) -> Registry:
+        # resolved lazily so set_default_registry() swaps reach us (tests)
+        return (self._registry if self._registry is not None
+                else default_registry())
+
+    def degrade(self, reason: str, detail: str = "") -> None:
+        with self._lock:
+            self._reasons[str(reason)] = str(detail)
+        reg = self._reg()
+        if reg.enabled:
+            reg.gauge("dryad_health_degraded",
+                      "1 while the named degradation is active").labels(
+                reason=reason).set(1)
+
+    def clear(self, reason: str) -> None:
+        with self._lock:
+            self._reasons.pop(str(reason), None)
+        reg = self._reg()
+        if reg.enabled:
+            reg.gauge("dryad_health_degraded",
+                      "1 while the named degradation is active").labels(
+                reason=reason).set(0)
+
+    def reset(self) -> None:
+        """Drop every active reason (tests / a fresh serving generation)."""
+        with self._lock:
+            reasons = list(self._reasons)
+        for r in reasons:
+            self.clear(r)
+
+    @property
+    def ok(self) -> bool:
+        with self._lock:
+            return not self._reasons
+
+    def reasons(self) -> dict[str, str]:
+        with self._lock:
+            return dict(self._reasons)
+
+
+def healthz_payload(health: Optional[HealthState] = None) -> tuple[int, dict]:
+    """(status_code, body) for a /healthz GET — shared by the standalone
+    metrics exporter and the serve front end so both flip together.
+    Always auth-exempt at the callers (probes must not need credentials).
+    """
+    h = health if health is not None else default_health()
+    if h.ok:
+        return 200, {"ok": True}
+    return 503, {"ok": False, "degraded": sorted(h.reasons())}
+
+
+_default: Optional[HealthState] = None
+_default_lock = threading.Lock()
+
+
+def default_health() -> HealthState:
+    """The process-wide health state every /healthz endpoint serves."""
+    global _default
+    if _default is None:
+        with _default_lock:
+            if _default is None:
+                _default = HealthState()
+    return _default

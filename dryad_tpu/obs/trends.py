@@ -1,0 +1,245 @@
+"""Bench trend ledger: the committed ``BENCH_r*.json`` history as data.
+
+ROADMAP's standing instruction — "bench.py trends, not points: acceptance
+walls are cold single runs and noisy through the tunnel" — has had no
+machinery behind it: the per-round artifacts exist, but nothing compares
+them.  This module ingests the committed history, compares the newest
+point against the history median with a spread-aware tolerance, and emits
+a machine-readable regression report (``scripts/bench_trend.py`` runs it
+in ci.sh; the ``/stats`` endpoint can mount it as an extra provider).
+
+Verdict rules (the CLAUDE.md measuring discipline, applied across
+rounds instead of within a run):
+
+* a metric regresses only against the MEDIAN of the prior rounds that
+  recorded it (a single noisy round can neither fake nor mask a trend);
+* the newest point's own per-arm spread fields are consulted first: a
+  spread > 5% (``SPREAD_SUSPECT``) marks the verdict ``suspect`` —
+  "suspect capture, never a regression verdict";
+* the tolerance is deliberately loose (default 15%): cold single runs
+  through the tunnel wobble, and the ledger is a tripwire for real
+  cliffs, not a 1% gate.
+
+Artifact stamps (r12 satellite): ``bench.py``/``scripts/bench_serve.py``
+write ``schema_version``, ``git_rev`` and ``device_kind`` into their JSON
+so history keys off data, not filenames; the reader stays
+backfill-tolerant for the unstamped r1–r7 files (driver wrapper shape
+``{"n", "cmd", "rc", "tail", "parsed": {...}}`` or bench.py's flat line).
+
+Pure stdlib (json/glob/statistics) — the obs package is jax-free by lint.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+import statistics
+from typing import Optional, Sequence
+
+from dryad_tpu.obs.registry import Registry, default_registry
+
+#: per-arm spread above this flags the capture (CLAUDE.md / serve bench)
+SPREAD_SUSPECT = 0.05
+#: relative regression tolerance vs the history median (trends, not points)
+DEFAULT_TOLERANCE = 0.15
+#: current bench artifact schema (the r12 stamping satellite)
+SCHEMA_VERSION = 1
+
+#: metric direction tables — anything in neither set is context, not a
+#: tracked metric (row counts, spreads, tree counts, the stamps)
+HIGHER_BETTER = frozenset({
+    "value", "vs_baseline", "final_train_auc", "iters_per_sec_10m",
+    "rows_per_s", "requests_per_s", "pipeline_speedup",
+})
+LOWER_BETTER = frozenset({
+    "marginal_s_per_iter_10m", "wall_2tree_10m", "wall_8tree_10m",
+    "deep_level_ms_wired", "deep_level_ms_legacy",
+    "leafwise_level_ms_wired", "leafwise_level_ms_legacy",
+    "supervisor_overhead_ms", "obs_overhead_ms", "obs_overhead_pct",
+    "p50_ms", "p99_ms",
+})
+
+#: metric -> the newest point's spread fields that vouch for it; the 10M
+#: marginal is a (8-tree − 2-tree) difference, so BOTH arm spreads apply
+_SPREAD_FIELDS = {
+    "iters_per_sec_10m": ("spread_2tree_10m", "spread_8tree_10m"),
+    "marginal_s_per_iter_10m": ("spread_2tree_10m", "spread_8tree_10m"),
+    "wall_2tree_10m": ("spread_2tree_10m",),
+    "wall_8tree_10m": ("spread_8tree_10m",),
+    "deep_level_ms_wired": ("deep_level_spread_wired",),
+    "deep_level_ms_legacy": ("deep_level_spread_legacy",),
+    "leafwise_level_ms_wired": ("leafwise_level_spread_wired",),
+    "leafwise_level_ms_legacy": ("leafwise_level_spread_legacy",),
+    "supervisor_overhead_ms": ("supervisor_overhead_spread",),
+    "obs_overhead_ms": ("obs_overhead_spread",),
+    "obs_overhead_pct": ("obs_overhead_spread",),
+    "rows_per_s": ("spread_rows_per_s",),
+}
+
+_ROUND_RE = re.compile(r"_r0*(\d+)\.json$")
+
+
+def _extract_metrics(doc: dict) -> Optional[dict]:
+    """The flat numeric-metrics dict out of one artifact, whatever its
+    vintage: the driver wrapper carries ``parsed``; a bare bench.py line
+    saved directly IS the dict (it has ``metric``/``bench``)."""
+    if isinstance(doc.get("parsed"), dict):
+        return doc["parsed"]
+    if "metric" in doc or "bench" in doc or "schema_version" in doc:
+        return doc
+    return None
+
+
+def load_history(root: str = ".",
+                 pattern: str = "BENCH_r*.json",
+                 paths: Optional[Sequence[str]] = None) -> list[dict]:
+    """Ordered bench points: ``{"round", "path", "metrics", "git_rev",
+    "device_kind", "schema_version"}``.  Unstamped r1–r7 artifacts load
+    with ``None`` stamps (backfill tolerance); unreadable or metric-less
+    files are skipped, never fatal."""
+    if paths is None:
+        paths = sorted(glob.glob(os.path.join(root, pattern)))
+    out = []
+    for path in paths:
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if not isinstance(doc, dict):
+            continue
+        metrics = _extract_metrics(doc)
+        if not metrics:
+            continue
+        m = _ROUND_RE.search(os.path.basename(path))
+        rnd = int(m.group(1)) if m else doc.get("n")
+        out.append({
+            "round": rnd if isinstance(rnd, int) else None,
+            "path": os.path.basename(path),
+            "metrics": {k: v for k, v in metrics.items()
+                        if isinstance(v, (int, float))
+                        and not isinstance(v, bool)},
+            "schema_version": metrics.get("schema_version"),
+            "git_rev": metrics.get("git_rev") or doc.get("git_rev"),
+            "device_kind": metrics.get("device_kind") or doc.get("device_kind"),
+        })
+    out.sort(key=lambda p: (p["round"] is None, p["round"]))
+    return out
+
+
+def compare(history: Sequence[dict],
+            tolerance: float = DEFAULT_TOLERANCE) -> dict:
+    """Newest point vs the median of its history, per tracked metric.
+
+    Returns ``{"ok", "newest", "n_points", "metrics": {name: {value,
+    median, n_history, rel_delta, direction, spread, verdict}}}`` where
+    verdict is ``ok`` / ``improved`` / ``regression`` / ``suspect`` (the
+    spread veto) / ``new`` (no history records the metric).  ``ok`` is
+    False only on a ``regression``.
+    """
+    if len(history) < 1:
+        return {"ok": True, "n_points": 0, "newest": None, "metrics": {}}
+    newest = history[-1]
+    prior = list(history[:-1])
+    report: dict = {"ok": True, "n_points": len(history),
+                    "newest": newest["path"], "metrics": {}}
+    for name, value in sorted(newest["metrics"].items()):
+        if name in HIGHER_BETTER:
+            direction = "higher_better"
+        elif name in LOWER_BETTER:
+            direction = "lower_better"
+        else:
+            continue
+        hist_vals = [p["metrics"][name] for p in prior
+                     if name in p["metrics"]]
+        entry = {"value": value, "n_history": len(hist_vals),
+                 "direction": direction}
+        if not hist_vals:
+            entry.update(median=None, rel_delta=None, verdict="new")
+            report["metrics"][name] = entry
+            continue
+        med = statistics.median(hist_vals)
+        entry["median"] = med
+        rel = (value - med) / abs(med) if med else 0.0
+        entry["rel_delta"] = round(rel, 4)
+        worse = -rel if direction == "higher_better" else rel
+        spread = max((newest["metrics"].get(f, 0.0)
+                      for f in _SPREAD_FIELDS.get(name, ())), default=0.0)
+        entry["spread"] = spread
+        if worse > tolerance:
+            if spread > SPREAD_SUSPECT:
+                # suspect capture, never a regression verdict (CLAUDE.md)
+                entry["verdict"] = "suspect"
+            else:
+                entry["verdict"] = "regression"
+                report["ok"] = False
+        elif worse < -tolerance:
+            entry["verdict"] = "improved"
+        else:
+            entry["verdict"] = "ok"
+        report["metrics"][name] = entry
+    return report
+
+
+def ingest(history: Sequence[dict],
+           registry: Optional[Registry] = None) -> int:
+    """Fold the history into registry series — one
+    ``dryad_bench_value{metric=..., round=...}`` gauge point per tracked
+    metric per round, plus ``dryad_bench_rounds`` — so scrapers see the
+    whole trajectory on ``/metrics``.  Returns the number of series set.
+    """
+    reg = registry if registry is not None else default_registry()
+    if not reg.enabled:
+        return 0
+    fam = reg.gauge("dryad_bench_value",
+                    "Committed bench-history metric values by round")
+    n = 0
+    for point in history:
+        rnd = point["round"] if point["round"] is not None else -1
+        for name, value in point["metrics"].items():
+            if name in HIGHER_BETTER or name in LOWER_BETTER:
+                fam.labels(metric=name, round=rnd).set(float(value))
+                n += 1
+    reg.gauge("dryad_bench_rounds",
+              "Bench-history points loaded").set(len(history))
+    return n
+
+
+def artifact_stamp(device_kind: Optional[str] = None,
+                   root: Optional[str] = None) -> dict:
+    """The r12 bench-artifact stamp: ``schema_version`` + ``git_rev`` (+
+    the caller's ``device_kind`` — resolved by the bench, which may touch
+    jax; this module may not).  Keys the history off data instead of
+    filenames; failures stamp ``None``, never raise (a bench must not
+    die because git is absent)."""
+    rev = None
+    try:
+        import subprocess
+
+        out = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            capture_output=True, text=True, timeout=5,
+            cwd=root or os.getcwd())
+        rev = out.stdout.strip() or None
+    except Exception:   # noqa: BLE001 — the stamp is best-effort
+        rev = None
+    return {"schema_version": SCHEMA_VERSION, "git_rev": rev,
+            "device_kind": device_kind}
+
+
+def stats_provider(root: str = ".", tolerance: float = DEFAULT_TOLERANCE):
+    """An ``extra_stats`` provider for the /stats endpoint: loads the
+    committed history once (it is static for the life of a run) and
+    serves the regression report under ``bench_trends``."""
+    cache: dict = {}
+
+    def provide() -> dict:
+        if "report" not in cache:
+            history = load_history(root)
+            cache["report"] = compare(history, tolerance) if history else {
+                "ok": True, "n_points": 0, "newest": None, "metrics": {}}
+        return {"bench_trends": cache["report"]}
+
+    return provide

@@ -43,6 +43,12 @@ DEVICE_UNAVAILABLE = "device_unavailable"
 OOM = "oom"
 PREEMPTION = "preemption"
 UNKNOWN = "unknown"
+#: not a fault CLASS but an injection KIND (r12): the injector SLEEPS at
+#: the configured hook site instead of raising — the deterministic twin
+#: of a tunnel fetch hanging toward the ~60 s kill line, used to test the
+#: obs fetch-stall watchdog (the hook fires inside the trainer's
+#: watch_fetch bracket, so the in-flight age gauge sees the hang)
+STALL = "stall"
 
 #: classes the supervisor may retry; UNKNOWN always fails closed
 RETRYABLE = (FETCH_DEATH, DEVICE_UNAVAILABLE, OOM, PREEMPTION)
@@ -137,17 +143,22 @@ def make_fault(kind: str) -> BaseException:
 class FaultPoint:
     """One configured injection: fire at the FIRST chunk-hook event with
     ``site`` at/after ``iteration`` (>=, not ==: chunked dispatch only
-    visits chunk-start iterations, so an exact match could never hit)."""
+    visits chunk-start iterations, so an exact match could never hit).
+    ``kind=STALL`` sleeps ``stall_s`` seconds at the hook instead of
+    raising (the hung-fetch twin; the run then proceeds normally)."""
 
     iteration: int
     kind: str = DEVICE_UNAVAILABLE
     site: str = "dispatch"
+    stall_s: float = 0.0
 
     def __post_init__(self):
         if self.site not in SITES:
             raise ValueError(f"site must be one of {SITES}, got {self.site!r}")
-        if self.kind not in _CANONICAL_MSG:
+        if self.kind != STALL and self.kind not in _CANONICAL_MSG:
             raise ValueError(f"unknown fault kind {self.kind!r}")
+        if self.kind == STALL and self.stall_s <= 0:
+            raise ValueError("a STALL point needs stall_s > 0")
 
 
 class FaultInjector:
@@ -172,6 +183,14 @@ class FaultInjector:
                 self.fired.append({"point": i, "site": site,
                                    "iteration": int(iteration),
                                    "kind": pt.kind})
+                if pt.kind == STALL:
+                    # a hang, not a death: hold the hook (inside the
+                    # trainer's watch_fetch bracket) so the watchdog sees
+                    # the in-flight age rise, then let the run continue
+                    import time
+
+                    time.sleep(pt.stall_s)
+                    continue
                 raise make_fault(pt.kind)
 
     @property

@@ -36,6 +36,8 @@ from typing import Any, Optional
 from dryad_tpu.checkpoint import Checkpointer
 from dryad_tpu.obs.spans import record as record_span
 from dryad_tpu.obs.spans import span
+from dryad_tpu.obs.tripwire import default_tripwire
+from dryad_tpu.obs.watchdog import default_watchdog
 from dryad_tpu.resilience import faults as F
 from dryad_tpu.resilience.journal import RunJournal
 from dryad_tpu.resilience.policy import ChunkCapPolicy, RetryPolicy
@@ -165,6 +167,13 @@ def supervise_train(
            checkpoint_every=every, backend=backend,
            retry_budget=policy.retry_budget)
 
+    # r12: unexpected recompiles (obs/tripwire.py — a new program key
+    # after the trainer armed its family) land in the journal as events,
+    # so the flight recorder correlates them with the faults that follow
+    _remove_tw = default_tripwire().add_listener(
+        lambda program, detail: jevent("recompile_unexpected",
+                                       program=program, detail=detail))
+
     def _loop():
         nonlocal n_faults, same_point, last_resume_iter, every
         while True:
@@ -205,9 +214,18 @@ def supervise_train(
                 record_span("supervise.classify",
                             time.perf_counter() - _t_cl)
                 ckpt_iter = latest_iteration()
+                # stall correlation (r12): if the fetch watchdog saw a
+                # stall during THIS segment, record its age next to the
+                # classification — the journal then shows "pending 43 s,
+                # then fetch_death" instead of a death from nowhere
+                stall = default_watchdog().last_stall()
+                extra = {}
+                if stall is not None and stall.get("ended_at", 0) >= _t_seg:
+                    extra = {"stall_age_s": stall["age_s"],
+                             "stall_site": stall["site"]}
                 jevent("fault", kind=kind, site=last["site"],
                        iteration=last["iteration"], resume_point=ckpt_iter,
-                       message=str(exc)[:300])
+                       message=str(exc)[:300], **extra)
                 if kind == F.UNKNOWN:
                     jevent("fail_closed", reason="unknown_fault",
                            message=str(exc)[:300])
@@ -282,7 +300,8 @@ def supervise_train(
     finally:
         # EVERY exit — completion, fail-closed, an unexpected error raised
         # outside the classified path, Ctrl-C mid-backoff — releases an
-        # owned journal handle
+        # owned journal handle (and the tripwire listener, which holds it)
+        _remove_tw()
         _close(j, own_journal)
 
 

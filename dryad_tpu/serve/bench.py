@@ -69,6 +69,10 @@ def run_bench(model, *, backend: str = "cpu", clients: int = 4,
         # ---- structural warmup: one request per possible bucket ------------
         for b in server.cache.buckets():
             server.predict(feature_pool[:min(b, pool_n)])
+        # arm the recompile tripwire: from here on a cold compiled-entry
+        # key is not just counted in recompiles_after_warmup below but
+        # fires dryad_recompile_unexpected_total and degrades /healthz
+        server.warmup_complete()
         warm = server.stats()
         compiles_at_warmup = warm["cache_compiles"]
         if verbose:

@@ -111,12 +111,39 @@ class CompiledPredictCache:
         self._lock = threading.Lock()
         self._fns: dict[tuple, object] = {}
         self._warm: set[tuple] = set()
+        # recompile tripwire (r12, obs/tripwire.py): a fresh cache
+        # legitimately compiles during warmup; once ``warmup_complete()``
+        # arms the family, any NEW (version, bucket, shards) key raises
+        # ``dryad_recompile_unexpected_total`` and degrades /healthz —
+        # the "zero recompiles after warmup" test assertion as a live
+        # production alarm.  begin_program here resets the family for
+        # this cache's generation (the jax-free obs side; host keys only).
+        from dryad_tpu.obs.tripwire import default_tripwire
+
+        self._tripwire = default_tripwire()
+        self._tripwire.begin_program("serve.predict")
 
     @property
     def num_entries(self) -> int:
         """Warm (version, bucket, shards) keys — compiled shapes, not
         closures."""
         return len(self._warm)
+
+    def warmup_complete(self) -> None:
+        """Declare the expected-compile budget spent: every bucket this
+        cache can produce has been touched (``buckets()`` is the warmup
+        set and shard routing is deterministic per bucket), so any later
+        cold key is an UNEXPECTED recompile — counter + degraded
+        /healthz, not just a slow request.  Re-arming after a deploy (or
+        a fired alarm) clears the standing degradation — re-warm +
+        re-arm IS the recovery path."""
+        self._tripwire.arm("serve.predict")
+
+    def deploy_started(self) -> None:
+        """Open a deploy window (a model load legitimately compiles new
+        programs): disarm without forgetting warm keys; the caller warms
+        the new version's buckets and calls ``warmup_complete()`` again."""
+        self._tripwire.disarm("serve.predict")
 
     def buckets(self) -> list[int]:
         """Every bucket size this cache can ever produce — the warmup set.
@@ -188,6 +215,12 @@ class CompiledPredictCache:
                 fn = (self._build_jax(entry, n_shards)
                       if self.backend == "jax" else self._build_cpu(entry))
                 self._fns[fkey] = fn
+        if not hit:
+            # cold key = a compile boundary; after warmup_complete() a new
+            # key here fires the recompile tripwire (exactly once per key)
+            self._tripwire.note_compile(
+                "serve.predict", key,
+                detail=f"version={key[0]} bucket={key[1]} shards={key[2]}")
         if self.metrics is not None:
             self.metrics.record_cache(hit, entry.version)
         return fn
@@ -221,6 +254,7 @@ class CompiledPredictCache:
         import jax.numpy as jnp
 
         from dryad_tpu.cpu.predict import rf_average
+        from dryad_tpu.engine import introspect
         from dryad_tpu.engine.predict import _accumulate, sharded_accumulate_fn
 
         booster = entry.booster
@@ -240,12 +274,29 @@ class CompiledPredictCache:
             # eviction's re-stage is picked up transparently — jit caches
             # on shape/dtype, not array identity, so this never recompiles
             trees_dev, init_dev = entry.device_state(mesh)
+            # compile-boundary introspection (memoized per shape; the
+            # cache-level _get already notes the tripwire key, so the
+            # capture only records dryad_prog_* cost series)
             if mesh is not None:
                 Xd = jax.device_put(Xp, row_sharding)
+                introspect.capture(
+                    "serve.predict",
+                    (entry.version, Xp.shape, n_shards, depth,
+                     trees_dev["value"].shape),
+                    acc, trees_dev, Xd, init_dev, note_tripwire=False,
+                    labels={"bucket": Xp.shape[0], "shards": n_shards})
                 raw = np.asarray(acc(trees_dev, Xd, init_dev))
             else:
-                raw = np.asarray(_accumulate(trees_dev, jnp.asarray(Xp),
-                                             init_dev, depth))
+                Xj = jnp.asarray(Xp)
+                introspect.capture(
+                    "serve.predict",
+                    (entry.version, Xp.shape, 1, depth,
+                     trees_dev["value"].shape),
+                    _accumulate, trees_dev, Xj, init_dev, depth,
+                    note_tripwire=False,
+                    labels={"bucket": Xp.shape[0], "shards": 1})
+                raw = np.asarray(_accumulate(trees_dev, Xj, init_dev,
+                                             depth))
             if is_rf:
                 _, _, n_iter = entry.staged()
                 if n_iter > 0:

@@ -15,7 +15,9 @@ Endpoints (JSON in/out):
     POST /models/rollback    → {"version": v}
     GET  /metrics            → Prometheus text exposition of the shared
                                telemetry registry (dryad_tpu/obs)
-    GET  /healthz            → {"ok": true} (always auth-exempt)
+    GET  /healthz            → 200 {"ok": true} | 503 {"ok": false,
+                               "degraded": [...]} (obs/health.py; always
+                               auth-exempt)
 
 Routing: ``version`` pins an exact registry version, ``model`` routes by
 registry name (multi-model co-serving); default is the active version.
@@ -110,7 +112,13 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self):  # noqa: N802 — stdlib handler API
         self._req_t0 = time.perf_counter()
         if self.path == "/healthz":
-            self._send(200, {"ok": True})     # liveness probes skip auth
+            # liveness probes skip auth; the shared health state flips this
+            # (and the metrics exporter's /healthz) to 503 together — e.g.
+            # an unexpected serve recompile after warmup (obs/tripwire.py)
+            from dryad_tpu.obs.health import healthz_payload
+
+            code, body = healthz_payload()
+            self._send(code, body)
             return
         if not self._authorized():
             return

@@ -157,6 +157,11 @@ class PredictServer:
     def load_model(self, path: str, *, activate: bool = True,
                    num_iteration: Optional[int] = None,
                    name: Optional[str] = None) -> int:
+        # a deploy legitimately compiles the new version's buckets: open
+        # the tripwire's deploy window (no-op when never armed) so a
+        # routine model load can't latch /healthz at 503 — warm the new
+        # version (``warmup``) and re-arm (``warmup_complete``) to close it
+        self.cache.deploy_started()
         return self.registry.load(path, activate=activate,
                                   num_iteration=num_iteration, name=name)
 
@@ -171,6 +176,40 @@ class PredictServer:
         registry alone cannot free those (they hold the entry alive)."""
         self.registry.unload(version)
         self.cache.evict_version(version)
+
+    def warmup(self, versions=None) -> int:
+        """Structural warmup through the real compiled-predict path: one
+        zero-binned batch per (version, bucket) — ``cache.buckets()`` is
+        the complete reachable set and shard routing is deterministic per
+        bucket, so this compiles every program warm traffic can ever hit
+        — then arm the recompile tripwire (``warmup_complete``).  This is
+        the PRODUCTION arming path: the serve CLI runs it with
+        ``--warmup``; serve/bench.py does the equivalent with real
+        feature batches.  Returns the number of (version, bucket) pairs
+        touched."""
+        if versions is None:
+            versions = self.registry.versions()
+        touched = 0
+        for version in versions:
+            entry = self.registry.get(version)
+            mapper = entry.booster.mapper
+            for b in self.cache.buckets():
+                Xb = np.zeros((b, mapper.num_features), mapper.bin_dtype)
+                self.cache.predict_raw(entry, Xb)
+                touched += 1
+        self.warmup_complete()
+        return touched
+
+    def warmup_complete(self) -> None:
+        """Arm the recompile tripwire (obs/tripwire.py): the caller has
+        touched every bucket it intends to serve warm, so any later cold
+        compiled-entry key increments
+        ``dryad_recompile_unexpected_total{program="serve.predict"}`` and
+        degrades ``/healthz`` — the live form of the "zero recompiles
+        after warmup" invariant.  ``warmup()`` / serve/bench.py call this
+        after their structural warmups; re-arming after a deploy clears
+        the standing degradation (the recovery path)."""
+        self.cache.warmup_complete()
 
     # ---- request path ------------------------------------------------------
     def predict(self, X: np.ndarray, *, version: Optional[int] = None,

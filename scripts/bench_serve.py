@@ -132,6 +132,22 @@ def main(argv=None) -> int:
             sharded_report["recompiles_after_warmup"])
         summary["mesh_shards"] = sharded_report["mesh_shards"]
 
+    # artifact stamp (r12): schema_version + git rev + device kind ride
+    # both the full report and the one-line summary so the trend ledger
+    # (dryad_tpu/obs/trends.py) keys serve history off data, not filenames
+    from dryad_tpu.obs.trends import artifact_stamp
+
+    try:
+        import jax
+
+        _dev = jax.devices()[0]
+        _kind = getattr(_dev, "device_kind", None) or _dev.platform
+    except Exception:  # noqa: BLE001 — a stamp must never kill the bench
+        _kind = None
+    stamp = artifact_stamp(device_kind=_kind)
+    report.update(stamp)
+    summary.update(stamp)
+
     print(json.dumps(report, indent=1))
     if args.json:
         with open(args.json, "w") as f:

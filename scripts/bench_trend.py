@@ -1,0 +1,123 @@
+"""Bench trend ledger CLI (engine: dryad_tpu/obs/trends.py).
+
+    python scripts/bench_trend.py --check [--root .] [--tolerance 0.15]
+    python scripts/bench_trend.py --selftest
+    python scripts/bench_trend.py --json report.json
+
+``--check`` loads the committed ``BENCH_r*.json`` history, compares the
+newest point against the history median (spread-aware: a per-arm spread
+> 5% in the newest artifact makes a would-be regression ``suspect``,
+never a verdict — CLAUDE.md), prints the machine-readable report, and
+exits 1 only on a ``regression`` verdict.  scripts/ci.sh runs it over
+the committed files (must exit 0) and then ``--selftest``, which seeds a
+synthetic regression fixture in a temp dir and exits 0 only if the
+checker actually flags it — the gate proves both directions.
+
+Stdlib only (the ledger is jax-free by the obs package lint).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+
+def _write_fixture(td: str, regressed: bool) -> None:
+    """Three healthy rounds + a newest point that either holds the trend
+    or regresses the 10M marginal ~2x with a CLEAN spread (a dirty spread
+    must downgrade to ``suspect`` — also asserted by --selftest)."""
+    base = {"value": 13.0, "iters_per_sec_10m": 0.40,
+            "marginal_s_per_iter_10m": 2.5, "wall_8tree_10m": 21.0,
+            "spread_2tree_10m": 0.01, "spread_8tree_10m": 0.01}
+    for i, rnd in enumerate((1, 2, 3)):
+        point = dict(base, value=base["value"] + i * 0.1)
+        with open(os.path.join(td, f"BENCH_r{rnd:02d}.json"), "w") as f:
+            json.dump({"n": rnd, "parsed": point}, f)
+    newest = dict(base, schema_version=1, git_rev="fixture",
+                  device_kind="cpu")
+    if regressed:
+        newest["marginal_s_per_iter_10m"] = 5.2     # ~2x worse
+        newest["iters_per_sec_10m"] = 0.19
+    with open(os.path.join(td, "BENCH_r04.json"), "w") as f:
+        json.dump({"n": 4, "parsed": newest}, f)
+
+
+def _selftest() -> int:
+    from dryad_tpu.obs.trends import compare, load_history
+
+    with tempfile.TemporaryDirectory() as td:
+        _write_fixture(td, regressed=False)
+        clean = compare(load_history(td))
+        if not clean["ok"]:
+            print("SELFTEST FAIL: healthy fixture flagged", clean)
+            return 1
+        _write_fixture(td, regressed=True)
+        bad = compare(load_history(td))
+        verdicts = {m: e["verdict"] for m, e in bad["metrics"].items()}
+        if bad["ok"] or verdicts.get("marginal_s_per_iter_10m") != "regression":
+            print("SELFTEST FAIL: seeded regression not flagged", verdicts)
+            return 1
+        # the spread veto: the same regression under a suspect capture
+        # must NOT produce a regression verdict
+        _write_fixture(td, regressed=True)
+        with open(os.path.join(td, "BENCH_r04.json")) as f:
+            doc = json.load(f)
+        doc["parsed"]["spread_8tree_10m"] = 0.3
+        doc["parsed"]["spread_2tree_10m"] = 0.3
+        with open(os.path.join(td, "BENCH_r04.json"), "w") as f:
+            json.dump(doc, f)
+        vetoed = compare(load_history(td))
+        verdicts = {m: e["verdict"] for m, e in vetoed["metrics"].items()}
+        if (not vetoed["ok"]
+                or verdicts.get("marginal_s_per_iter_10m") != "suspect"):
+            print("SELFTEST FAIL: spread veto missing", verdicts)
+            return 1
+    print("TREND SELFTEST OK: regression flagged, spread veto honored")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="bench_trend")
+    ap.add_argument("--root", default=".",
+                    help="directory holding BENCH_r*.json (default: cwd)")
+    ap.add_argument("--tolerance", type=float, default=None,
+                    help="relative regression tolerance vs the history "
+                         "median (default 0.15 — trends, not points)")
+    ap.add_argument("--check", action="store_true",
+                    help="exit 1 on any regression verdict")
+    ap.add_argument("--selftest", action="store_true",
+                    help="seed a regression fixture and verify the "
+                         "checker flags it (ci.sh's proof of the gate)")
+    ap.add_argument("--json", help="also write the report here")
+    args = ap.parse_args(argv)
+
+    if args.selftest:
+        return _selftest()
+
+    from dryad_tpu.obs.trends import DEFAULT_TOLERANCE, compare, load_history
+
+    tol = args.tolerance if args.tolerance is not None else DEFAULT_TOLERANCE
+    history = load_history(args.root)
+    report = compare(history, tol)
+    print(json.dumps(report, indent=1))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(report, f, indent=1)
+    if len(history) < 2:
+        print("bench_trend: <2 history points — nothing to compare",
+              file=sys.stderr)
+        return 0
+    if args.check and not report["ok"]:
+        bad = [m for m, e in report["metrics"].items()
+               if e["verdict"] == "regression"]
+        print(f"TREND REGRESSION: {bad} vs the history median "
+              f"(tolerance {tol:.0%})", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

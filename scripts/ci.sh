@@ -29,9 +29,29 @@ if [ $analysis_rc -ne 0 ]; then
 fi
 tail -2 /tmp/_analysis.log
 
-# Observability smoke (r9): the CLI's live metrics endpoint — train 5
-# trees with --metrics-port, scrape /healthz + /stats + /metrics while
-# the run is up, assert span series non-empty and counters monotone.
+# Bench trend ledger (r12): the committed BENCH_r*.json history must be
+# regression-free under the spread-aware median check, and the checker
+# must actually FLAG a seeded regression (--selftest proves the gate
+# fires in both directions, including the suspect-capture veto).
+if ! PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}" \
+    python scripts/bench_trend.py --check > /tmp/_trend.log 2>&1; then
+  echo "TREND FAIL: bench_trend.py --check (see /tmp/_trend.log)" >&2
+  tail -8 /tmp/_trend.log >&2
+  exit 1
+fi
+if ! PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}" \
+    python scripts/bench_trend.py --selftest > /tmp/_trend_self.log 2>&1; then
+  echo "TREND SELFTEST FAIL: the seeded regression was not flagged" >&2
+  tail -5 /tmp/_trend_self.log >&2
+  exit 1
+fi
+tail -1 /tmp/_trend_self.log
+
+# Observability smoke (r9; r12 adds the device-truth families): the CLI's
+# live metrics endpoint — train 5 trees through the DEVICE trainer with
+# --metrics-port, scrape /healthz + /stats + /metrics while the run is
+# up, assert span series non-empty, counters monotone, and the
+# dryad_prog_* / dryad_fetch_* families live on the same scrape.
 if ! env JAX_PLATFORMS=cpu DRYAD_OBS=1 \
     PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}" \
     python scripts/smoke_obs.py > /tmp/_obs_smoke.log 2>&1; then

@@ -7,7 +7,12 @@ endpoint while the run is up:
 * ``/healthz`` answers (before the dataset is even loaded),
 * ``/stats`` serves non-empty span series from the training loop,
 * counters are monotone across two scrapes,
-* ``/metrics`` serves parseable Prometheus text.
+* ``/metrics`` serves parseable Prometheus text,
+* (r12) the device-truth families are live: ``dryad_prog_*``
+  cost/compile series from the compile-boundary introspection and the
+  ``dryad_fetch_*`` watchdog gauge from the trainer's fetch sites — the
+  run uses the DEVICE trainer (backend tpu on the CPU jax platform) so
+  those boundaries actually exist.
 
 DRYAD_METRICS_HOLD_S keeps the endpoint up a few seconds past the run so
 the final scrape can never race a fast train; the scrape itself happens
@@ -45,6 +50,11 @@ def main() -> int:
     # them (<1 s of HTTP work); cmd_train's finally always sleeps the full
     # hold, so every extra second here is unconditional CI wall
     os.environ["DRYAD_METRICS_HOLD_S"] = "2"
+    # device-truth families (r12): introspection on (it is the production
+    # default; tests pin it off for suite wall) plus the opt-in
+    # memory_analysis capture — cheap here, the compile is local CPU
+    os.environ["DRYAD_PROG"] = "1"
+    os.environ["DRYAD_PROG_MEMORY"] = "1"
     from dryad_tpu.__main__ import main as cli_main
 
     rng = np.random.default_rng(0)
@@ -65,7 +75,7 @@ def main() -> int:
                 rc["code"] = cli_main([
                     "train", "--config", f"{td}/cfg.json",
                     "--data", f"{td}/X.npy", "--label", f"{td}/y.npy",
-                    "--backend", "cpu", "--quiet",
+                    "--backend", "tpu", "--quiet",
                     "--metrics-port", str(port)])
             except BaseException as e:  # noqa: BLE001 — reported below
                 rc["error"] = e
@@ -111,8 +121,11 @@ def main() -> int:
         if rc.get("code") != 0 or "error" in rc:
             print(f"OBS SMOKE FAIL: CLI train failed ({rc})")
             return 1
-        if "train.iteration" not in snap1["spans"]:
-            print(f"OBS SMOKE FAIL: no train.iteration span: "
+        # the device trainer's chunked path emits chunk_dispatch series;
+        # per-iteration dispatch (or the CPU trainer) emits train.iteration
+        if not ({"train.chunk_dispatch", "train.iteration"}
+                & set(snap1["spans"])):
+            print(f"OBS SMOKE FAIL: no trainer loop span: "
                   f"{sorted(snap1['spans'])}")
             return 1
         # monotone counters: every series present at scrape 1 is >= at 2
@@ -126,9 +139,22 @@ def main() -> int:
         if "# TYPE dryad_span_count_total counter" not in metrics_text:
             print("OBS SMOKE FAIL: /metrics missing span families")
             return 1
+        # r12 device-truth families must be live on the same scrape: the
+        # compile-boundary cost/memory series and the fetch watchdog gauge
+        dt_families = ("dryad_prog_flops", "dryad_prog_bytes_accessed",
+                       "dryad_prog_memory_bytes", "dryad_prog_compiles_total",
+                       "dryad_fetch_inflight_age_seconds")
+        for family in dt_families:
+            if family not in metrics_text:
+                print(f"OBS SMOKE FAIL: /metrics missing {family}")
+                return 1
+        if "bench_trends" not in snap2:
+            print("OBS SMOKE FAIL: /stats missing the bench_trends ledger")
+            return 1
         n_spans = len(snap2["spans"])
         print(f"OBS SMOKE OK: {n_spans} span series, "
               f"{len(snap2['counters'])} counter families, "
+              f"device_truth_families={len(dt_families)}, "
               f"iters={snap2['gauges'].get('dryad_train_iteration', {}).get('', '?')}")
     return 0
 

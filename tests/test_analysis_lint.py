@@ -371,3 +371,86 @@ def test_bench_real_fetch_float_of_call_result_name_counts():
     rep = _violations("bench-real-fetch",
                       {"scripts/_fixture_probe.py": ok})
     assert not _rule_hits(rep, "bench-real-fetch")
+
+
+# ---------------------------------------------------------------------------
+# introspect-compile-only (r12)
+
+def test_introspect_cost_analysis_seeded_outside_introspect():
+    src = SourceTree(ROOT).read("dryad_tpu/engine/levelwise.py")
+    bad = src + ("\ndef _peek(fn, x):\n"
+                 "    return fn.lower(x).cost_analysis()\n")
+    rep = _violations("introspect-compile-only",
+                      {"dryad_tpu/engine/levelwise.py": bad})
+    assert any("cost_analysis" in v.message for v in
+               _rule_hits(rep, "introspect-compile-only"))
+
+
+def test_introspect_aot_compile_seeded_in_serve():
+    src = SourceTree(ROOT).read("dryad_tpu/serve/cache.py")
+    bad = src + ("\ndef _aot(fn, x):\n"
+                 "    return fn.lower(x).compile()\n")
+    rep = _violations("introspect-compile-only",
+                      {"dryad_tpu/serve/cache.py": bad})
+    assert any(".compile()" in v.message for v in
+               _rule_hits(rep, "introspect-compile-only"))
+
+
+def test_introspect_re_compile_with_args_is_clean():
+    # re.compile(pattern) takes arguments — only the zero-arg AOT form is
+    # the banned shape (resilience/faults.py uses re.compile today)
+    src = SourceTree(ROOT).read("dryad_tpu/resilience/faults.py")
+    bad = src + '\n_EXTRA_PAT = re.compile("x")\n'
+    rep = _violations("introspect-compile-only",
+                      {"dryad_tpu/resilience/faults.py": bad})
+    assert not _rule_hits(rep, "introspect-compile-only")
+
+
+def test_introspect_capture_inside_traced_body_seeded():
+    src = SourceTree(ROOT).read("dryad_tpu/engine/levelwise.py")
+    bad = src + (
+        "\ndef _hot(n, s, fn):\n"
+        "    def body(i, carry):\n"
+        "        introspect.capture('train.chunk', ('k',), fn)\n"
+        "        return carry\n"
+        "    return jax.lax.fori_loop(0, n, body, s)\n")
+    rep = _violations("introspect-compile-only",
+                      {"dryad_tpu/engine/levelwise.py": bad})
+    assert any("traced body" in v.message for v in
+               _rule_hits(rep, "introspect-compile-only"))
+
+
+def test_introspect_expensive_call_in_loop_inside_introspect_py():
+    src = SourceTree(ROOT).read("dryad_tpu/engine/introspect.py")
+    bad = src + ("\ndef _sweep(lowereds):\n"
+                 "    out = []\n"
+                 "    for low in lowereds:\n"
+                 "        out.append(low.cost_analysis())\n"
+                 "    return out\n")
+    rep = _violations("introspect-compile-only",
+                      {"dryad_tpu/engine/introspect.py": bad})
+    assert _rule_hits(rep, "introspect-compile-only")
+
+
+def test_introspect_shipped_tree_clean():
+    rep = _violations("introspect-compile-only")
+    assert not rep.violations, "\n".join(
+        v.format() for v in rep.violations)
+
+
+def test_obs_trends_is_covered_by_the_transitive_jax_walk():
+    """The r12 satellite's explicit check: obs/trends.py rides the
+    obs-jax-free TRANSITIVE walk — a jax import seeded there (directly or
+    through an innocent-looking helper) must be flagged."""
+    src = SourceTree(ROOT).read("dryad_tpu/obs/trends.py")
+    rep = _violations("obs-jax-free",
+                      {"dryad_tpu/obs/trends.py": src + "\nimport jax\n"})
+    assert _rule_hits(rep, "obs-jax-free")
+    helper = "import jax\n\ndef rev():\n    return 'x'\n"
+    bad = "from dryad_tpu._gitutil import rev\n" + src
+    rep = _violations("obs-jax-free", {
+        "dryad_tpu/_gitutil.py": helper,
+        "dryad_tpu/obs/trends.py": bad,
+    })
+    assert any("transitive" in v.message
+               for v in _rule_hits(rep, "obs-jax-free"))

@@ -1,0 +1,456 @@
+"""Device-truth observability (r12): compiled-program introspection,
+recompile tripwire, fetch-stall watchdog, health-aware /healthz.
+
+Pins the ISSUE 8 contracts on CPU:
+
+* the serve tripwire — a forced bucket-miss AFTER warmup increments
+  ``dryad_recompile_unexpected_total`` exactly once while warm repeats
+  never fire (no false positives);
+* the fetch-stall watchdog — a ``FaultInjector``-stalled fetch raises
+  the in-flight age gauge and flips ``/healthz`` to degraded, recovery
+  clears it;
+* compile-boundary introspection — ``dryad_prog_*`` cost/memory series
+  appear for the device trainer's chunk program, and the capture is
+  memoized (no re-lower on a warm re-run);
+* the ACCEPTANCE drill — a supervised CPU run with an injected stalled
+  fetch plus a forced serve recompile, scraped over HTTP mid-run: stall
+  gauge rising, ``/healthz`` 503, the recompile counter firing exactly
+  once, ``dryad_prog_*`` present for BOTH growers — then completing
+  bitwise-equal to the uninstrumented run.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import dryad_tpu as dryad
+from dryad_tpu.datasets import higgs_like
+from dryad_tpu.obs import (
+    FetchWatchdog,
+    Registry,
+    default_health,
+    healthz_payload,
+    set_default_registry,
+    set_default_watchdog,
+    start_exporter,
+)
+from dryad_tpu.obs.tripwire import RecompileTripwire
+from dryad_tpu.resilience import FaultInjector, RetryPolicy, supervise_train
+from dryad_tpu.resilience import faults as F
+
+PARAMS = dict(objective="binary", num_trees=8, num_leaves=7, max_bins=32,
+              seed=3, min_data_in_leaf=5)
+
+
+@pytest.fixture(scope="module")
+def data():
+    X, y = higgs_like(3000, seed=21)
+    return dryad.Dataset(X, y, max_bins=32)
+
+
+@pytest.fixture()
+def fresh_registry():
+    reg = Registry()
+    old = set_default_registry(reg)
+    yield reg
+    set_default_registry(old)
+
+
+@pytest.fixture(autouse=True)
+def clean_health():
+    """Every test starts AND ends with a clean process health state — a
+    leaked degradation would 503 unrelated tests' /healthz probes."""
+    default_health().reset()
+    yield
+    default_health().reset()
+
+
+def _get(url, timeout=5):
+    return urllib.request.urlopen(url, timeout=timeout).read()
+
+
+# ---- health state -----------------------------------------------------------
+
+def test_health_state_degrade_clear_and_payload(fresh_registry):
+    h = default_health()
+    code, body = healthz_payload()
+    assert (code, body) == (200, {"ok": True})
+    h.degrade("fetch_stall", "pending 31s")
+    h.degrade("recompile", "serve bucket miss")
+    code, body = healthz_payload()
+    assert code == 503 and body["ok"] is False
+    assert body["degraded"] == ["fetch_stall", "recompile"]
+    # gauge mirror: 1 while active, 0 after recovery
+    g = fresh_registry.gauge("dryad_health_degraded")
+    assert g.labels(reason="fetch_stall").value() == 1
+    h.clear("fetch_stall")
+    h.clear("recompile")
+    assert healthz_payload() == (200, {"ok": True})
+    assert g.labels(reason="fetch_stall").value() == 0
+
+
+def test_exporter_healthz_flips_with_health(fresh_registry):
+    ex = start_exporter(fresh_registry, port=0)
+    try:
+        assert json.loads(_get(ex.url + "/healthz")) == {"ok": True}
+        default_health().degrade("fetch_stall", "test")
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _get(ex.url + "/healthz")
+        assert err.value.code == 503
+        assert json.loads(err.value.read())["degraded"] == ["fetch_stall"]
+        default_health().clear("fetch_stall")
+        assert json.loads(_get(ex.url + "/healthz")) == {"ok": True}
+    finally:
+        ex.stop()
+
+
+# ---- fetch-stall watchdog ---------------------------------------------------
+
+def test_watchdog_stall_raises_gauge_then_recovery_clears(fresh_registry):
+    dog = FetchWatchdog(fresh_registry, threshold_s=0.05,
+                        poll_interval_s=0.01)
+    gauge = fresh_registry.gauge("dryad_fetch_inflight_age_seconds")
+    with dog.watch("eval", 7):
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and default_health().ok:
+            time.sleep(0.01)
+        # mid-stall: gauge above threshold, health degraded, counter up
+        assert not default_health().ok
+        assert "fetch_stall" in default_health().reasons()
+        assert gauge.value() >= 0.05
+        assert fresh_registry.counter("dryad_fetch_stalls_total").labels(
+            site="eval").value() == 1
+    # recovery: gauge back to 0, health clean, stall recorded for the
+    # supervisor's correlation hook
+    assert default_health().ok
+    assert gauge.value() == 0.0
+    stall = dog.last_stall()
+    assert stall["site"] == "eval" and stall["iteration"] == 7
+    assert stall["age_s"] >= 0.05
+
+
+def test_watchdog_fast_fetches_never_stall(fresh_registry):
+    dog = FetchWatchdog(fresh_registry, threshold_s=5.0,
+                        poll_interval_s=0.01)
+    for i in range(5):
+        with dog.watch("runahead", i):
+            pass
+    assert default_health().ok
+    assert fresh_registry.counter("dryad_fetch_stalls_total").labels(
+        site="runahead").value() == 0
+    assert dog.last_stall() is None
+
+
+def test_watchdog_disabled_registry_is_null(fresh_registry):
+    fresh_registry.disable()
+    dog = FetchWatchdog(fresh_registry, threshold_s=0.01)
+    w = dog.watch("eval", 0)
+    with w:
+        time.sleep(0.03)
+    assert dog.watch("eval", 1) is dog.watch("eval", 2)  # shared null ctx
+    fresh_registry.enable()
+    assert fresh_registry.snapshot()["counters"] == {}
+
+
+def test_injected_stall_through_the_device_trainer(data, fresh_registry):
+    """A FaultInjector STALL point at a real trainer fetch site holds the
+    hook inside the watch_fetch bracket: the watchdog must see it, count
+    it, and the run must complete normally (a hang, not a death)."""
+    dog = FetchWatchdog(fresh_registry, threshold_s=0.05,
+                        poll_interval_s=0.01)
+    old = set_default_watchdog(dog)
+    injector = FaultInjector([(0, F.STALL, "fetch", 0.3)])
+    try:
+        booster = dryad.train(PARAMS, data, backend="tpu",
+                              chunk_hook=injector)
+    finally:
+        set_default_watchdog(old)
+    assert injector.pending == 0
+    assert booster.num_iterations == PARAMS["num_trees"]
+    stall = dog.last_stall()
+    assert stall is not None and stall["age_s"] >= 0.05
+    # the injected sleep fires at the FIRST fetch hook — the calibrate
+    # site — inside its watch bracket: that watch stalls exactly once.
+    # (Other sites may legitimately cross the tiny 50 ms test threshold
+    # under CI load, so only the injected site is pinned exactly.)
+    stalls = fresh_registry.counter("dryad_fetch_stalls_total")
+    assert stalls.labels(site="calibrate").value() == 1
+    assert default_health().ok     # recovered
+
+
+# ---- recompile tripwire -----------------------------------------------------
+
+def test_tripwire_unit_arm_and_key_change(fresh_registry):
+    tw = RecompileTripwire(fresh_registry)
+    fired = []
+    remove = tw.add_listener(lambda program, detail: fired.append(detail))
+    tw.begin_program("train.chunk")
+    assert tw.note_compile("train.chunk", ("key", 1)) is True
+    assert tw.note_compile("train.chunk", ("key", 1)) is False  # warm
+    tw.arm("train.chunk")
+    assert tw.note_compile("train.chunk", ("key", 1)) is False  # still warm
+    assert fired == [] and default_health().ok
+    tw.note_compile("train.chunk", ("key", 2))                  # p_key drift
+    assert len(fired) == 1
+    assert fresh_registry.counter(
+        "dryad_recompile_unexpected_total").labels(
+        program="train.chunk").value() == 1
+    # degradation is scoped PER FAMILY: another family's lifecycle must
+    # not clear this alarm, and re-arming THIS family is the recovery
+    assert "recompile:train.chunk" in default_health().reasons()
+    tw.begin_program("serve.predict")
+    assert "recompile:train.chunk" in default_health().reasons()
+    tw.arm("train.chunk")                                       # re-arm =
+    assert default_health().ok                                  # recovery
+    # a new run resets: disarmed, health cleared
+    tw.begin_program("train.chunk")
+    assert not tw.armed("train.chunk") and default_health().ok
+    # arming a KEY-LESS family is inert: a run warmed under a disabled
+    # registry must not false-fire when obs is enabled mid-run
+    tw.arm("train.chunk")
+    assert not tw.armed("train.chunk")
+    tw.note_compile("train.chunk", ("key", 6))                  # no fire
+    assert fresh_registry.counter(
+        "dryad_recompile_unexpected_total").labels(
+        program="train.chunk").value() == 1
+    remove()
+    tw.arm("train.chunk")
+    tw.note_compile("train.chunk", ("key", 7))                  # fires, but
+    assert len(fired) == 1                                      # no listener
+
+
+def test_serve_bucket_miss_after_warmup_fires_once(fresh_registry):
+    """The ISSUE satellite: forced serve bucket-miss increments
+    dryad_recompile_unexpected_total while warm repeats don't."""
+    from dryad_tpu.serve import PredictServer
+
+    X, y = higgs_like(600, seed=5)
+    booster = dryad.train(dict(PARAMS, num_trees=4), dryad.Dataset(
+        X, y, max_bins=32), backend="cpu")
+    server = PredictServer(backend="cpu", max_batch_rows=64, min_bucket=8)
+    server.registry.add(booster)
+    unexpected = fresh_registry.counter("dryad_recompile_unexpected_total")
+    with server:
+        for b in (8, 16):        # partial warmup, on purpose
+            server.predict(X[:b])
+        server.warmup_complete()
+        for _ in range(3):       # warm repeats: no false positives
+            server.predict(X[:8])
+            server.predict(X[:13])   # still bucket 16
+        assert unexpected.labels(program="serve.predict").value() == 0
+        assert default_health().ok
+        server.predict(X[:40])   # bucket 64 was never warmed: fires
+        assert unexpected.labels(program="serve.predict").value() == 1
+        assert "recompile:serve.predict" in default_health().reasons()
+        server.predict(X[:40])   # the key is known now: exactly once
+        assert unexpected.labels(program="serve.predict").value() == 1
+        # recovery: re-arming (re-warm done — the key is in the set now)
+        # clears the standing degradation
+        server.warmup_complete()
+        assert default_health().ok
+
+
+def test_serve_warmup_arms_and_deploy_window(fresh_registry, tmp_path):
+    """The PRODUCTION arming path: server.warmup() compiles every
+    (version, bucket) program and arms; a later load_model opens a deploy
+    window (no latched 503 on a routine deploy) and warmup() re-arms."""
+    from dryad_tpu.serve import PredictServer
+
+    X, y = higgs_like(600, seed=5)
+    booster = dryad.train(dict(PARAMS, num_trees=4), dryad.Dataset(
+        X, y, max_bins=32), backend="cpu")
+    server = PredictServer(backend="cpu", max_batch_rows=32, min_bucket=8)
+    server.registry.add(booster)
+    unexpected = fresh_registry.counter("dryad_recompile_unexpected_total")
+    with server:
+        touched = server.warmup()
+        assert touched == len(server.cache.buckets())
+        for n in (1, 5, 20, 32):         # every bucket warm, tripwire armed
+            server.predict(X[:n])
+        assert unexpected.labels(program="serve.predict").value() == 0
+        # deploy: a new version's compiles are NOT unexpected during the
+        # window; warmup() closes it re-armed
+        path = str(tmp_path / "v2.dryad")
+        booster.save(path)
+        v2 = server.load_model(path)
+        server.predict(X[:5], version=v2)    # cold key, window open
+        assert unexpected.labels(program="serve.predict").value() == 0
+        assert default_health().ok
+        server.warmup()
+        server.predict(X[:5], version=v2)
+        assert unexpected.labels(program="serve.predict").value() == 0
+
+
+# ---- compile-boundary introspection ----------------------------------------
+
+def test_introspect_records_prog_series(data, fresh_registry, monkeypatch):
+    monkeypatch.setenv("DRYAD_PROG", "1")
+    monkeypatch.setenv("DRYAD_PROG_MEMORY", "1")
+    from dryad_tpu.engine import introspect
+
+    introspect.reset_seen()
+    booster = dryad.train(PARAMS, data, backend="tpu")
+    snap = fresh_registry.snapshot()
+    flops = snap["gauges"]["dryad_prog_flops"]
+    label = next(iter(flops))
+    assert 'program="train.chunk"' in label and flops[label] > 0
+    assert snap["gauges"]["dryad_prog_bytes_accessed"]
+    kinds = {lbl for lbl in snap["gauges"]["dryad_prog_memory_bytes"]}
+    assert any('kind="temp"' in k for k in kinds)
+    assert snap["counters"]["dryad_prog_compiles_total"][
+        'program="train.chunk"'] == 1
+    captures = fresh_registry.counter("dryad_prog_captures_total").labels(
+        program="train.chunk")
+    n0 = captures.value()
+    assert n0 >= 1
+    # warm re-run: memoized — no re-capture, no unexpected recompile
+    dryad.train(PARAMS, data, backend="tpu")
+    assert captures.value() == n0
+    assert snap["counters"].get("dryad_recompile_unexpected_total", {}) == {}
+    assert booster.num_iterations == PARAMS["num_trees"]
+
+
+def test_introspect_off_by_default_in_suite(data, fresh_registry):
+    """conftest pins DRYAD_PROG=0 for suite wall: no capture happens, and
+    the registry carries no dryad_prog cost series after a train."""
+    dryad.train(PARAMS, data, backend="tpu")
+    snap = fresh_registry.snapshot()
+    assert "dryad_prog_flops" not in snap["gauges"]
+
+
+def test_predict_capture_single_and_sharded(data, fresh_registry,
+                                            monkeypatch):
+    monkeypatch.setenv("DRYAD_PROG", "1")
+    from dryad_tpu.engine import introspect
+    from dryad_tpu.engine.predict import (
+        predict_binned_device,
+        predict_binned_sharded,
+    )
+
+    introspect.reset_seen()
+    booster = dryad.train(dict(PARAMS, num_trees=4), data, backend="cpu")
+    Xb = data.X_binned[:64]
+    raw_single = np.asarray(predict_binned_device(booster, Xb))
+    raw_sharded = predict_binned_sharded(booster, Xb)
+    np.testing.assert_array_equal(raw_single, raw_sharded)
+    flops = fresh_registry.snapshot()["gauges"]["dryad_prog_flops"]
+    arms = {lbl for lbl in flops if 'program="predict"' in lbl}
+    assert any('arm="single"' in a for a in arms)
+    assert any('arm="sharded"' in a for a in arms)
+
+
+# ---- the acceptance drill ---------------------------------------------------
+
+def test_acceptance_stall_recompile_prog_series_live(data, tmp_path,
+                                                     fresh_registry,
+                                                     monkeypatch):
+    """Supervised CPU run (device trainer) with an injected stalled fetch
+    + a forced serve recompile, scraped over HTTP mid-run: the stall
+    gauge rises, /healthz goes 503, the recompile counter fires exactly
+    once, dryad_prog_* cost series exist for BOTH growers — and the run
+    completes bitwise-equal to the uninstrumented one."""
+    monkeypatch.setenv("DRYAD_PROG", "1")
+    monkeypatch.setenv("DRYAD_PROG_MEMORY", "1")
+    from dryad_tpu.engine import introspect
+
+    introspect.reset_seen()
+    dog = FetchWatchdog(fresh_registry, threshold_s=0.2,
+                        poll_interval_s=0.02)
+    old_dog = set_default_watchdog(dog)
+    injector = FaultInjector([(0, F.STALL, "fetch", 2.5)])
+    jpath = str(tmp_path / "run.jsonl")
+    ex = start_exporter(fresh_registry, port=0)
+    result = {}
+
+    def run():
+        try:
+            result["booster"] = supervise_train(
+                PARAMS, data, backend="tpu",
+                checkpoint_dir=str(tmp_path / "ck"), checkpoint_every=4,
+                journal=jpath, fault_injector=injector,
+                policy=RetryPolicy(backoff_base_s=0.0))
+        except BaseException as e:  # noqa: BLE001 — surfaced below
+            result["error"] = e
+
+    thread = threading.Thread(target=run)
+    thread.start()
+    try:
+        # scrape DURING the injected stall: /healthz 503 with fetch_stall
+        # and the in-flight age gauge above the threshold
+        deadline = time.monotonic() + 60
+        saw_degraded = saw_gauge = False
+        while time.monotonic() < deadline and thread.is_alive():
+            try:
+                _get(ex.url + "/healthz", timeout=2)
+            except urllib.error.HTTPError as err:
+                if err.code == 503 and "fetch_stall" in json.loads(
+                        err.read()).get("degraded", []):
+                    saw_degraded = True
+                    stats = json.loads(_get(ex.url + "/stats"))
+                    age = stats["gauges"][
+                        "dryad_fetch_inflight_age_seconds"][""]
+                    saw_gauge = age >= 0.2
+                    break
+            time.sleep(0.02)
+        assert saw_degraded, "never saw /healthz degrade during the stall"
+        assert saw_gauge, "stall gauge never rose past the threshold"
+    finally:
+        thread.join(180)
+        set_default_watchdog(old_dog)
+    assert "error" not in result, result.get("error")
+    assert injector.pending == 0
+    # recovered: /healthz green again, run complete
+    assert json.loads(_get(ex.url + "/healthz")) == {"ok": True}
+
+    # dryad_prog_* cost/memory series for BOTH growers: the supervised run
+    # used the default leaf-wise growth; a short depthwise run adds the
+    # level-synchronous grower's program
+    dryad.train(dict(PARAMS, growth="depthwise", num_trees=2,
+                     max_depth=4), data, backend="tpu")
+    flops = json.loads(_get(ex.url + "/stats"))["gauges"]["dryad_prog_flops"]
+    chunk_labels = [lbl for lbl in flops if 'program="train.chunk"' in lbl]
+    growths = {g for lbl in chunk_labels
+               for g in ("depthwise", "leafwise") if f'growth="{g}"' in lbl}
+    assert growths == {"depthwise", "leafwise"}, chunk_labels
+    mem = json.loads(_get(ex.url + "/stats"))["gauges"][
+        "dryad_prog_memory_bytes"]
+    assert any('program="train.chunk"' in lbl for lbl in mem)
+
+    # forced serve recompile after warmup: counter fires EXACTLY once
+    from dryad_tpu.serve import PredictServer
+
+    server = PredictServer(backend="cpu", max_batch_rows=64, min_bucket=8)
+    server.registry.add(result["booster"])
+    X = np.asarray(data.X_binned[:64], data.X_binned.dtype)
+    with server:
+        server.predict(X[:8], binned=True)
+        server.warmup_complete()
+        server.predict(X[:40], binned=True)      # cold bucket 64: fires
+        server.predict(X[:40], binned=True)      # warm now: still once
+    unexpected = json.loads(_get(ex.url + "/stats"))["counters"][
+        "dryad_recompile_unexpected_total"]
+    assert unexpected['program="serve.predict"'] == 1
+    ex.stop()
+
+    # the journal recorded the chunk traffic of a completed run
+    from dryad_tpu.resilience import RunJournal
+
+    events = [e["event"] for e in RunJournal.read_last_run(jpath)]
+    assert "complete" in events and "fault" not in events
+
+    # bitwise: instrumented + stalled == uninstrumented
+    default_health().reset()
+    off = Registry(enabled=False)
+    prev = set_default_registry(off)
+    try:
+        reference = dryad.train(PARAMS, data, backend="tpu")
+    finally:
+        set_default_registry(prev)
+    np.testing.assert_array_equal(reference.feature,
+                                  result["booster"].feature)
+    np.testing.assert_array_equal(reference.value, result["booster"].value)
