@@ -8,6 +8,8 @@
         [--log-jsonl metrics.jsonl] [--backend auto|tpu|cpu] [--quiet]
     python -m dryad_tpu predict --model m.dryad --data X.npy --out preds.npy [--raw]
     python -m dryad_tpu dump    --model m.dryad [--out model.json]
+    python -m dryad_tpu profile [--selftest] [--stage NAME ...] [--rows N] \
+        [--k K --reps R --slots P] [--out PROFILE.json] [--list]
     python -m dryad_tpu serve   --model m.dryad [--model fraud=m2.dryad ...] \
         [--host H --port P] [--backend auto|tpu|cpu] \
         [--max-batch-rows N --max-wait-ms F] [--pipeline-depth 2] \
@@ -130,6 +132,17 @@ def cmd_train(args) -> int:
         if args.journal:
             tail = JournalTail(args.journal).start()
 
+    trace_buf = None
+    if args.trace_out:
+        # capture the span tree live; the trace is written in the finally
+        # below so a faulted run still leaves its timeline behind
+        from dryad_tpu.obs import trace_export
+
+        trace_buf = trace_export.enable_tracing()
+        # the ring is process-wide: an in-process caller's SECOND train
+        # run would otherwise write the first run's spans into its trace
+        trace_buf.clear()
+
     logger = None
     # everything past exporter/tail startup runs under the finally that
     # stops them: an in-process caller (tests, smoke_obs) hitting a bad
@@ -181,6 +194,25 @@ def cmd_train(args) -> int:
                 profile_dir=args.profile_dir,
             )
     finally:
+        if trace_buf is not None:
+            from dryad_tpu.obs import trace_export
+
+            try:
+                journal_events = ()
+                if args.journal and os.path.exists(args.journal):
+                    from dryad_tpu.resilience.journal import RunJournal
+
+                    journal_events = RunJournal.read_last_run(args.journal)
+                trace_export.write_trace(args.trace_out,
+                                         span_events=trace_buf.events(),
+                                         journal_events=journal_events)
+                if not args.quiet:
+                    print(f"wrote Chrome trace -> {args.trace_out}")
+            except Exception as e:  # noqa: BLE001 — the trace is best-
+                print(f"trace export failed: {e!r}",  # effort; never mask
+                      file=sys.stderr)                # the training error
+            finally:
+                trace_export.disable_tracing()
         if logger is not None:
             logger.close()
         # DRYAD_METRICS_HOLD_S keeps the endpoint up briefly after the run
@@ -195,6 +227,74 @@ def cmd_train(args) -> int:
         booster.save(args.model)
         if not args.quiet:
             print(f"saved {booster.num_iterations} iterations -> {args.model}")
+    return 0
+
+
+def cmd_profile(args) -> int:
+    """Stage-level device profiler (engine/probes.py): liveness-proven
+    timed-fori walls for the named hot-path stages, exported as
+    ``dryad_stage_ms`` gauges and a stamped PROFILE artifact the trend
+    ledger ingests.  ``--selftest`` is the ci.sh gate: the seeded
+    dead-perturbation probe MUST be rejected and every shipped probe must
+    pass liveness (CPU, seconds)."""
+    from dryad_tpu.engine import probes
+
+    if args.list:
+        for name, probe in probes.PROBES.items():
+            print(f"{name:20s} {probe.doc}")
+        return 0
+    if args.selftest:
+        return probes.run_selftest(quiet=args.quiet)
+
+    names = args.stage or list(probes.PROBES)
+    unknown = [n for n in names if n not in probes.PROBES]
+    if unknown:
+        raise SystemExit(f"unknown stage(s): {unknown} "
+                         f"(see --list)")
+    results = []
+    for name in names:
+        r = probes.run_probe(name, rows=args.rows, K=args.k,
+                             reps=args.reps, num_slots=args.slots)
+        if not args.quiet:
+            flag = "  SUSPECT CAPTURE" if (
+                r["spread"] > probes.SPREAD_SUSPECT) else ""
+            print(f"stage {name:20s} {r['ms']:10.2f} ms  "
+                  f"spread {r['spread']:.3f}{flag}")
+        results.append(r)
+
+    import jax
+
+    from dryad_tpu.obs.profiler import export_stages, profile_artifact
+    from dryad_tpu.obs.trends import PROFILE_PATTERN, compare, load_history
+
+    export_stages(results)
+    dev = jax.devices()[0]
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    art = profile_artifact(
+        results, device_kind=getattr(dev, "device_kind", None) or dev.platform,
+        root=root)
+    print(json.dumps(art))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(art, f, indent=1)
+            f.write("\n")
+    if args.check_trend and not args.trend_root:
+        raise SystemExit("--check-trend requires --trend-root (the "
+                         "directory holding the PROFILE_r*.json history)")
+    if args.trend_root:
+        history = load_history(args.trend_root, pattern=PROFILE_PATTERN)
+        if not history:
+            # an empty/typo'd history must not turn a CI gate green
+            msg = (f"no loadable PROFILE_r*.json under {args.trend_root!r}"
+                   " — nothing to compare")
+            if args.check_trend:
+                raise SystemExit(msg)
+            print(msg, file=sys.stderr)
+        else:
+            report = compare(history)
+            print(json.dumps({"profile_trends": report}))
+            if args.check_trend and not report["ok"]:
+                return 1
     return 0
 
 
@@ -349,6 +449,11 @@ def main(argv=None) -> int:
                         "keeping the highest supervise_attempt per "
                         "iteration)")
     t.add_argument("--profile-dir", help="capture a jax.profiler trace here")
+    t.add_argument("--trace-out",
+                   help="write a Chrome trace_event JSON (Perfetto-"
+                        "loadable) of the run's span tree — plus the "
+                        "journal events under --supervise --journal — "
+                        "here (obs/trace_export.py)")
     t.add_argument("--log-period", type=int, default=1)
     t.add_argument("--metrics-port", type=int, default=None,
                    help="mount the live observability endpoint on this "
@@ -362,6 +467,34 @@ def main(argv=None) -> int:
                         "DRYAD_AUTH_TOKEN; /healthz stays open)")
     t.add_argument("--quiet", action="store_true")
     t.set_defaults(fn=cmd_train)
+
+    pf = sub.add_parser("profile",
+                        help="stage-level device profiler (timed-fori "
+                             "harness with runtime liveness proofs)")
+    pf.add_argument("--selftest", action="store_true",
+                    help="prove the liveness proof: the seeded dead probe "
+                         "must be rejected, every shipped probe must pass "
+                         "(the ci.sh gate; CPU, seconds)")
+    pf.add_argument("--list", action="store_true",
+                    help="print the stage-probe catalog and exit")
+    pf.add_argument("--stage", action="append", default=None,
+                    help="restrict to the named stage(s); repeatable")
+    pf.add_argument("--rows", type=int, default=None,
+                    help="probe row count (default: 1M on device, 8192 CPU)")
+    pf.add_argument("--k", type=int, default=3,
+                    help="dependent iterations inside the timed fori")
+    pf.add_argument("--reps", type=int, default=2,
+                    help="timed programs per probe (min is the estimator)")
+    pf.add_argument("--slots", type=int, default=64,
+                    help="segment/slot count P for the per-level stages")
+    pf.add_argument("--out", help="also write the stamped PROFILE JSON here")
+    pf.add_argument("--trend-root", default=None,
+                    help="compare against the PROFILE_r*.json history in "
+                         "this directory (newest-vs-median, spread veto)")
+    pf.add_argument("--check-trend", action="store_true",
+                    help="exit 1 on a profile-trend regression verdict")
+    pf.add_argument("--quiet", action="store_true")
+    pf.set_defaults(fn=cmd_profile)
 
     pr = sub.add_parser("predict", help="predict with a saved model")
     pr.add_argument("--model", required=True)

@@ -24,6 +24,10 @@ introspect-compile-only  cost_analysis/memory_analysis/AOT-compile() live in
                        engine/introspect.py ONLY, and never in a loop or a
                        traced (fori/scan) body — the recompile tripwire
                        must never become a per-iteration host sync (r12)
+unharnessed-timed-fori  the timed-fori discipline lives in exactly one
+                       place (engine/probes.timed_fori, with the runtime
+                       liveness proof); bench/profile scripts must not
+                       re-copy it around a raw lax.fori_loop (r13)
 =====================  =====================================================
 """
 
@@ -502,7 +506,10 @@ def _check_bench_fetch(path, src, tree):
 register(Rule(
     name="bench-real-fetch",
     doc="timed fori programs must end in a real host fetch",
-    targets=("bench.py", "scripts/*.py"),
+    # r13: the harness itself (engine/probes.py) and the profile CLI are
+    # in scope — the ONE place the discipline lives must machine-check too
+    targets=("bench.py", "scripts/*.py", "dryad_tpu/engine/probes.py",
+             "dryad_tpu/__main__.py"),
     check=_check_bench_fetch,
 ))
 
@@ -558,8 +565,54 @@ def _check_dead_perturbation(path, src, tree):
 register(Rule(
     name="dead-perturbation",
     doc="perturbations must survive integer rounding to reach the stage",
-    targets=("bench.py", "scripts/*.py", "dryad_tpu/engine/**"),
+    # engine/** already covers engine/probes.py; the profile CLI rides too
+    targets=("bench.py", "scripts/*.py", "dryad_tpu/engine/**",
+             "dryad_tpu/__main__.py"),
     check=_check_dead_perturbation,
+))
+
+
+# ---------------------------------------------------------------------------
+# unharnessed-timed-fori (r13)
+#
+# The timed-fori discipline lives in EXACTLY one place now —
+# engine/probes.timed_fori, which adds the runtime liveness proof (two
+# perturbation seeds must fetch differing accumulators, so a hoisted or
+# rounded-away stage raises instead of measuring 2x fast).  A bench or
+# profile script that times a hand-rolled lax.fori_loop (>= 1
+# perf_counter + a fori_loop call in one function) has forked the
+# discipline again and bypassed the proof.  The archived r3-r5
+# ``exp_*`` one-shot experiment records predate the harness and are kept
+# verbatim for provenance, so the rule scopes to the LIVING measurement
+# surfaces: bench.py and the maintained profile_*/bench_*/smoke_*
+# scripts.
+
+def _check_unharnessed_fori(path, src, tree):
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        has_fori = any((dotted(c.func) or "").endswith("fori_loop")
+                       for c in _calls(node))
+        times = any((dotted(c.func) or "").endswith("perf_counter")
+                    for c in _calls(node))
+        if has_fori and times:
+            out.append(Violation(
+                "unharnessed-timed-fori", path, node.lineno,
+                f"{node.name}() times a hand-rolled lax.fori_loop — the "
+                "timed-fori discipline lives in engine/probes.timed_fori "
+                "(runtime liveness proof included); route the measurement "
+                "through the harness instead of re-copying it"))
+    return out
+
+
+register(Rule(
+    name="unharnessed-timed-fori",
+    doc="bench/profile scripts time fori programs only through "
+        "engine/probes.timed_fori (the liveness-proven harness)",
+    targets=("bench.py", "scripts/profile_*.py", "scripts/bench_*.py",
+             "scripts/smoke_*.py"),
+    check=_check_unharnessed_fori,
 ))
 
 

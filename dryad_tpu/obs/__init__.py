@@ -15,6 +15,12 @@ The compiled-program cost/memory capture that FEEDS ``dryad_prog_*``
 lives OUTSIDE this package (engine/introspect.py): it touches jax, and
 obs collectors only record values the engine already fetched.
 
+r13 adds the stage-profiler aggregation (``dryad_stage_ms`` gauges +
+stamped ``PROFILE_r*.json`` artifacts — profiler.py; the timed-fori
+harness that MEASURES them is engine/probes.py, outside this package
+for the same jax-freedom reason) and Chrome trace_event export of the
+span ring / journal / stage walls (trace_export.py, ``GET /trace``).
+
 Hard contracts (see registry.py / scripts/ci.sh):
 
 * host-side only — nothing here may touch jax or fetch from a device;
@@ -37,6 +43,12 @@ from dryad_tpu.obs.registry import (
     set_default_registry,
 )
 from dryad_tpu.obs.spans import record, span
+from dryad_tpu.obs.trace_export import (
+    SpanTrace,
+    default_trace,
+    disable_tracing,
+    enable_tracing,
+)
 from dryad_tpu.obs.tripwire import RecompileTripwire, default_tripwire
 from dryad_tpu.obs.watchdog import (
     FetchWatchdog,
@@ -63,4 +75,8 @@ __all__ = [
     "watch_fetch",
     "RecompileTripwire",
     "default_tripwire",
+    "SpanTrace",
+    "enable_tracing",
+    "disable_tracing",
+    "default_trace",
 ]

@@ -6,6 +6,10 @@ which mounts the same handlers next to /predict — serve/http.py):
     GET /metrics   Prometheus text exposition (registry.exposition())
     GET /stats     JSON: uptime, span summary, counters/gauges/histograms
                    (+ any extra_stats providers merged in)
+    GET /trace     Chrome trace_event JSON of the live span ring
+                   (obs/trace_export.py; empty traceEvents until
+                   ``enable_tracing()`` installs the sink — the train
+                   CLI's ``--trace-out`` does, as can any caller)
     GET /healthz   200 {"ok": true} while the process health state is
                    clean, 503 {"ok": false, "degraded": [...]} while any
                    subsystem holds a degradation (fetch stall, unexpected
@@ -81,6 +85,13 @@ class _Handler(BaseHTTPRequestHandler):
         if self.path == "/metrics":
             self._send(200, reg.exposition().encode(),
                        "text/plain; version=0.0.4; charset=utf-8")
+        elif self.path == "/trace":
+            from dryad_tpu.obs import trace_export
+
+            buf = trace_export.default_trace()
+            body = trace_export.dumps_trace(
+                span_events=buf.events() if buf is not None else ())
+            self._send(200, body.encode(), "application/json")
         elif self.path == "/stats":
             self._send(200, json.dumps(stats_payload(
                 reg, self.server.started_at,

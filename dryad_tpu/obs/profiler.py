@@ -1,0 +1,83 @@
+"""Stage-profile aggregation: gauges + stamped PROFILE artifacts.
+
+The measurement itself lives in the engine (``engine/probes.py`` — it
+touches jax); this module only aggregates the plain result dicts the
+harness already fetched, per the obs package contract (jax-free by lint,
+host-side only):
+
+* ``export_stages`` folds results into ``dryad_stage_ms{stage=,arm=}``
+  and ``dryad_stage_spread{stage=,arm=}`` gauges so per-stage device
+  walls ride the same ``/metrics`` scrape as everything else;
+* ``profile_artifact`` flattens results into the stamped
+  ``PROFILE_r*.json`` shape (``stage_ms_<name>`` / ``stage_spread_<name>``
+  + the r12 schema/git/device stamps) that ``obs/trends.py`` ingests —
+  per-stage regressions get the same newest-vs-median + spread-veto
+  verdicts as bench walls.
+
+A result dict needs ``stage`` and ``ms``; ``spread``, ``rows`` and
+``arm`` (bench's wired/legacy pairs) are optional.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Optional, Sequence
+
+from dryad_tpu.obs.registry import Registry, default_registry
+from dryad_tpu.obs.trends import artifact_stamp
+
+STAGE_MS = "dryad_stage_ms"
+STAGE_SPREAD = "dryad_stage_spread"
+
+
+def _stage_key(result: dict) -> str:
+    arm = result.get("arm")
+    return f"{result['stage']}_{arm}" if arm else str(result["stage"])
+
+
+def export_stages(results: Sequence[dict],
+                  registry: Optional[Registry] = None) -> int:
+    """Set one ms + one spread gauge per result; returns series touched."""
+    reg = registry if registry is not None else default_registry()
+    if not reg.enabled:
+        return 0
+    ms_fam = reg.gauge(STAGE_MS,
+                       "Per-stage device wall (timed-fori min) in ms")
+    sp_fam = reg.gauge(STAGE_SPREAD,
+                       "Per-stage capture spread (max/min - 1)")
+    n = 0
+    for r in results:
+        labels = {"stage": str(r["stage"])}
+        if r.get("arm"):
+            labels["arm"] = str(r["arm"])
+        ms_fam.labels(**labels).set(float(r["ms"]))
+        sp_fam.labels(**labels).set(float(r.get("spread", 0.0)))
+        n += 1
+    return n
+
+
+def profile_artifact(results: Sequence[dict],
+                     device_kind: Optional[str] = None,
+                     root: Optional[str] = None) -> dict:
+    """The flat stamped artifact dict (one ``stage_ms_*`` +
+    ``stage_spread_*`` pair per stage, context fields untracked)."""
+    out: dict = {"profile_schema": 1}
+    for r in results:
+        key = _stage_key(r)
+        out[f"stage_ms_{key}"] = float(r["ms"])
+        out[f"stage_spread_{key}"] = float(r.get("spread", 0.0))
+        if r.get("rows") is not None:
+            out[f"stage_rows_{key}"] = int(r["rows"])
+    out.update(artifact_stamp(device_kind=device_kind, root=root))
+    return out
+
+
+def write_profile(results: Sequence[dict], path: str,
+                  device_kind: Optional[str] = None,
+                  root: Optional[str] = None) -> dict:
+    """Write the stamped artifact to ``path``; returns the dict."""
+    art = profile_artifact(results, device_kind=device_kind, root=root)
+    with open(path, "w") as f:
+        json.dump(art, f, indent=1)
+        f.write("\n")
+    return art

@@ -24,6 +24,12 @@ enabled check — both allocation-free (test-pinned with tracemalloc).
 ``record(name, seconds)`` feeds the same series without a ``with`` block,
 for loop bodies where a context manager would force a reindent across
 ``break`` edges (both trainers use it for their per-iteration series).
+
+r13: an optional TRACE SINK (``set_trace_sink``) receives every completed
+span as ``(path, t0_s, dur_s)`` — ``obs/trace_export.py`` installs a ring
+buffer there and renders Chrome trace_event JSON from it.  The sink fires
+only on the registry-enabled path (the disabled fast path is untouched)
+and a sink exception never propagates into the instrumented caller.
 """
 
 from __future__ import annotations
@@ -38,6 +44,17 @@ SECONDS = "dryad_span_seconds_total"
 COUNT = "dryad_span_count_total"
 
 _TLS = threading.local()
+
+#: trace sink: None, or a callable(path, t0_s, dur_s) — see module doc
+_TRACE_SINK = None
+
+
+def set_trace_sink(sink) -> None:
+    """Install (or clear, with ``None``) the span trace sink.  The sink
+    must be cheap and non-raising; trace_export.SpanTrace.record is the
+    intended one."""
+    global _TRACE_SINK
+    _TRACE_SINK = sink
 
 
 class _NullSpan:
@@ -88,6 +105,12 @@ class _Span:
         if stack and stack[-1] is self:
             stack.pop()
         _emit(self._reg, self.path, dt)
+        sink = _TRACE_SINK
+        if sink is not None:
+            try:
+                sink(self.path, self._t0, dt)
+            except Exception:   # noqa: BLE001 — tracing must never break
+                pass            # the instrumented caller
         return False
 
 
@@ -109,6 +132,13 @@ def record(name: str, seconds: float,
     if not reg.enabled:
         return
     _emit(reg, name, seconds)
+    sink = _TRACE_SINK
+    if sink is not None:
+        try:
+            # the stage just ENDED; back-date its start by its duration
+            sink(name, time.perf_counter() - seconds, seconds)
+        except Exception:   # noqa: BLE001 — tracing must never break callers
+            pass
 
 
 def snapshot(registry: Optional[Registry] = None) -> dict:

@@ -1,0 +1,162 @@
+"""Chrome trace_event export: spans + journal + stage walls, Perfetto-ready.
+
+The obs stack already holds three time-shaped truths — the span tree
+(per-stage host walls, nested), the supervised-run journal (faults,
+backoffs, resumes with run-relative timestamps) and the stage profiler's
+device walls (``engine/probes`` results) — but until r13 none of them
+rendered as a timeline.  This module emits the Chrome ``trace_event``
+JSON format (the ``{"traceEvents": [...]}`` object form), loadable in
+Perfetto / ``chrome://tracing``:
+
+* **spans** — complete events (``ph: "X"``) under pid 1, one track per
+  thread, captured live by ``SpanTrace`` (a bounded ring buffer installed
+  as the span trace sink — ``enable_tracing()``).  Nesting is preserved
+  by construction: a child span's [ts, ts+dur] interval lies inside its
+  parent's on the same tid, and longer events sort first at equal ts so
+  viewers stack them correctly.
+* **journal events** — instant events (``ph: "i"``) under pid 2.  Their
+  clock is the journal's own run-relative ``elapsed_s``, so they live on
+  a separate process track rather than pretending to share the span
+  clock.
+* **stage walls** — complete events under pid 3, laid out back to back.
+  Probe walls are per-stage MINIMA from the timed-fori harness, not a
+  recorded timeline; the sequential layout just makes their relative
+  magnitudes visible next to the host spans.
+
+Consumers: ``GET /trace`` on the metrics exporter and ``--trace-out`` on
+the train CLI.  Pure stdlib — the obs package is jax-free by lint.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from collections import deque
+from typing import Optional, Sequence
+
+from dryad_tpu.obs import spans
+
+#: ring capacity: ~64k spans ≈ hours of chunked training at obs cadence
+DEFAULT_CAPACITY = 65536
+
+
+class SpanTrace:
+    """Bounded thread-safe ring of completed spans ``(path, t0_s, dur_s,
+    tid)`` — the span trace sink (spans.set_trace_sink)."""
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY):
+        self._events: deque = deque(maxlen=int(capacity))
+        self._lock = threading.Lock()
+        self.dropped = 0
+
+    def record(self, path: str, t0_s: float, dur_s: float) -> None:
+        tid = threading.get_ident() & 0xFFFF
+        with self._lock:
+            if len(self._events) == self._events.maxlen:
+                self.dropped += 1
+            self._events.append((path, t0_s, dur_s, tid))
+
+    def events(self) -> list:
+        with self._lock:
+            return list(self._events)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._events.clear()
+            self.dropped = 0
+
+
+_default: Optional[SpanTrace] = None
+_default_lock = threading.Lock()
+
+
+def enable_tracing(capacity: int = DEFAULT_CAPACITY) -> SpanTrace:
+    """Install (idempotently) the process-default SpanTrace as the span
+    sink and return it.  Spans record into it only while the registry is
+    enabled (the zero-cost-disabled contract is untouched).  The ring is
+    process-wide and NOT cleared here (a live /trace endpoint may still
+    be serving it); a caller scoping a trace to one run clears the
+    returned buffer itself — the train CLI's --trace-out does.  A
+    ``capacity`` different from the existing default ring's is ignored."""
+    global _default
+    with _default_lock:
+        if _default is None:
+            _default = SpanTrace(capacity)
+    spans.set_trace_sink(_default.record)
+    return _default
+
+
+def disable_tracing() -> None:
+    spans.set_trace_sink(None)
+
+
+def default_trace() -> Optional[SpanTrace]:
+    return _default
+
+
+def to_trace_events(span_events: Sequence = (),
+                    journal_events: Sequence[dict] = (),
+                    stages: Sequence[dict] = ()) -> list:
+    """One flat, ts-sorted trace_event list from the three sources.
+    Timestamps are microseconds; span ts keep their perf_counter origin
+    (arbitrary but shared), journal ts are run-relative (own pid)."""
+    meta = [
+        {"ph": "M", "pid": 1, "tid": 0, "name": "process_name",
+         "args": {"name": "dryad spans (host walls)"}},
+        {"ph": "M", "pid": 2, "tid": 0, "name": "process_name",
+         "args": {"name": "dryad journal (run-relative)"}},
+        {"ph": "M", "pid": 3, "tid": 0, "name": "process_name",
+         "args": {"name": "dryad stage walls (timed-fori minima)"}},
+    ]
+    evs = []
+    for path, t0, dur, tid in span_events:
+        evs.append({
+            "ph": "X", "cat": "span", "pid": 1, "tid": int(tid),
+            "name": str(path).rsplit("/", 1)[-1],
+            "ts": round(float(t0) * 1e6, 3),
+            "dur": round(float(dur) * 1e6, 3),
+            "args": {"path": str(path)},
+        })
+    for e in journal_events:
+        args = {k: v for k, v in e.items()
+                if k not in ("event", "elapsed_s")
+                and isinstance(v, (str, int, float, bool))}
+        evs.append({
+            "ph": "i", "cat": "journal", "pid": 2, "tid": 0, "s": "p",
+            "name": str(e.get("event", "event")),
+            "ts": round(float(e.get("elapsed_s", 0.0)) * 1e6, 3),
+            "args": args,
+        })
+    cursor = 0.0
+    for st in stages:
+        name = str(st.get("stage", "stage"))
+        if st.get("arm"):
+            name = f"{name}[{st['arm']}]"
+        dur = max(float(st.get("ms", 0.0)) * 1e3, 0.0)
+        args = {k: v for k, v in st.items()
+                if isinstance(v, (str, int, float, bool))}
+        evs.append({"ph": "X", "cat": "stage", "pid": 3, "tid": 0,
+                    "name": name, "ts": round(cursor, 3),
+                    "dur": round(dur, 3), "args": args})
+        cursor += dur
+    # monotonic ts; longer events first at equal ts so nesting stacks
+    evs.sort(key=lambda e: (e["ts"], -e.get("dur", 0.0)))
+    return meta + evs
+
+
+def dumps_trace(span_events: Sequence = (),
+                journal_events: Sequence[dict] = (),
+                stages: Sequence[dict] = ()) -> str:
+    """The loadable JSON document (object form, ms display unit)."""
+    return json.dumps({
+        "traceEvents": to_trace_events(span_events, journal_events, stages),
+        "displayTimeUnit": "ms",
+    })
+
+
+def write_trace(path: str, span_events: Sequence = (),
+                journal_events: Sequence[dict] = (),
+                stages: Sequence[dict] = ()) -> None:
+    with open(path, "w") as f:
+        f.write(dumps_trace(span_events, journal_events, stages))
+        f.write("\n")

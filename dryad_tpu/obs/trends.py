@@ -47,6 +47,14 @@ DEFAULT_TOLERANCE = 0.15
 #: current bench artifact schema (the r12 stamping satellite)
 SCHEMA_VERSION = 1
 
+#: the r13 stage-profiler artifacts (obs/profiler.py writes them); every
+#: ``stage_ms_<name>`` field is lower-better with its spread riding in
+#: the sibling ``stage_spread_<name>`` — prefix rules, so new stage
+#: probes are trend-tracked with no table edit here
+PROFILE_PATTERN = "PROFILE_r*.json"
+STAGE_MS_PREFIX = "stage_ms_"
+STAGE_SPREAD_PREFIX = "stage_spread_"
+
 #: metric direction tables — anything in neither set is context, not a
 #: tracked metric (row counts, spreads, tree counts, the stamps)
 HIGHER_BETTER = frozenset({
@@ -81,13 +89,32 @@ _SPREAD_FIELDS = {
 _ROUND_RE = re.compile(r"_r0*(\d+)\.json$")
 
 
+def _direction(name: str) -> Optional[str]:
+    """Tracked-metric direction, or None for context fields.  Exact
+    tables first, then the stage-profiler prefix rule."""
+    if name in HIGHER_BETTER:
+        return "higher_better"
+    if name in LOWER_BETTER or name.startswith(STAGE_MS_PREFIX):
+        return "lower_better"
+    return None
+
+
+def _spread_fields_of(name: str) -> tuple:
+    """The newest point's spread fields vouching for ``name``."""
+    if name.startswith(STAGE_MS_PREFIX):
+        return (STAGE_SPREAD_PREFIX + name[len(STAGE_MS_PREFIX):],)
+    return _SPREAD_FIELDS.get(name, ())
+
+
 def _extract_metrics(doc: dict) -> Optional[dict]:
     """The flat numeric-metrics dict out of one artifact, whatever its
     vintage: the driver wrapper carries ``parsed``; a bare bench.py line
-    saved directly IS the dict (it has ``metric``/``bench``)."""
+    saved directly IS the dict (it has ``metric``/``bench``); a profile
+    artifact carries ``profile_schema`` even when its stamp failed."""
     if isinstance(doc.get("parsed"), dict):
         return doc["parsed"]
-    if "metric" in doc or "bench" in doc or "schema_version" in doc:
+    if ("metric" in doc or "bench" in doc or "schema_version" in doc
+            or "profile_schema" in doc):
         return doc
     return None
 
@@ -146,11 +173,8 @@ def compare(history: Sequence[dict],
     report: dict = {"ok": True, "n_points": len(history),
                     "newest": newest["path"], "metrics": {}}
     for name, value in sorted(newest["metrics"].items()):
-        if name in HIGHER_BETTER:
-            direction = "higher_better"
-        elif name in LOWER_BETTER:
-            direction = "lower_better"
-        else:
+        direction = _direction(name)
+        if direction is None:
             continue
         hist_vals = [p["metrics"][name] for p in prior
                      if name in p["metrics"]]
@@ -166,7 +190,7 @@ def compare(history: Sequence[dict],
         entry["rel_delta"] = round(rel, 4)
         worse = -rel if direction == "higher_better" else rel
         spread = max((newest["metrics"].get(f, 0.0)
-                      for f in _SPREAD_FIELDS.get(name, ())), default=0.0)
+                      for f in _spread_fields_of(name)), default=0.0)
         entry["spread"] = spread
         if worse > tolerance:
             if spread > SPREAD_SUSPECT:
@@ -199,7 +223,7 @@ def ingest(history: Sequence[dict],
     for point in history:
         rnd = point["round"] if point["round"] is not None else -1
         for name, value in point["metrics"].items():
-            if name in HIGHER_BETTER or name in LOWER_BETTER:
+            if _direction(name) is not None:
                 fam.labels(metric=name, round=rnd).set(float(value))
                 n += 1
     reg.gauge("dryad_bench_rounds",
@@ -231,8 +255,9 @@ def artifact_stamp(device_kind: Optional[str] = None,
 
 def stats_provider(root: str = ".", tolerance: float = DEFAULT_TOLERANCE):
     """An ``extra_stats`` provider for the /stats endpoint: loads the
-    committed history once (it is static for the life of a run) and
-    serves the regression report under ``bench_trends``."""
+    committed histories once (static for the life of a run) and serves
+    the regression reports under ``bench_trends`` (always) and
+    ``profile_trends`` (when any ``PROFILE_r*.json`` exists)."""
     cache: dict = {}
 
     def provide() -> dict:
@@ -240,6 +265,11 @@ def stats_provider(root: str = ".", tolerance: float = DEFAULT_TOLERANCE):
             history = load_history(root)
             cache["report"] = compare(history, tolerance) if history else {
                 "ok": True, "n_points": 0, "newest": None, "metrics": {}}
-        return {"bench_trends": cache["report"]}
+            prof = load_history(root, pattern=PROFILE_PATTERN)
+            cache["profile"] = compare(prof, tolerance) if prof else None
+        out = {"bench_trends": cache["report"]}
+        if cache["profile"] is not None:
+            out["profile_trends"] = cache["profile"]
+        return out
 
     return provide

@@ -47,6 +47,19 @@ if ! PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}" \
 fi
 tail -1 /tmp/_trend_self.log
 
+# Stage-profiler selftest (r13): the timed-fori harness's runtime
+# liveness proof must FIRE on the seeded dead-perturbation probe (the
+# r5/r10 2x-fast class the AST lint cannot fully catch) and PASS on
+# every shipped stage probe — CPU, seconds.
+if ! env JAX_PLATFORMS=cpu \
+    PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}" \
+    python -m dryad_tpu profile --selftest --quiet > /tmp/_profile_self.log 2>&1; then
+  echo "PROFILE SELFTEST FAIL: python -m dryad_tpu profile --selftest (see /tmp/_profile_self.log)" >&2
+  tail -5 /tmp/_profile_self.log >&2
+  exit 1
+fi
+tail -1 /tmp/_profile_self.log
+
 # Observability smoke (r9; r12 adds the device-truth families): the CLI's
 # live metrics endpoint — train 5 trees through the DEVICE trainer with
 # --metrics-port, scrape /healthz + /stats + /metrics while the run is

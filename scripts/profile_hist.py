@@ -2,67 +2,112 @@
 
 Times each device stage of the levelwise grower in isolation on the
 Higgs-200k shape (N=200k, F=28, B=256): single-leaf histogram, per-level
-segmented histogram (P=128), split scan, argsort, predict traversal.
+segmented histogram (P=128), split scan, argsort, predict-shaped sort.
+
+r13: rides the canonical harness (engine/probes.timed_fori — K dependent
+iterations in ONE jit, carried whole-unit perturbation, terminal real
+fetch, runtime liveness proof), replacing the r2-era per-call walls this
+script carried under ``no-block-until-ready`` waivers.  Arrays ride as
+jit ARGUMENTS (the HTTP-413 closure rule).
+
+Usage: PYTHONPATH=/root/.axon_site:/root/repo python scripts/profile_hist.py [rows]
 """
-# dryadlint: disable-file=no-block-until-ready -- r2-era stage probe; per-call walls recorded in BENCH_r01/r02, superseded by the timed-fori doctrine (bench._timed_fori)
-# dryadlint: disable-file=jit-closure-constant -- r2-era probe: 200k-shape closures stay well under the ~tens-of-MB HTTP-413 limit; kept verbatim for provenance
 from __future__ import annotations
 
-import time
+import sys
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 import dryad_tpu as dryad
 from dryad_tpu.datasets import higgs_like
-from dryad_tpu.engine.histogram import build_hist, build_hist_multi, build_hist_segmented
+from dryad_tpu.engine.histogram import (
+    build_hist,
+    build_hist_multi,
+    build_hist_segmented,
+)
+from dryad_tpu.engine.probes import timed_fori
 from dryad_tpu.engine.split import find_best_split
 
 
-def timeit(fn, *args, n=5, **kw):
-    out = fn(*args, **kw)
-    jax.block_until_ready(out)
-    t0 = time.perf_counter()
-    for _ in range(n):
-        out = fn(*args, **kw)
-    jax.block_until_ready(out)
-    return (time.perf_counter() - t0) / n
-
-
 def main():
-    N, F, B = 200_000, 28, 256
+    N = int(sys.argv[1]) if len(sys.argv) > 1 else 200_000
+    F, B, P = 28, 256, 128
+    K, reps = 3, 2
     X, y = higgs_like(N, seed=7)
     ds = dryad.Dataset(X, y, max_bins=B)
     Xb = jnp.asarray(ds.X_binned)
     key = jax.random.PRNGKey(0)
     g = jax.random.normal(key, (N,), jnp.float32)
     h = jnp.abs(g) + 0.1
-    mask = jnp.ones((N,), bool)
-    sel128 = jax.random.randint(jax.random.PRNGKey(1), (N,), 0, 128).astype(jnp.int32)
+    mask = jax.random.uniform(jax.random.PRNGKey(2), (N,)) < 0.8
+    sel = jax.random.randint(jax.random.PRNGKey(1), (N,), 0, P).astype(
+        jnp.int32)
+    print(f"devices: {jax.devices()}  rows={N}")
 
-    f_single = jax.jit(lambda m: build_hist(Xb, g, h, m, B))
-    f_single_fast = jax.jit(lambda m: build_hist(Xb, g, h, m, B, precision="fast"))
-    f_seg = jax.jit(lambda s: build_hist_segmented(Xb, g, h, s, 128, B))
-    f_seg_fast = jax.jit(lambda s: build_hist_segmented(Xb, g, h, s, 128, B, precision="fast"))
-    f_multi = jax.jit(lambda s: build_hist_multi(Xb, g, h, s, 16, B))
-    f_sort = jax.jit(lambda s: jnp.argsort(s, stable=True))
-    hist = f_single(mask)
+    def show(tag, step, *args):
+        ms, spread = timed_fori(step, K, reps, *args, label=tag)
+        flag = "  SUSPECT" if spread > 0.05 else ""
+        print(f"{tag:28s} {ms:8.2f} ms  spread {spread:.3f}{flag}")
 
-    f_split = jax.jit(lambda hh: find_best_split(
-        hh, hh[0].sum(), hh[1].sum(), hh[2].sum(),
-        lambda_l2=1.0, min_child_weight=1e-3, min_data_in_leaf=20,
-        min_split_gain=0.0, feat_mask=jnp.ones((F,), bool),
-        is_cat_feat=jnp.zeros((F,), bool), allow=jnp.bool_(True), has_cat=False))
+    # single-leaf masked histogram — roll the MASK by the carried scalar
+    def single(precision):
+        def step(s, Xb, g, h, mask):
+            si = s.astype(jnp.int32)
+            hist = build_hist(Xb, g, h, jnp.roll(mask, si), B,
+                              precision=precision, backend="auto")
+            # plane sum: a single bin can be empty in binned Higgs data
+            return s + 1.0, hist[0].sum()
+        return step
 
-    print(f"devices: {jax.devices()}")
-    print(f"single-leaf hist (exact):    {timeit(f_single, mask)*1e3:8.2f} ms")
-    print(f"single-leaf hist (fast):     {timeit(f_single_fast, mask)*1e3:8.2f} ms")
-    print(f"segmented P=128 (exact):     {timeit(f_seg, sel128)*1e3:8.2f} ms")
-    print(f"segmented P=128 (fast):      {timeit(f_seg_fast, sel128)*1e3:8.2f} ms")
-    print(f"multi dense P=16 (exact):    {timeit(f_multi, sel128 % 16)*1e3:8.2f} ms")
-    print(f"argsort 200k:                {timeit(f_sort, sel128)*1e3:8.2f} ms")
-    print(f"split scan (full tree hist): {timeit(f_split, hist)*1e3:8.2f} ms")
+    show("single-leaf hist (exact)", single("exact"), Xb, g, h, mask)
+    show("single-leaf hist (fast)", single("fast"), Xb, g, h, mask)
+
+    # segmented P=128 — rotate the SORT KEY (slot ids), selection fixed
+    def seg(precision):
+        def step(s, Xb, g, h, sel):
+            si = s.astype(jnp.int32)
+            hist = build_hist_segmented(Xb, g, h, (sel + si) % P, P, B,
+                                        precision=precision, backend="auto")
+            return s + 1.0, hist[0, 0].sum()
+        return step
+
+    show("segmented P=128 (exact)", seg("exact"), Xb, g, h, sel)
+    show("segmented P=128 (fast)", seg("fast"), Xb, g, h, sel)
+
+    # dense multi P=16
+    def multi_step(s, Xb, g, h, sel):
+        si = s.astype(jnp.int32)
+        hist = build_hist_multi(Xb, g, h, (sel + si) % 16, 16, B)
+        return s + 1.0, hist[0, 0].sum()
+
+    show("multi dense P=16 (exact)", multi_step, Xb, g, h, sel)
+
+    # the stable argsort a legacy level pays — rotated sort key
+    def sort_step(s, sel):
+        si = s.astype(jnp.int32)
+        srt = jnp.argsort((sel + si) % P, stable=True)
+        return s + 1.0, srt[0].astype(jnp.float32) + srt[-1].astype(
+            jnp.float32)
+
+    show("stable argsort (N,)", sort_step, sel)
+
+    # split scan over the full-tree histogram
+    hist0 = build_hist(Xb, g, h, mask, B, backend="auto")
+    fmask = jnp.ones((F,), bool)
+    iscat = jnp.zeros((F,), bool)
+
+    def split_step(s, hh, fmask, iscat):
+        smod = s - jnp.floor(s / 8.0) * 8.0
+        hh2 = hh * (1.0 + 0.01 * smod)
+        res = find_best_split(
+            hh2, hh2[0].sum(), hh2[1].sum(), hh2[2].sum(),
+            lambda_l2=1.0, min_child_weight=1e-3, min_data_in_leaf=20,
+            min_split_gain=0.0, feat_mask=fmask, is_cat_feat=iscat,
+            allow=jnp.bool_(True), has_cat=False)
+        return s + 1.0, res.gain
+
+    show("split scan (tree hist)", split_step, hist0, fmask, iscat)
 
 
 if __name__ == "__main__":

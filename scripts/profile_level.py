@@ -1,14 +1,20 @@
 """Per-level cost breakdown of the depthwise grower at scale.
 
-Times the pieces a deep level pays (segmented histogram + its tile-plan
-sort, row partition gathers, vmapped split finder) with the fori-loop
-methodology, to locate the non-kernel tail (CLAUDE.md open item).
+Times the pieces a LEGACY deep level pays (segmented histogram + its
+tile-plan sort, row partition gathers, vmapped split finder, the hists
+scatter) to locate the non-kernel tail.  r13: every stage rides the
+canonical harness (engine/probes.timed_fori), which liveness-proves each
+perturbation at runtime — the old hand-rolled loop here consumed its
+scalar through ``(s * 1e-30).astype(int32)`` in two stages, i.e. a DEAD
+input the harness now rejects (exactly the 2x-too-fast class CLAUDE.md
+records for r5/r10).  Arrays ride as jit ARGUMENTS.
 
-Usage: PYTHONPATH=... python scripts/profile_level.py [rows] [P]
+Usage: PYTHONPATH=... python scripts/profile_level.py [rows] [P] [reps]
 """
 
+from __future__ import annotations
+
 import sys
-import time
 
 import jax
 import jax.numpy as jnp
@@ -16,6 +22,7 @@ import numpy as np
 
 from dryad_tpu.config import make_params
 from dryad_tpu.engine.histogram import build_hist_segmented
+from dryad_tpu.engine.probes import timed_fori
 from dryad_tpu.engine.split import find_best_split
 
 
@@ -32,73 +39,100 @@ def main():
     g = jnp.asarray(rng.normal(size=N).astype(np.float32))
     h = jnp.asarray(rng.uniform(0.1, 1.0, size=N).astype(np.float32))
     row_slot = jnp.asarray(rng.integers(0, L, size=N).astype(np.int32))
-    sel = jnp.asarray(rng.integers(0, 2 * P, size=N).astype(np.int32))
-    sel = jnp.where(sel < P, sel, P)  # half the rows selected
+    sel_np = rng.integers(0, 2 * P, size=N).astype(np.int32)
+    sel_np = np.where(sel_np < P, sel_np, P)  # ~half the rows selected
+    # rows_bound must be MATHEMATICALLY guaranteed (tile_plan contract —
+    # rows beyond it drop silently): a binomial ~N/2 draw exceeds N//2+1
+    # about half the time, so the bound is the EXACT draw count (the
+    # rotation perturbation never changes the selected SET)
+    bound = int((sel_np < P).sum())
+    sel = jnp.asarray(sel_np)
     p = make_params(dict(objective="binary", num_leaves=L, max_depth=8,
                          growth="depthwise"))
 
-    def loop_time(step, *arrays):
-        f = jax.jit(lambda s0, *a: jax.lax.fori_loop(
-            0, K, lambda i, s: step(s, *a), s0))
-        _ = float(f(jnp.float32(0.0), *arrays))
-        t0 = time.perf_counter()
-        _ = float(f(jnp.float32(0.0), *arrays))
-        return (time.perf_counter() - t0) / K
+    def show(tag, step, *args):
+        ms, spread = timed_fori(step, K, 2, *args, label=tag)
+        flag = "  SUSPECT" if spread > 0.05 else ""
+        print(f"{tag:28s} {ms:9.1f} ms  spread {spread:.3f}{flag}")
 
     # segmented histogram (the per-level kernel call, incl. its tile plan)
-    t = loop_time(lambda s, X, gg, hh, ss: build_hist_segmented(
-        X, gg + s, hh, ss, P, B, rows_per_chunk=p.rows_per_chunk,
-        platform=plat, rows_bound=N // 2 + 1)[0, 0, 0, 0] * 1e-30,
-        Xb, g, h, sel)
-    print(f"seg hist P={P} (bound N/2): {t*1e3:9.1f} ms")
+    # — perturb the SORT KEY (rotate slot ids; the selected set is fixed)
+    def seg_step(s, Xb, g, h, sel):
+        si = s.astype(jnp.int32)
+        sel2 = jnp.where(sel < P, (sel + si) % P, P)
+        hist = build_hist_segmented(Xb, g, h, sel2, P, B,
+                                    rows_per_chunk=p.rows_per_chunk,
+                                    platform=plat, rows_bound=bound)
+        # slot-0 plane sum (bins here start at 1 — a bin-0 contrib is
+        # constant zero and the harness rejects it as dead)
+        return s + 1.0, hist[0, 0].sum()
 
-    # the tile-plan's stable sort alone
-    t = loop_time(lambda s, ss: jnp.argsort(
-        ss + (s * 1e-30).astype(jnp.int32), stable=True)[0].astype(jnp.float32)
-        * 1e-30, sel)
-    print(f"stable argsort (N,):       {t*1e3:9.1f} ms")
+    show(f"seg hist P={P} (exact bound)", seg_step, Xb, g, h, sel)
 
-    # row partition gathers (one level's worth)
-    def part(s, X, rs):
-        rf = jnp.maximum(rs % F, 0)
+    # the tile-plan's stable sort alone — rotated sort key (the old
+    # (s*1e-30).astype(int32) perturbation was dead; harness-rejected now)
+    def sort_step(s, sel):
+        si = s.astype(jnp.int32)
+        srt = jnp.argsort(jnp.where(sel < P, (sel + si) % P, P),
+                          stable=True)
+        return s + 1.0, (srt[0] + srt[N // 2]).astype(jnp.float32)
+
+    show("stable argsort (N,)", sort_step, sel)
+
+    # row partition gathers (one level's worth) — the gather COLUMN
+    # rotates with the carried scalar, so the gather stays in the loop
+    def part_step(s, Xb, rs):
+        si = s.astype(jnp.int32)
+        rf = (jnp.maximum(rs % F, 0) + si) % F
         bins_rf = jnp.take_along_axis(
-            X, rf[:, None].astype(jnp.int32), axis=1)[:, 0].astype(jnp.int32)
-        go_left = bins_rf <= (rs + s.astype(jnp.int32))
+            Xb, rf[:, None].astype(jnp.int32), axis=1)[:, 0].astype(
+            jnp.int32)
+        go_left = bins_rf <= rs
         new_slot = jnp.where(go_left, rs, rs + 1)
-        return new_slot[0].astype(jnp.float32) * 1e-30
-    t = loop_time(part, Xb, row_slot)
-    print(f"partition gathers:         {t*1e3:9.1f} ms")
+        # full-N sum: two sampled rows can both be column-insensitive
+        # (bins <= slot for every feature) and read as dead
+        return s + 1.0, jnp.sum(new_slot.astype(jnp.float32))
 
-    # vmapped split finder over 2P children
-    hists = jnp.asarray(rng.normal(size=(2 * P, 3, F, B)).astype(np.float32))
+    show("partition gathers", part_step, Xb, row_slot)
+
+    # vmapped split finder over 2P children — gains are scale-sensitive
+    hists = jnp.asarray(
+        np.stack([rng.normal(size=(2 * P, F, B)),
+                  rng.uniform(0.1, 1.0, size=(2 * P, F, B)),
+                  rng.uniform(0.5, 2.0, size=(2 * P, F, B))],
+                 axis=1).astype(np.float32))
     fmask = jnp.ones((F,), bool)
     iscat = jnp.zeros((F,), bool)
-
-    def best(hist, G, H, C, allow):
-        return find_best_split(
-            hist, G, H, C, lambda_l2=1.0, min_child_weight=1e-3,
-            min_data_in_leaf=20, min_split_gain=0.0, feat_mask=fmask,
-            is_cat_feat=iscat, allow=allow, has_cat=False)
-    GHC = jnp.abs(hists[:, :3, :, :].sum(axis=(2, 3)))
     allow = jnp.ones((2 * P,), bool)
 
-    def split_step(s, hh):
-        res = jax.vmap(best, in_axes=(0, 0, 0, 0, 0))(
-            hh + s, GHC[:, 0], GHC[:, 1], GHC[:, 2], allow)
-        return res.gain[0] * 1e-30
-    t = loop_time(split_step, hists)
-    print(f"vmap split finder 2P:      {t*1e3:9.1f} ms")
+    def split_step(s, hh, fmask, iscat, allow):
+        smod = s - jnp.floor(s / 8.0) * 8.0
+        hh2 = hh * (1.0 + 0.01 * smod)
+        G = hh2[:, 0].sum(axis=(1, 2))
+        H = hh2[:, 1].sum(axis=(1, 2))
+        C = hh2[:, 2].sum(axis=(1, 2))
+
+        def best(hh_, G_, H_, C_, a_):
+            return find_best_split(
+                hh_, G_, H_, C_, lambda_l2=1.0, min_child_weight=1e-3,
+                min_data_in_leaf=20, min_split_gain=0.0, feat_mask=fmask,
+                is_cat_feat=iscat, allow=a_, has_cat=False)
+
+        res = jax.vmap(best)(hh2, G, H, C, allow)
+        return s + 1.0, res.gain[0] + res.gain[-1]
+
+    show("vmap split finder 2P", split_step, hists, fmask, iscat, allow)
 
     # hists scatter update (two (L,3,F,B) .at[].set per level)
     big = jnp.zeros((L, 3, F, B), jnp.float32)
     idx = jnp.arange(P, dtype=jnp.int32)
 
-    def scat(s, bg, hh):
+    def scat_step(s, bg, hh, idx):
         bg = bg.at[idx].set(hh[:P] + s)
         bg = bg.at[idx + P].set(hh[P:])
-        return bg[0, 0, 0, 0] * 1e-30
-    t = loop_time(scat, big, hists)
-    print(f"hists scatter 2x(L,...):   {t*1e3:9.1f} ms")
+        return s + 1.0, bg[0, 0, 0, 0]
+
+    show("hists scatter 2x(L,...)", scat_step, big, hists, idx)
 
 
 if __name__ == "__main__":

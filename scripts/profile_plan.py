@@ -1,27 +1,32 @@
 """Fine-grained stage breakdown of the segmented histogram pipeline at 10M.
 
-profile_level.py showed the whole build_hist_segmented call at ~675 ms with
-the Pallas kernel only ~107 ms of it — this script times each surrounding
-stage (tile plan, row gather, dtype cast, tile transpose, weight packing)
-and candidate replacements (packed single-word sort, uint8 tiles,
-unpadded weights, locality-structured gathers) in isolation with the
-fori-loop methodology, to pick the round-3 data-movement levers.
+profile_level.py showed the whole build_hist_segmented call dominated by
+its surrounding data movement, not the kernel — this script times each
+stage (tile plan, row gather, dtype cast, tile transpose, weight packing,
+the kernel alone) and the packed single-word sort candidate in isolation.
+
+r13: every stage rides the canonical harness (engine/probes.timed_fori)
+with runtime liveness proofs; the r3-era ``block_until_ready`` setup
+materializations are gone — device inputs passed as jit arguments are
+forced by the harness's warm fetch before any timed wall starts, so no
+explicit sync is needed (and ``block_until_ready`` returns instantly
+through this tunnel anyway, CLAUDE.md).
 
 Usage: PYTHONPATH=... python scripts/profile_plan.py [rows] [P] [reps]
 """
-# dryadlint: disable-file=no-block-until-ready -- r3-era setup materialization, results recorded in BENCH_r03/STATUS; timed regions use the fori doctrine
+
+from __future__ import annotations
 
 import sys
-import time
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from dryad_tpu.engine.pallas_hist import (
-    _TILE_ROWS, _hist_tiles, _pack_weights, _pow2_bins, _tiles_from_rows,
-    tile_plan,
+    _TILE_ROWS, _hist_tiles, _pack_weights, _tiles_from_rows, tile_plan,
 )
+from dryad_tpu.engine.probes import timed_fori
 
 
 def main():
@@ -42,118 +47,126 @@ def main():
     sel = jnp.asarray(sel_np)
     bound = N // 2 + 1
 
-    def loop_time(tag, step, *arrays):
-        f = jax.jit(lambda s0, *a: jax.lax.fori_loop(
-            0, K, lambda i, s: step(s, *a), s0))
-        _ = float(f(jnp.float32(0.0), *arrays))
-        t0 = time.perf_counter()
-        _ = float(f(jnp.float32(0.0), *arrays))
-        dt = (time.perf_counter() - t0) / K
-        print(f"{tag:42s} {dt*1e3:9.1f} ms")
-        return dt
+    def show(tag, step, *args):
+        ms, spread = timed_fori(step, K, 2, *args, label=tag)
+        flag = "  SUSPECT" if spread > 0.05 else ""
+        print(f"{tag:42s} {ms:9.1f} ms  spread {spread:.3f}{flag}")
 
-    j32 = lambda s: (s * 1e-30).astype(jnp.int32)
+    def rot(sel_, si):
+        # rotate the SORT KEY mod P; sentinel P (dropped rows) stays put
+        return jnp.where(sel_ < P, (sel_ + si) % P, P)
 
     # ---- stage 1: plan ------------------------------------------------------
-    loop_time("argsort(sel) stable", lambda s, ss: jnp.argsort(
-        ss + j32(s), stable=True)[0].astype(jnp.float32) * 1e-30, sel)
+    def argsort_step(s, ss):
+        srt = jnp.argsort(rot(ss, s.astype(jnp.int32)), stable=True)
+        return s + 1.0, (srt[0] + srt[N // 2]).astype(jnp.float32)
 
-    def packed_sort(s, ss):
-        key = (ss + j32(s)).astype(jnp.uint32) * jnp.uint32(1 << 24) \
-            + jnp.arange(N, dtype=jnp.uint32)
+    show("argsort(sel) stable", argsort_step, sel)
+
+    def packed_sort_step(s, ss):
+        key = rot(ss, s.astype(jnp.int32)).astype(jnp.uint32) \
+            * jnp.uint32(1 << 24) + jnp.arange(N, dtype=jnp.uint32)
         srt = jnp.sort(key)
-        return (srt[0] & jnp.uint32(0xFFFFFF)).astype(jnp.float32) * 1e-30
-    loop_time("packed uint32 single sort", packed_sort, sel)
+        return s + 1.0, (srt[0] & jnp.uint32(0xFFFFFF)).astype(jnp.float32) \
+            + (srt[N // 2] & jnp.uint32(0xFFFFFF)).astype(jnp.float32)
 
-    def plan_only(s, ss):
-        buf, tl, tf = tile_plan(ss + j32(s), N, P, T, rows_bound=bound)
-        return buf[0].astype(jnp.float32) * 1e-30
-    loop_time("tile_plan total", plan_only, sel)
+    show("packed uint32 single sort", packed_sort_step, sel)
+
+    def plan_step(s, ss):
+        buf, tl, tf = tile_plan(rot(ss, s.astype(jnp.int32)), N, P, T,
+                                rows_bound=bound)
+        return s + 1.0, (buf[0] + tl[0]).astype(jnp.float32)
+
+    show("tile_plan total", plan_step, sel)
 
     buf, tile_leaf, tile_first = tile_plan(sel, N, P, T, rows_bound=bound)
-    buf = jax.block_until_ready(buf)
     n_tiles = buf.shape[0] // T
 
     # ---- stage 2: gathers ---------------------------------------------------
+    # the gather INDEX buffer rolls with the carried scalar: same access
+    # volume every trip, different addresses — the stage cannot hoist
+    # (gather locality measurably does not matter here, CLAUDE.md)
     Xp = jnp.concatenate([Xb, jnp.zeros((1, F), Xb.dtype)])
 
-    def gx(s, xp, bb):
-        rows = xp[bb + j32(s)]
-        return rows[0, 0].astype(jnp.float32) * 1e-30
-    loop_time("X row gather uint8 (plan buf)", gx, Xp, buf)
+    def gx_step(s, xp, bb):
+        rows = xp[jnp.roll(bb, s.astype(jnp.int32))]
+        return s + 1.0, (rows[0, 0] + rows[rows.shape[0] // 2, 0]).astype(
+            jnp.float32)
 
-    # same gather with a locality-friendly buf (sorted within = sequential)
+    show("X row gather uint8 (plan buf)", gx_step, Xp, buf)
+
     buf_sorted = jnp.sort(jnp.where(buf < N, buf, N))
-    loop_time("X row gather uint8 (sorted buf)", gx, Xp, buf_sorted)
+    show("X row gather uint8 (sorted buf)", gx_step, Xp, buf_sorted)
 
     ghp = jnp.concatenate([jnp.stack([g, h], axis=1),
                            jnp.zeros((1, 2), jnp.float32)])
 
-    def ggh(s, gp, bb):
-        rows = gp[bb + j32(s)]
-        return rows[0, 0] * 1e-30
-    loop_time("g/h two-col gather", ggh, ghp, buf)
+    def ggh_step(s, gp, bb):
+        rows = gp[jnp.roll(bb, s.astype(jnp.int32))]
+        return s + 1.0, rows[0, 0] + rows[rows.shape[0] // 2, 0]
+
+    show("g/h two-col gather", ggh_step, ghp, buf)
 
     # ---- stage 3: cast + tile transpose ------------------------------------
-    Xrows = jax.block_until_ready(Xp[buf])
+    Xrows = Xp[buf]
 
-    def cast_t(s, xr):
-        Xt = _tiles_from_rows(xr.astype(jnp.int32) + j32(s)[None, None],
-                              n_tiles, T, B)
-        return Xt[0, 0, 0, 0].astype(jnp.float32) * 1e-30
-    loop_time("astype(i32) + tiles transpose", cast_t, Xrows)
+    def cast_step(s, xr):
+        si = s.astype(jnp.int32)
+        # period-8 offset: a period-2 one repeats the same contrib
+        # multiset across the liveness seeds at even K (harness-rejected)
+        Xt = _tiles_from_rows(xr.astype(jnp.int32) + si % 8, n_tiles, T, B)
+        return s + 1.0, Xt.reshape(-1)[0].astype(jnp.float32) \
+            + Xt.reshape(-1)[-1].astype(jnp.float32)
 
-    def t_u8(s, xr):
-        xr = xr + j32(s).astype(jnp.uint8)[None, None]
+    show("astype(i32) + tiles transpose", cast_step, Xrows)
+
+    def t_u8_step(s, xr):
+        si = s.astype(jnp.int32)
+        xr = xr + (si % 8).astype(jnp.uint8)
         Fc = 32
         fpad = (-F) % Fc
         xrp = jnp.pad(xr, ((0, 0), (0, fpad)))
         Xt = xrp.reshape(n_tiles, T, 1, Fc).transpose(2, 0, 3, 1)
-        return Xt[0, 0, 0, 0].astype(jnp.float32) * 1e-30
-    loop_time("uint8 tiles transpose (no cast)", t_u8, Xrows)
+        return s + 1.0, Xt.reshape(-1)[0].astype(jnp.float32) \
+            + Xt.reshape(-1)[-1].astype(jnp.float32)
+
+    show("uint8 tiles transpose (no cast)", t_u8_step, Xrows)
 
     # ---- stage 4: weight packing -------------------------------------------
-    ght = jax.block_until_ready(ghp[buf].reshape(n_tiles, T, 2))
+    ght = ghp[buf].reshape(n_tiles, T, 2)
     valid = (buf < N).reshape(n_tiles, T)
 
-    def packw(s, gt, vv):
+    def packw_step(s, gt, vv):
         Wt = _pack_weights(gt[:, :, 0] + s, gt[:, :, 1], vv)
-        return Wt[0, 0, 0].astype(jnp.float32) * 1e-30
-    loop_time("pack_weights (current engine)", packw, ght, valid)
+        return s + 1.0, Wt[0, 0, 0].astype(jnp.float32) \
+            + Wt[-1, 0, -1].astype(jnp.float32)
 
-    def packw8(s, gt, vv):
-        from dryad_tpu.engine.pallas_hist import _split3
-        v = vv.astype(jnp.float32)
-        gv = (gt[:, :, 0] + s) * v
-        hv = gt[:, :, 1] * v
-        w = jnp.stack([*_split3(gv), *_split3(hv), v.astype(jnp.bfloat16)],
-                      axis=-2)
-        return w[0, 0, 0].astype(jnp.float32) * 1e-30
-    loop_time("pack_weights 7-row inline", packw8, ght, valid)
+    show("pack_weights (current engine)", packw_step, ght, valid)
 
     # ---- stage 5: kernel alone ---------------------------------------------
-    Xt = jax.block_until_ready(_tiles_from_rows(Xp[buf].astype(jnp.int32),
-                                                n_tiles, T, B))
-    Wt = jax.block_until_ready(_pack_weights(ght[:, :, 0], ght[:, :, 1], valid))
-
+    Xt = _tiles_from_rows(Xp[buf].astype(jnp.int32), n_tiles, T, B)
+    Wt = _pack_weights(ght[:, :, 0], ght[:, :, 1], valid)
     tile_skip = jnp.zeros_like(tile_leaf)
 
-    def kern(s, xt, wt, tl, tf, sk):
+    def kern_step(s, xt, wt, tl, tf, sk):
         hist = _hist_tiles(xt, wt + s.astype(jnp.bfloat16), tl,
                            tf, sk, num_cols=P, total_bins=B,
                            num_features=F, platform=plat)
-        return hist[0, 0, 0, 0] * 1e-30
-    loop_time("_hist_tiles kernel alone (i32 tiles)", kern, Xt, Wt,
-              tile_leaf, tile_first, tile_skip)
+        return s + 1.0, hist[0, 0].sum() + hist[-1, 0].sum()
+
+    show("_hist_tiles kernel alone (i32 tiles)", kern_step, Xt, Wt,
+         tile_leaf, tile_first, tile_skip)
 
     # ---- whole current pipeline for reference ------------------------------
     from dryad_tpu.engine.histogram import build_hist_segmented
 
-    loop_time("build_hist_segmented (whole)", lambda s, X, gg, hh, ss:
-              build_hist_segmented(X, gg + s, hh, ss, P, B,
-                                   rows_per_chunk=65536, platform=plat,
-                                   rows_bound=bound)[0, 0, 0, 0] * 1e-30,
-              Xb, g, h, sel)
+    def whole_step(s, Xb, g, h, ss):
+        hist = build_hist_segmented(Xb, g, h, rot(ss, s.astype(jnp.int32)),
+                                    P, B, rows_per_chunk=65536,
+                                    platform=plat, rows_bound=bound)
+        return s + 1.0, hist[0, 0].sum()
+
+    show("build_hist_segmented (whole)", whole_step, Xb, g, h, sel)
 
 
 if __name__ == "__main__":

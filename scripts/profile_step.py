@@ -1,16 +1,20 @@
 """Component timing for the 10M-row train step (VERDICT r1 item 2).
 
-CLAUDE.md methodology: K dependent iterations inside ONE jit via
-lax.fori_loop, wall-clock / K.  Each stage's step consumes a scalar
-perturbation and emits a scalar so the loop carries a true dependency.
-Big arrays are jit ARGUMENTS (remote compile rejects large constants).
+r13: rides the canonical harness (engine/probes.timed_fori — K dependent
+iterations inside ONE jit, carried whole-unit perturbation, terminal
+real fetch, runtime liveness proof).  The r2-era closure constants are
+gone: every array — including the grown tree's — rides as a jit
+ARGUMENT (the HTTP-413 rule), and the traversal stage perturbs the
+THRESHOLDS (the old ``value + s`` perturbation never reached the
+traversal, whose output is leaf ids — a dead input the harness would
+reject).
 
 Usage: PYTHONPATH=/root/.axon_site:/root/repo python scripts/profile_step.py [rows] [K]
 """
-# dryadlint: disable-file=jit-closure-constant -- r2-era probe: one-shot tree build, closure constants deliberate at the probe shape; kept verbatim for provenance
+
+from __future__ import annotations
 
 import sys
-import time
 
 import jax
 import jax.numpy as jnp
@@ -19,6 +23,7 @@ import numpy as np
 from dryad_tpu.config import make_params
 from dryad_tpu.engine.grower import grow_any
 from dryad_tpu.engine.predict import tree_leaves
+from dryad_tpu.engine.probes import timed_fori
 from dryad_tpu.objectives import get_objective
 
 
@@ -30,8 +35,7 @@ def main():
     plat = jax.devices()[0].platform
     print(f"rows={N} features={F} bins={B} reps={K} device={jax.devices()[0]}")
 
-    Xb_h = rng.integers(1, B, size=(N, F), dtype=np.uint8)
-    Xb = jnp.asarray(Xb_h)
+    Xb = jnp.asarray(rng.integers(1, B, size=(N, F), dtype=np.uint8))
     y = jnp.asarray((rng.random(N) < 0.5).astype(np.float32))
     g = jnp.asarray(rng.normal(size=N).astype(np.float32))
     h = jnp.asarray(rng.uniform(0.1, 1.0, size=N).astype(np.float32))
@@ -43,62 +47,65 @@ def main():
                          growth="depthwise"))
     obj = get_objective(p)
 
-    def loop_time(make_step, *arrays):
-        """make_step(s, *arrays) -> scalar; K dependent reps in one jit."""
-        def prog(s0, *arrays):
-            return jax.lax.fori_loop(
-                0, K, lambda i, s: make_step(s, *arrays), s0)
-        f = jax.jit(prog)
-        _ = float(f(jnp.float32(0.0), *arrays))  # compile + warm
-        t0 = time.perf_counter()
-        _ = float(f(jnp.float32(0.0), *arrays))
-        return (time.perf_counter() - t0) / K
+    def show(tag, step, *args):
+        ms, spread = timed_fori(step, K, 2, *args, label=tag)
+        flag = "  SUSPECT" if spread > 0.05 else ""
+        print(f"{tag:22s} {ms:9.1f} ms  spread {spread:.3f}{flag}")
+        return ms
 
     # grad/hess
-    t = loop_time(lambda s, gg, yy: obj.grad_hess_jax(gg + s, yy)[0][0] * 1e-30,
-                  g, y)
-    print(f"grad/hess:            {t*1e3:9.1f} ms")
+    def gh_step(s, gg, yy):
+        gr, hs = obj.grad_hess_jax(gg + s, yy)
+        return s + 1.0, gr[0] + hs[N // 2]
+
+    show("grad/hess:", gh_step, g, y)
 
     # grower
-    def grow_step(s, X, gg, hh, bb):
+    def grow_step(s, X, gg, hh, bb, fmask, iscat):
         tr = grow_any(p, B, X, gg + s, hh, bb, fmask, iscat,
                       has_cat=False, platform=plat)
-        return tr["value"][0] * 1e-30
-    t_grow = loop_time(grow_step, Xb, g, h, bag)
-    print(f"grower (depthwise):   {t_grow*1e3:9.1f} ms")
+        # whole value table: internal nodes' values stay 0, so a fixed
+        # pair of entries can be constant and read as dead
+        return s + 1.0, jnp.sum(tr["value"])
 
-    # traversal on a grown tree (tree arrays as args)
-    tree = jax.jit(lambda X, gg, hh: grow_any(
-        p, B, X, gg, hh, bag, fmask, iscat, has_cat=False, platform=plat),
-        )(Xb, g, h)
-    tree = {k: v for k, v in tree.items()}
+    t_grow = show("grower (depthwise):", grow_step, Xb, g, h, bag,
+                  fmask, iscat)
+
+    # traversal on a grown tree (tree arrays as jit args) — the
+    # perturbation shifts the THRESHOLDS (period 8), so every level's
+    # comparisons move and the leaf-id sum shifts far above fp32 ulp
+    tree = dict(grow_any(p, B, Xb, g, h, bag, fmask, iscat,
+                         has_cat=False, platform=plat))
 
     def trav_step(s, X, tr):
-        lv = tree_leaves({**tr, "value": tr["value"] + s}, X, p.max_depth)
-        return lv[0].astype(jnp.float32) * 1e-30
-    t_trav = loop_time(trav_step, Xb, tree)
-    print(f"traversal (d={p.max_depth}):     {t_trav*1e3:9.1f} ms")
+        si = s.astype(jnp.int32)
+        lv = tree_leaves({**tr, "threshold": tr["threshold"] + si % 8},
+                         X, p.max_depth)
+        return s + 1.0, jnp.sum(lv.astype(jnp.float32))
+
+    show(f"traversal (d={p.max_depth}):", trav_step, Xb, tree)
 
     # score update given leaves
-    leaves = jax.jit(lambda X, tr: tree_leaves(tr, X, p.max_depth))(Xb, tree)
+    leaves = tree_leaves(tree, Xb, p.max_depth)
+    sc = jnp.zeros((N, 1), jnp.float32)
 
     def upd_step(s, lv, val, sc):
         col = jnp.take(sc, 0, axis=1) + (val + s)[lv]
         sc2 = jax.lax.dynamic_update_index_in_dim(sc, col, 0, axis=1)
-        return sc2[0, 0] * 1e-30
-    sc = jnp.zeros((N, 1), jnp.float32)
-    t_upd = loop_time(upd_step, leaves, tree["value"], sc)
-    print(f"score update:         {t_upd*1e3:9.1f} ms")
+        return s + 1.0, sc2[0, 0] + sc2[N // 2, 0]
 
-    # full step: grow + score update via the grower's row_leaf (no traversal)
-    def full_step(s, X, gg, hh, bb, sc):
+    show("score update:", upd_step, leaves, tree["value"], sc)
+
+    # full step: grow + score update via the grower's row_leaf
+    def full_step(s, X, gg, hh, bb, fmask, iscat, sc):
         tr = grow_any(p, B, X, gg + s, hh, bb, fmask, iscat,
                       has_cat=False, platform=plat)
         col = jnp.take(sc, 0, axis=1) + tr["value"][tr["row_leaf"]]
-        return col[0] * 1e-30
-    t_full = loop_time(full_step, Xb, g, h, bag, sc)
-    print(f"grow+update(rowleaf): {t_full*1e3:9.1f} ms")
-    print(f"  outside-grower:     {(t_full-t_grow)*1e3:9.1f} ms")
+        return s + 1.0, jnp.sum(col) * jnp.float32(1.0 / N)
+
+    t_full = show("grow+update(rowleaf):", full_step, Xb, g, h, bag,
+                  fmask, iscat, sc)
+    print(f"  outside-grower:     {(t_full - t_grow):9.1f} ms")
 
 
 if __name__ == "__main__":

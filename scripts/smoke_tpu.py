@@ -236,6 +236,27 @@ def smoke_leafwise_wired_parity():
     print("leafwise wired expansion: trees bitwise vs legacy on device")
 
 
+def smoke_stage_profiler():
+    """First per-stage device breakdown (r13): run the cheap tier of the
+    stage-probe registry (engine/probes) on the attached device, each
+    liveness-proven at runtime — a dead/hoisted stage raises instead of
+    recording a 2x-fast lie.  Alongside the wired/legacy bench pairs this
+    gives the next TPU-attached session its stage-level evidence in one
+    command (ROADMAP standing satellite)."""
+    import jax
+
+    from dryad_tpu.engine import probes
+
+    if jax.devices()[0].platform == "cpu":
+        print("stage profiler: skipped (no accelerator attached)")
+        return
+    for name in probes.SMOKE_PROBES:
+        r = probes.run_probe(name, rows=200_000, K=3, reps=2)
+        flag = "  SUSPECT" if r["spread"] > probes.SPREAD_SUSPECT else ""
+        print(f"stage {name}: {r['ms']:.2f} ms spread {r['spread']:.3f} "
+              f"(liveness-proven){flag}")
+
+
 def smoke_train_parity():
     """Tiny end-to-end train on the ATTACHED device vs the CPU reference:
     identical tree structures and bitwise same-booster predict (the
@@ -279,6 +300,7 @@ _ALL_SMOKES = [
     smoke_pallas_natural_order,
     smoke_leafperm_wired_parity,
     smoke_leafwise_wired_parity,
+    smoke_stage_profiler,
 ]
 
 

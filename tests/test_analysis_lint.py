@@ -454,3 +454,83 @@ def test_obs_trends_is_covered_by_the_transitive_jax_walk():
     })
     assert any("transitive" in v.message
                for v in _rule_hits(rep, "obs-jax-free"))
+
+
+# ---------------------------------------------------------------------------
+# unharnessed-timed-fori (r13)
+
+_UNHARNESSED = textwrap.dedent("""
+    import time
+    import jax
+
+    def my_loop_time(step, s0):
+        prog = jax.jit(lambda s: jax.lax.fori_loop(0, 8, step, s))
+        float(prog(s0))
+        t0 = time.perf_counter()
+        float(prog(s0))
+        return time.perf_counter() - t0
+""")
+
+
+def test_unharnessed_fori_seeded_in_profile_script():
+    """A hand-rolled timed fori in a living measurement script is a
+    violation — the discipline lives in engine/probes.timed_fori."""
+    rep = _violations("unharnessed-timed-fori",
+                      {"scripts/profile_fixture.py": _UNHARNESSED})
+    assert _rule_hits(rep, "unharnessed-timed-fori")
+
+
+def test_unharnessed_fori_seeded_in_bench():
+    src = SourceTree(ROOT).read("bench.py")
+    rep = _violations("unharnessed-timed-fori",
+                      {"bench.py": src + "\n" + _UNHARNESSED})
+    assert _rule_hits(rep, "unharnessed-timed-fori")
+
+
+def test_unharnessed_fori_harness_call_is_clean():
+    ok = textwrap.dedent("""
+        from dryad_tpu.engine.probes import timed_fori
+
+        def measure(step, args):
+            ms, spread = timed_fori(step, 3, 2, *args, label="x")
+            return ms
+    """)
+    rep = _violations("unharnessed-timed-fori",
+                      {"scripts/profile_fixture.py": ok})
+    assert not _rule_hits(rep, "unharnessed-timed-fori")
+
+
+def test_unharnessed_fori_shipped_tree_clean_and_exps_out_of_scope():
+    """The migrated bench/profile/bench_* scripts are clean, and the
+    archived exp_* one-shots (kept verbatim for provenance) are OUTSIDE
+    the rule's targets rather than waived: the same seeded violation
+    that fires in a profile script must produce zero hits in an exp_
+    fixture."""
+    rep = _violations("unharnessed-timed-fori")
+    assert not rep.violations
+    rep = _violations("unharnessed-timed-fori",
+                      {"scripts/exp_fixture_probe.py": _UNHARNESSED})
+    assert not _rule_hits(rep, "unharnessed-timed-fori")
+
+
+def test_bench_real_fetch_covers_the_harness_module():
+    """r13 rescope: engine/probes.py is in bench-real-fetch's targets —
+    strip the harness's terminal fetches and the rule must fire."""
+    src = SourceTree(ROOT).read("dryad_tpu/engine/probes.py")
+    assert src.count("float(out[1])") == 3      # the three fetch sites
+    bad = src.replace("float(out[1])", "out[1]")
+    rep = _violations("bench-real-fetch",
+                      {"dryad_tpu/engine/probes.py": bad})
+    assert any(v.path == "dryad_tpu/engine/probes.py"
+               for v in _rule_hits(rep, "bench-real-fetch"))
+
+
+def test_dead_perturbation_covers_the_harness_module():
+    src = SourceTree(ROOT).read("dryad_tpu/engine/probes.py")
+    bad = src + ("\ndef _sneaky(s, tab):\n"
+                 "    import jax.numpy as jnp\n"
+                 "    return tab[(s + 0.001).astype(jnp.int32)]\n")
+    rep = _violations("dead-perturbation",
+                      {"dryad_tpu/engine/probes.py": bad})
+    assert any(v.path == "dryad_tpu/engine/probes.py"
+               for v in _rule_hits(rep, "dead-perturbation"))

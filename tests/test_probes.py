@@ -1,0 +1,184 @@
+"""The canonical timed-fori harness (engine/probes) + the jax-free
+profiler aggregation (obs/profiler).
+
+Pins the r13 contracts: the runtime liveness proof REJECTS dead
+perturbations (rounded-away casts, hoisted stages, order-symmetric
+periodic walks) and passes live ones; probe results flow into
+``dryad_stage_ms`` gauges and the stamped PROFILE artifact shape the
+trend ledger ingests; the CLI selftest catches the seeded dead probe.
+
+Probe executions here use tiny shapes (the suite budget rule:
+interpret-mode pallas fixtures pay per-tile Python) — the full registry
+sweep lives in ``python -m dryad_tpu profile --selftest`` (ci.sh).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dryad_tpu.engine import probes
+from dryad_tpu.engine.probes import (
+    DeadProbeError,
+    dead_probe_step,
+    run_probe,
+    timed_fori,
+)
+from dryad_tpu.obs import Registry
+from dryad_tpu.obs.profiler import (
+    export_stages,
+    profile_artifact,
+    write_profile,
+)
+
+ROOT = __file__.rsplit("/tests/", 1)[0]
+
+
+# ---- the harness ------------------------------------------------------------
+
+def test_live_probe_times_and_reports_spread():
+    x = jnp.asarray(np.random.default_rng(0).normal(size=2048)
+                    .astype(np.float32))
+
+    def step(s, x):
+        y = jnp.sort(x + 0.125 * (s - jnp.floor(s / 8.0) * 8.0))
+        return s + 1.0, y[0] + y[-1]
+
+    ms, spread = timed_fori(step, 2, 2, x, label="live-sort")
+    assert ms > 0.0 and spread >= 0.0
+
+
+def test_dead_probe_rejected_at_runtime():
+    """The seeded r5/r10 failure class MUST raise — the ISSUE's liveness
+    acceptance, in-process."""
+    x = jnp.asarray(np.random.default_rng(1).normal(size=2048)
+                    .astype(np.float32))
+    with pytest.raises(DeadProbeError, match="DEAD"):
+        timed_fori(dead_probe_step(), 2, 1, x, label="seeded-dead")
+
+
+def test_hoisted_stage_rejected():
+    """A stage fed only by non-carried inputs (the r10 LICM class): the
+    step ignores s entirely."""
+    x = jnp.asarray(np.random.default_rng(2).normal(size=2048)
+                    .astype(np.float32))
+
+    def step(s, x):
+        return s + 1.0, jnp.sort(x)[0]
+
+    with pytest.raises(DeadProbeError):
+        timed_fori(step, 2, 1, x, label="hoisted")
+
+
+def test_period_symmetric_perturbation_rejected():
+    """A period-2 walk under K=2 yields the same contrib MULTISET at both
+    seeds (the accumulator is order-independent) — must read as dead."""
+    x = jnp.asarray(np.random.default_rng(3).normal(size=1024)
+                    .astype(np.float32))
+
+    def step(s, x):
+        par = s - jnp.floor(s / 2.0) * 2.0
+        return s + 1.0, jnp.sort(x + par)[0]
+
+    with pytest.raises(DeadProbeError):
+        timed_fori(step, 2, 1, x, label="period-2")
+
+
+def test_nonfinite_contrib_rejected():
+    x = jnp.asarray(np.ones(16, np.float32))
+
+    def step(s, x):
+        return s + 1.0, jnp.log(x[0] * 0.0 - s * 0.0 - 1.0)  # nan
+
+    with pytest.raises(DeadProbeError, match="non-finite"):
+        timed_fori(step, 2, 1, x, label="nan-probe")
+
+
+def test_check_live_false_skips_the_proof():
+    x = jnp.asarray(np.zeros(64, np.float32))
+
+    def step(s, x):
+        return s + 1.0, jnp.sort(x)[0]        # dead, but unchecked
+
+    ms, _ = timed_fori(step, 2, 1, x, label="unchecked", check_live=False)
+    assert ms > 0.0
+
+
+# ---- the registry probes (tiny-shape spot checks) ---------------------------
+
+def test_registry_probe_runs_and_reports():
+    # ONE representative probe end to end; the full registry sweep rides
+    # test_selftest_passes_in_process below (and the ci.sh gate) — no
+    # need to pay a second compile per probe against the suite budget
+    r = run_probe("renewal_sort", rows=2048, K=2, reps=1, num_slots=8)
+    assert r["stage"] == "renewal_sort" and r["ms"] > 0.0
+    assert r["platform"] == "cpu" and r["rows"] == 2048
+
+
+def test_k_at_walk_period_rejected_loudly():
+    """K >= the probes' period-8 walk makes both liveness windows the
+    same multiset — run_probe must fail the CONFIGURATION, not report a
+    misleading 'dead stage'."""
+    with pytest.raises(ValueError, match="walk period"):
+        run_probe("split_scan", rows=512, K=probes.WALK_PERIOD, reps=1,
+                  num_slots=4)
+    # the escape hatch still times
+    r = run_probe("renewal_sort", rows=512, K=probes.WALK_PERIOD, reps=1,
+                  num_slots=4, check_live=False)
+    assert r["ms"] > 0.0
+
+
+def test_registry_covers_the_issue_stages():
+    need = {"hist_masked", "hist_segmented", "split_scan",
+            "permute_records", "hist_from_layout", "route_gather",
+            "predict_traversal", "goss_sort", "renewal_sort"}
+    assert need <= set(probes.PROBES)
+    assert set(probes.SMOKE_PROBES) <= set(probes.PROBES)
+
+
+def test_selftest_passes_in_process(capsys):
+    """The full gate, exactly what ci.sh runs: dead probe caught, every
+    shipped probe liveness-proven."""
+    assert probes.run_selftest(rows=2048, num_slots=4, quiet=True) == 0
+    out = capsys.readouterr().out
+    assert "PROFILE SELFTEST OK" in out
+
+
+# ---- the jax-free aggregation (obs/profiler) --------------------------------
+
+RESULTS = [
+    {"stage": "hist_segmented", "ms": 136.2, "spread": 0.02, "rows": 10_000},
+    {"stage": "deep_level", "arm": "wired", "ms": 51.4, "spread": 0.01,
+     "rows": 10_000},
+]
+
+
+def test_export_stages_gauges():
+    reg = Registry()
+    assert export_stages(RESULTS, reg) == 2
+    fam = reg.gauge("dryad_stage_ms")
+    assert fam.labels(stage="hist_segmented").value() == 136.2
+    assert fam.labels(stage="deep_level", arm="wired").value() == 51.4
+    sp = reg.gauge("dryad_stage_spread")
+    assert sp.labels(stage="hist_segmented").value() == 0.02
+    # zero-cost disabled: nothing recorded
+    assert export_stages(RESULTS, Registry(enabled=False)) == 0
+
+
+def test_profile_artifact_shape_and_stamp(tmp_path):
+    art = write_profile(RESULTS, str(tmp_path / "PROFILE_r01.json"),
+                        device_kind="cpu", root=ROOT)
+    assert art["stage_ms_hist_segmented"] == 136.2
+    assert art["stage_spread_hist_segmented"] == 0.02
+    assert art["stage_ms_deep_level_wired"] == 51.4
+    assert art["stage_rows_deep_level_wired"] == 10_000
+    assert art["profile_schema"] == 1
+    assert art["schema_version"] == 1 and art["git_rev"]
+    import json
+
+    on_disk = json.loads((tmp_path / "PROFILE_r01.json").read_text())
+    assert on_disk == art
+
+
+def test_profile_artifact_unstamped_outside_git(tmp_path):
+    art = profile_artifact(RESULTS, root=str(tmp_path))
+    assert art["git_rev"] is None       # best-effort stamp, never raises

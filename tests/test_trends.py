@@ -158,6 +158,90 @@ def test_stats_provider_shape(tmp_path):
     assert provide() is not None        # cached second call
 
 
+# ---- PROFILE_r*.json ingestion (r13 stage profiler) ------------------------
+
+PROF = {"profile_schema": 1, "stage_ms_hist_segmented": 136.0,
+        "stage_spread_hist_segmented": 0.01,
+        "stage_ms_route_gather": 30.0, "stage_spread_route_gather": 0.02,
+        "stage_rows_hist_segmented": 10_000_000}
+
+
+def _profile_history(tmp_path, points):
+    for i, metrics in enumerate(points, start=1):
+        _write(str(tmp_path / f"PROFILE_r{i:02d}.json"), dict(metrics))
+    return str(tmp_path)
+
+
+def test_profile_history_loads_and_tracks_stage_metrics(tmp_path):
+    from dryad_tpu.obs.trends import PROFILE_PATTERN
+
+    root = _profile_history(tmp_path, [PROF, dict(PROF,
+                                                  stage_ms_route_gather=28.0)])
+    hist = load_history(root, pattern=PROFILE_PATTERN)
+    assert [p["round"] for p in hist] == [1, 2]
+    report = compare(hist)
+    assert report["ok"]
+    assert report["metrics"]["stage_ms_route_gather"]["verdict"] == "ok"
+    # context fields (rows) are never tracked metrics
+    assert "stage_rows_hist_segmented" not in report["metrics"]
+
+
+def test_profile_regression_flagged_and_spread_vetoed(tmp_path):
+    """A 2x-slower stage regresses vs the median; the SAME point with a
+    seeded noisy spread downgrades to suspect (the CLAUDE.md veto)."""
+    from dryad_tpu.obs.trends import PROFILE_PATTERN
+
+    bad = dict(PROF, stage_ms_hist_segmented=270.0)
+    root = _profile_history(tmp_path, [PROF, PROF, PROF, bad])
+    report = compare(load_history(root, pattern=PROFILE_PATTERN))
+    entry = report["metrics"]["stage_ms_hist_segmented"]
+    assert not report["ok"] and entry["verdict"] == "regression"
+
+    noisy = dict(bad, stage_spread_hist_segmented=0.2)
+    _write(str(tmp_path / "PROFILE_r04.json"), noisy)
+    report = compare(load_history(root, pattern=PROFILE_PATTERN))
+    entry = report["metrics"]["stage_ms_hist_segmented"]
+    assert report["ok"] and entry["verdict"] == "suspect"
+
+
+def test_profile_history_backfill_tolerant(tmp_path):
+    """An unstamped artifact (no schema_version — the stamp is
+    best-effort) still loads via its profile_schema marker; junk files
+    skip, never fatal."""
+    from dryad_tpu.obs.trends import PROFILE_PATTERN
+
+    unstamped = {k: v for k, v in PROF.items()}     # no schema_version
+    _write(str(tmp_path / "PROFILE_r01.json"), unstamped)
+    _write(str(tmp_path / "PROFILE_r02.json"),
+           dict(PROF, schema_version=1, git_rev="abc", device_kind="cpu"))
+    with open(str(tmp_path / "PROFILE_r03.json"), "w") as f:
+        f.write("{ torn")
+    hist = load_history(str(tmp_path), pattern=PROFILE_PATTERN)
+    assert [p["round"] for p in hist] == [1, 2]
+    assert hist[0]["git_rev"] is None and hist[1]["git_rev"] == "abc"
+
+
+def test_stats_provider_mounts_profile_trends(tmp_path):
+    root = _history(tmp_path, [BASE, BASE])
+    out = stats_provider(root)()
+    assert "profile_trends" not in out          # no PROFILE files
+    _profile_history(tmp_path, [PROF, PROF])
+    out = stats_provider(root)()
+    assert out["profile_trends"]["ok"]
+    assert out["profile_trends"]["n_points"] == 2
+
+
+def test_profile_ingest_registry_series(tmp_path):
+    from dryad_tpu.obs.trends import PROFILE_PATTERN
+
+    root = _profile_history(tmp_path, [PROF])
+    reg = Registry()
+    n = ingest(load_history(root, pattern=PROFILE_PATTERN), reg)
+    assert n == 2        # two stage_ms_* metrics, spreads/rows untracked
+    fam = reg.gauge("dryad_bench_value")
+    assert fam.labels(metric="stage_ms_route_gather", round=1).value() == 30.0
+
+
 # ---- artifact stamp ---------------------------------------------------------
 
 def test_artifact_stamp_in_repo_and_outside(tmp_path):
