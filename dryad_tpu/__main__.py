@@ -14,8 +14,12 @@
         [--host H --port P] [--backend auto|tpu|cpu] \
         [--max-batch-rows N --max-wait-ms F] [--pipeline-depth 2] \
         [--sharded auto|on|off] [--device-budget-mb M] [--log-requests] \
-        [--auth-token T] \
+        [--auth-token T] [--port-file F]   # F gets 'host port' when ready \
         [--request X.npy --out p.npy]   # one-shot through the full stack
+    python -m dryad_tpu fleet   --model m.dryad --replicas N [--port P] \
+        [--journal fleet.jsonl --retry-budget N] [--warmup] \
+        [--max-inflight N --bulk-max-inflight N] [--model-cap NAME=N] \
+        [--auth-token T]   # supervised replica pool + health-routed router
 
 Data formats: ``.npy`` (dense float matrix), ``.npz`` with keys
 ``indptr/indices/values/num_features`` (CSR sparse), or ``.csv``
@@ -397,13 +401,26 @@ def cmd_serve(args) -> int:
             print(json.dumps(server.stats(), indent=1))
         return 0
 
+    from dryad_tpu.resilience.faults import injector_from_env
     from dryad_tpu.serve.http import make_http_server
 
+    # replica fault drills (fleet supervisor -> env -> this process):
+    # absent/empty env costs nothing; a malformed spec fails startup loudly
+    fault_hook = injector_from_env()
     httpd = make_http_server(server, args.host, args.port,
                              verbose=not args.quiet,
                              log_requests=args.log_requests,
-                             auth_token=args.auth_token)
+                             auth_token=args.auth_token,
+                             fault_hook=fault_hook)
     host, port = httpd.server_address[:2]
+    if args.port_file:
+        # the fleet handshake: replicas bind port 0, so readiness and the
+        # chosen port must be announced race-free — write-then-rename so a
+        # watcher never reads a half-written file
+        tmp = args.port_file + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(f"{host} {port}\n")
+        os.replace(tmp, args.port_file)
     print(f"dryad serving on http://{host}:{port}  "
           f"(backend={server.backend}; POST /predict, GET /stats)")
     try:
@@ -414,6 +431,72 @@ def cmd_serve(args) -> int:
         httpd.server_close()
         server.stop()
         print(json.dumps(server.stats(), indent=1))
+    return 0
+
+
+def cmd_fleet(args) -> int:
+    """Replicated serving: N serve subprocesses under lifecycle
+    supervision (crash/hang detection, budgeted respawn, journal) behind
+    the health-routed fleet router (dryad_tpu/fleet)."""
+    from dryad_tpu.fleet import FleetSupervisor, make_fleet_router, serve_argv
+    from dryad_tpu.fleet.router import main_loop
+    from dryad_tpu.resilience.policy import RetryPolicy
+
+    model_caps = {}
+    for spec in args.model_cap or []:
+        name, _, cap = spec.partition("=")
+        if not name or not cap.isdigit():
+            raise SystemExit(f"--model-cap wants NAME=N, got {spec!r}")
+        model_caps[name] = int(cap)
+
+    def make_argv(index: int, port_file: str) -> list:
+        return serve_argv(args.model, port_file, backend=args.backend,
+                          max_batch_rows=args.max_batch_rows,
+                          max_wait_ms=args.max_wait_ms,
+                          queue_size=args.queue_size, warmup=args.warmup,
+                          auth_token=args.auth_token)
+
+    policy = (RetryPolicy() if args.retry_budget is None
+              else RetryPolicy(retry_budget=args.retry_budget))
+    supervisor = FleetSupervisor(
+        make_argv, args.replicas, policy=policy, journal=args.journal,
+        probe_interval_s=args.probe_interval,
+        startup_timeout_s=args.startup_timeout)
+    # a process MANAGER must not die leaving its children running: the
+    # default SIGTERM kills python without unwinding, so `kill <fleet>`
+    # would orphan every replica (observed).  Route TERM through the
+    # KeyboardInterrupt path main_loop already handles, so the finally
+    # below terminates the pool.
+    import signal
+
+    def _term(signum, frame):
+        raise KeyboardInterrupt
+
+    signal.signal(signal.SIGTERM, _term)
+    # start() is INSIDE the try: each replica pays a 10-20 s jax import,
+    # so a TERM/Ctrl-C during startup must still reach supervisor.stop()
+    # (which terminates whatever was already spawned), or the half-built
+    # pool leaks serve processes
+    try:
+        supervisor.start()
+        httpd = make_fleet_router(
+            supervisor, args.host, args.port,
+            max_inflight=args.max_inflight,
+            bulk_max_inflight=args.bulk_max_inflight,
+            model_caps=model_caps or None,
+            request_timeout_s=args.request_timeout,
+            min_healthy=args.min_healthy,
+            auth_token=args.auth_token, verbose=not args.quiet)
+        host, port = httpd.server_address[:2]
+        if not args.quiet:
+            urls = {s.name: s.state()["url"]
+                    for s in supervisor.slots}
+            print(f"dryad fleet on http://{host}:{port}  "
+                  f"({args.replicas} replicas: {urls}; POST /predict, "
+                  "POST /models/push, GET /metrics aggregates the pool)")
+        main_loop(httpd, quiet=args.quiet)
+    finally:
+        supervisor.stop()
     return 0
 
 
@@ -547,8 +630,66 @@ def main(argv=None) -> int:
                                      "through the serving stack and exit")
     s.add_argument("--out", help="one-shot mode: output .npy path")
     s.add_argument("--raw", action="store_true", help="raw scores (no link)")
+    s.add_argument("--port-file",
+                   help="write 'host port' here once listening (atomic "
+                        "rename) — the fleet supervisor's readiness "
+                        "handshake for --port 0 replicas")
     s.add_argument("--quiet", action="store_true")
     s.set_defaults(fn=cmd_serve)
+
+    fl = sub.add_parser("fleet",
+                        help="replicated serving: supervised replica pool "
+                             "behind a health-routed router (dryad_tpu/fleet)")
+    fl.add_argument("--model", required=True, action="append",
+                    help="model path or NAME=path alias; repeat to co-serve "
+                         "(every replica loads the same set)")
+    fl.add_argument("--replicas", type=int, default=2,
+                    help="serve subprocesses in the pool")
+    fl.add_argument("--backend", default="auto",
+                    choices=["auto", "tpu", "cpu"])
+    fl.add_argument("--host", default="127.0.0.1")
+    fl.add_argument("--port", type=int, default=8000,
+                    help="router port (also serves the aggregated /metrics "
+                         "and fleet /healthz; replicas bind free ports)")
+    fl.add_argument("--max-batch-rows", type=int, default=4096)
+    fl.add_argument("--max-wait-ms", type=float, default=2.0)
+    fl.add_argument("--queue-size", type=int, default=256)
+    fl.add_argument("--warmup", action="store_true",
+                    help="each replica compiles its buckets and arms the "
+                         "recompile tripwire at startup")
+    fl.add_argument("--max-inflight", type=int, default=64,
+                    help="fleet admission cap: beyond this every request "
+                         "sheds (503)")
+    fl.add_argument("--bulk-max-inflight", type=int, default=None,
+                    help="bulk requests shed beyond this in-flight count "
+                         "(default max-inflight/2) — interactive survives "
+                         "overload first")
+    fl.add_argument("--model-cap", action="append", default=None,
+                    help="NAME=N per-model in-flight admission cap; "
+                         "repeatable")
+    fl.add_argument("--request-timeout", type=float, default=30.0,
+                    help="per-forward timeout; one retry on a different "
+                         "healthy replica")
+    fl.add_argument("--min-healthy", type=int, default=1,
+                    help="fleet /healthz answers 503 below this many "
+                         "routable replicas")
+    fl.add_argument("--probe-interval", type=float, default=0.25,
+                    help="supervisor health-probe cadence (seconds)")
+    fl.add_argument("--startup-timeout", type=float, default=120.0,
+                    help="per-replica readiness deadline (device replicas "
+                         "pay model load + compile here)")
+    fl.add_argument("--retry-budget", type=int, default=None,
+                    help="per-replica respawns before the slot fails "
+                         "closed (resilience.RetryPolicy)")
+    fl.add_argument("--journal",
+                    help="fleet journal JSONL path (spawn/crash/respawn/"
+                         "swap decisions, append-only)")
+    fl.add_argument("--auth-token",
+                    default=os.environ.get("DRYAD_AUTH_TOKEN"),
+                    help="bearer token for router AND replicas "
+                         "(/healthz stays open)")
+    fl.add_argument("--quiet", action="store_true")
+    fl.set_defaults(fn=cmd_fleet)
 
     args = ap.parse_args(argv)
     return args.fn(args)

@@ -15,6 +15,8 @@ no-block-until-ready   block_until_ready returns instantly through the axon
 batcher-device-fetch   the serve dispatch loop never touches device results
                        (the ONE fetch lives in cache.execute_raw)
 obs-jax-free           dryad_tpu/obs imports no jax, directly OR transitively
+fleet-jax-free         dryad_tpu/fleet likewise (r14): the router/supervisor
+                       must start and respawn while a device is wedged
 jit-closure-constant   big arrays captured by jit closures become program
                        constants — remote compile rejects them (HTTP 413)
 bench-real-fetch       timed fori programs end in a REAL host fetch
@@ -149,9 +151,11 @@ def _check_block_until_ready(path, src, tree):
 
 register(Rule(
     name="no-block-until-ready",
-    doc="serve/resilience/obs/bench must never sync on block_until_ready",
+    doc="serve/resilience/obs/fleet/bench must never sync on "
+        "block_until_ready",
     targets=("dryad_tpu/serve/**", "dryad_tpu/resilience/**",
-             "dryad_tpu/obs/**", "bench.py", "scripts/*.py"),
+             "dryad_tpu/obs/**", "dryad_tpu/fleet/**",
+             "bench.py", "scripts/*.py"),
     check=_check_block_until_ready,
 ))
 
@@ -250,6 +254,59 @@ register(Rule(
     targets=("dryad_tpu/obs/**",),
     check=_check_obs_direct,
     tree_check=_tree_check_obs,
+))
+
+
+# ---------------------------------------------------------------------------
+# fleet-jax-free (r14) — the same contract as obs, for the same reason:
+# the fleet router and supervisor are host-side process/socket machinery
+# that must start, route, and respawn while a replica's device is wedged.
+# A jax import here would (a) couple router startup to device init and
+# (b) tempt a device fetch into the routing loop.  Direct bans are strict
+# (lazy in-function imports included); the transitive check walks
+# module-level imports — e.g. an innocent helper pulled from engine/
+# would flag the whole chain.
+
+def _check_fleet_direct(path, src, tree):
+    out = []
+    for line, mod in _imports_of(tree, ("jax", "jaxlib")):
+        out.append(Violation(
+            "fleet-jax-free", path, line,
+            f"import {mod} in dryad_tpu/fleet — the fleet layer is "
+            "host-side process/socket supervision and jax-free by lint "
+            "(r14); replicas own the devices, the fleet owns processes"))
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Attribute) and node.attr in (
+                "device_get", "addressable_data", "asnumpy"):
+            out.append(Violation(
+                "fleet-jax-free", path, node.lineno,
+                f".{node.attr} in dryad_tpu/fleet — the router/supervisor "
+                "must never touch device buffers; every value crosses HTTP"))
+    return out
+
+
+def _tree_check_fleet(sources, tree):
+    out = []
+    chains = find_banned_chains(sorted(sources), tree,
+                                banned_roots=("jax", "jaxlib"))
+    for chain, banned in chains:
+        entry = chain[0]
+        out.append(Violation(
+            "fleet-jax-free", _module_rel(entry, tree), 1,
+            "transitive jax import: " + " -> ".join(chain)
+            + " — importing dryad_tpu.fleet must not pull in jax "
+            "(jax-free-by-construction contract, r14; import from the "
+            "jax-free leaf modules — obs, resilience.faults/journal/"
+            "policy — not the packages that wrap them)"))
+    return out
+
+
+register(Rule(
+    name="fleet-jax-free",
+    doc="dryad_tpu/fleet is jax-free, directly and transitively",
+    targets=("dryad_tpu/fleet/**",),
+    check=_check_fleet_direct,
+    tree_check=_tree_check_fleet,
 ))
 
 

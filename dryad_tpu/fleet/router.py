@@ -1,0 +1,479 @@
+"""Health-routed HTTP front door for a replica fleet.
+
+A thin stdlib router in front of the supervisor's slots: requests land
+here, get admission-controlled, and are forwarded over plain HTTP to one
+healthy replica.  The router holds NO model state and never touches jax
+(the fleet package is jax-free by lint) — it is deliberately the
+smallest thing that can make N shared-nothing serve processes look like
+one endpoint.
+
+Routing and degradation, in order:
+
+1. **Admission (priority shedding).**  Each request carries a priority
+   class (``X-Dryad-Priority: interactive|bulk``; default interactive;
+   the body stays opaque bytes — a body ``"priority"`` is honored only
+   when a per-model cap already forces a body parse).  Bulk
+   sheds FIRST: when total in-flight reaches ``bulk_max_inflight`` new
+   bulk requests get 503 while interactive traffic still flows; at
+   ``max_inflight`` everything sheds.  Optional per-model caps
+   (``model_caps``) bound any one model's in-flight share the same way.
+   This is LAYERED ON the per-replica micro-batcher queue: the router
+   bounds what enters the fleet, each replica's bounded queue
+   (``ServeOverloaded`` -> 503) remains the final backstop.
+2. **Routing.**  Round-robin over routable slots (healthy, not draining,
+   not failed closed) — the supervisor's monitor updates that set, the
+   router just reads it.
+3. **Retry.**  A forwarded request that dies on the wire (connect error,
+   timeout) or answers 5xx is retried EXACTLY ONCE against a different
+   routable replica.  One retry is the whole budget: the recorded
+   fleet drills (crash mid-request, stuck-503) need exactly one, and
+   unbounded retries would amplify overload into a retry storm.
+
+Observability: ``/metrics`` serves the router's own ``dryad_fleet_*``
+series PLUS every live replica's scrape, each sample relabeled with
+``replica="rN"`` — one endpoint scrapes the whole fleet.  ``/healthz``
+(auth-exempt, like every other healthz in this repo) answers 200 while
+at least ``min_healthy`` replicas are routable.  ``/stats`` returns the
+JSON view (slot states + shed/retry counters).  Bearer auth reuses the
+obs exporter's scheme.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import socket
+import sys
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from dryad_tpu.obs.exporter import authorized, send_unauthorized
+from dryad_tpu.obs.registry import Registry, default_registry
+
+PRIORITIES = ("interactive", "bulk")
+#: statuses that count as "this replica failed us" for the single retry
+RETRYABLE_STATUSES = (500, 502, 503, 504)
+#: hop-by-hop / recomputed headers never forwarded either direction
+_SKIP_HEADERS = {"host", "content-length", "connection", "transfer-encoding",
+                 "keep-alive"}
+
+
+def relabel_exposition(text: str, replica: str) -> str:
+    """Inject ``replica="rN"`` into every sample line of a Prometheus
+    text exposition.  Comment lines (# HELP/# TYPE) are dropped — N
+    replicas would repeat them per family, which scrapers reject."""
+    out = []
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        # sample shape: name[{labels}] value [timestamp]
+        brace = line.find("{")
+        space = line.find(" ")
+        if brace != -1 and (space == -1 or brace < space):
+            out.append(line[:brace + 1] + f'replica="{replica}",'
+                       + line[brace + 1:])
+        elif space != -1:
+            out.append(f'{line[:space]}{{replica="{replica}"}}{line[space:]}')
+    return "\n".join(out) + ("\n" if out else "")
+
+
+class _RouterState:
+    """Everything the handler threads share (rides on the HTTP server)."""
+
+    def __init__(self, supervisor, *, registry: Optional[Registry],
+                 max_inflight: int, bulk_max_inflight: Optional[int],
+                 model_caps: Optional[dict], request_timeout_s: float,
+                 min_healthy: int, auth_token: Optional[str]):
+        self.supervisor = supervisor
+        self.registry = (registry if registry is not None
+                         else default_registry())
+        self.max_inflight = int(max_inflight)
+        self.bulk_max_inflight = (int(bulk_max_inflight)
+                                  if bulk_max_inflight is not None
+                                  else max(1, self.max_inflight // 2))
+        if not 0 < self.bulk_max_inflight <= self.max_inflight:
+            raise ValueError("need 0 < bulk_max_inflight <= max_inflight "
+                             "(bulk sheds first, never last)")
+        self.model_caps = {str(k): int(v)
+                           for k, v in (model_caps or {}).items()}
+        self.request_timeout_s = float(request_timeout_s)
+        self.min_healthy = int(min_healthy)
+        self.auth_token = auth_token
+        self._lock = threading.Lock()
+        self._inflight_total = 0
+        self._inflight_model: dict[str, int] = {}
+        self._rr = 0
+
+    # ---- admission ---------------------------------------------------------
+    def admit(self, priority: str, model: Optional[str]) -> Optional[str]:
+        """Take an admission slot, or return the refusal reason.  The
+        caller MUST pair a None return with a later ``release``."""
+        with self._lock:
+            if self._inflight_total >= self.max_inflight:
+                return "fleet at max_inflight"
+            if (priority == "bulk"
+                    and self._inflight_total >= self.bulk_max_inflight):
+                return "bulk shed (fleet beyond bulk_max_inflight)"
+            if model is not None and model in self.model_caps:
+                if (self._inflight_model.get(model, 0)
+                        >= self.model_caps[model]):
+                    return f"model {model!r} at its admission cap"
+            self._inflight_total += 1
+            if model is not None:
+                self._inflight_model[model] = (
+                    self._inflight_model.get(model, 0) + 1)
+            return None
+
+    def release(self, model: Optional[str]) -> None:
+        with self._lock:
+            self._inflight_total -= 1
+            if model is not None:
+                self._inflight_model[model] = (
+                    self._inflight_model.get(model, 1) - 1)
+
+    @property
+    def inflight_total(self) -> int:
+        with self._lock:
+            return self._inflight_total
+
+    # ---- slot choice -------------------------------------------------------
+    def pick(self, exclude=()) -> Optional[object]:
+        slots = [s for s in self.supervisor.routable_slots()
+                 if s.name not in exclude]
+        if not slots:
+            return None
+        with self._lock:
+            self._rr += 1
+            return slots[self._rr % len(slots)]
+
+    # ---- metrics helpers ---------------------------------------------------
+    def count(self, name: str, help: str, **labels) -> None:
+        if self.registry.enabled:
+            fam = self.registry.counter(name, help)
+            (fam.labels(**labels) if labels else fam).inc()
+
+    def gauge_inflight(self) -> None:
+        if self.registry.enabled:
+            self.registry.gauge(
+                "dryad_fleet_inflight",
+                "Requests currently inside the fleet").set(
+                self.inflight_total)
+
+
+class _Handler(BaseHTTPRequestHandler):
+    # the _RouterState rides on the server object (see make_fleet_router)
+
+    def log_message(self, fmt, *args):  # quiet by default
+        if getattr(self.server, "verbose", False):
+            super().log_message(fmt, *args)
+
+    def _send(self, code: int, payload: dict) -> None:
+        self._send_raw(code, json.dumps(payload).encode(), "application/json")
+
+    def _send_raw(self, code: int, body: bytes, ctype: str) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _authorized(self) -> bool:
+        if authorized(self, self.server.state.auth_token):
+            return True
+        send_unauthorized(self)
+        return False
+
+    # ---- GET ---------------------------------------------------------------
+    def do_GET(self):  # noqa: N802 — stdlib handler API
+        state: _RouterState = self.server.state
+        if self.path == "/healthz":
+            states = state.supervisor.states()
+            ok = state.supervisor.fleet_ok(state.min_healthy)
+            self._send(200 if ok else 503,
+                       {"ok": ok, "replicas": states})
+            return
+        if not self._authorized():
+            return
+        if self.path == "/metrics":
+            self._send_raw(200, self._aggregate_metrics().encode(),
+                           "text/plain; version=0.0.4; charset=utf-8")
+        elif self.path == "/stats":
+            self._send(200, {
+                "replicas": state.supervisor.states(),
+                "inflight": state.inflight_total,
+                "max_inflight": state.max_inflight,
+                "bulk_max_inflight": state.bulk_max_inflight,
+                "model_caps": state.model_caps,
+                "fleet": state.registry.snapshot(),
+            })
+        else:
+            self._send(404, {"error": f"unknown path {self.path}"})
+
+    def _aggregate_metrics(self) -> str:
+        state: _RouterState = self.server.state
+        state.gauge_inflight()
+        # replica /metrics honors the same bearer auth as ours — an authed
+        # fleet must not silently lose every per-replica series
+        headers = ({"Authorization": f"Bearer {state.auth_token}"}
+                   if state.auth_token else {})
+        live = [s for s in state.supervisor.slots
+                if s.proc is not None and s.proc.alive
+                and s.proc.host is not None]
+        results: dict[str, str] = {}
+
+        def scrape(slot) -> None:
+            try:
+                status, body = slot.proc.request("GET", "/metrics",
+                                                 headers=headers,
+                                                 timeout_s=2.0)
+            except OSError:
+                status, body = None, b""
+            if status == 200:
+                results[slot.name] = relabel_exposition(
+                    body.decode(errors="replace"), slot.name)
+            else:
+                state.count("dryad_fleet_scrape_error_total",
+                            "Replica /metrics scrapes that failed",
+                            replica=slot.name)
+
+        # concurrent scrapes: one hung replica costs the whole request its
+        # OWN 2 s timeout, not 2 s per sick slot
+        threads = [threading.Thread(target=scrape, args=(s,), daemon=True)
+                   for s in live]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=3.0)
+        parts = [state.registry.exposition()]
+        parts += [results[s.name] for s in live if s.name in results]
+        return "".join(parts)
+
+    # ---- POST --------------------------------------------------------------
+    def do_POST(self):  # noqa: N802 — stdlib handler API
+        if not self._authorized():
+            return
+        state: _RouterState = self.server.state
+        try:
+            length = int(self.headers.get("Content-Length") or 0)
+            body = self.rfile.read(length) if length else b""
+            if self.path == "/predict":
+                self._route_predict(body)
+            elif self.path == "/models/push":
+                spec = json.loads(body or b"{}")
+                result = state.supervisor.rolling_push(
+                    spec["path"], name=spec.get("name"),
+                    activate=bool(spec.get("activate", True)),
+                    auth_token=state.auth_token)
+                self._send(200 if not result["errors"] else 502, result)
+            else:
+                self._send(404, {"error": f"unknown path {self.path}"})
+        except (KeyError, ValueError) as e:
+            self._send(400, {"error": repr(e)})
+        except Exception as e:  # noqa: BLE001 — surface, don't kill the router
+            self._send(500, {"error": repr(e)})
+
+    def _priority_and_model(self, body: bytes) -> tuple[str, Optional[str]]:
+        """Priority from the ``X-Dryad-Priority`` header; the body stays
+        opaque bytes on the default path (parsing MB-scale bulk payloads
+        at the router just to read one key would double the JSON cost of
+        every request).  Only a configured per-model cap forces a body
+        parse (the model name lives there unless ``X-Dryad-Model`` is
+        set), and THAT parse also honors a body ``"priority"`` as a
+        convenience — header-less priority classing without model caps
+        defaults to interactive."""
+        state: _RouterState = self.server.state
+        priority = (self.headers.get("X-Dryad-Priority") or "").lower()
+        model = self.headers.get("X-Dryad-Model")
+        if state.model_caps and model is None and body:
+            try:
+                doc = json.loads(body)
+                priority = priority or str(doc.get("priority", "")).lower()
+                model = doc.get("model")
+            except ValueError:
+                pass
+        if priority not in PRIORITIES:
+            priority = "interactive"
+        return priority, model
+
+    def _route_predict(self, body: bytes) -> None:
+        state: _RouterState = self.server.state
+        priority, model = self._priority_and_model(body)
+        state.count("dryad_fleet_request_total",
+                    "Requests entering the fleet router",
+                    priority=priority)
+        reason = state.admit(priority, model)
+        if reason is not None:
+            state.count("dryad_fleet_shed_total",
+                        "Requests shed by fleet admission control",
+                        priority=priority)
+            self._send(503, {"error": f"shed: {reason}",
+                             "priority": priority})
+            return
+        t0 = time.perf_counter()
+        try:
+            status, payload, replica = self._forward(body)
+            if status is None:
+                self._send(503, {"error": "no healthy replica"})
+                return
+            self._send_raw(status, payload, "application/json")
+            if state.registry.enabled:
+                state.registry.histogram(
+                    "dryad_fleet_request_latency_seconds",
+                    "Wall latency through the router").labels(
+                    priority=priority).observe(time.perf_counter() - t0)
+                if replica is not None:
+                    state.count("dryad_fleet_routed_total",
+                                "Requests served, by replica",
+                                replica=replica)
+        finally:
+            state.release(model)
+
+    def _forward(self, body: bytes):
+        """Forward to one routable replica; retry once elsewhere on a
+        wire failure or 5xx.  Returns (status, payload, replica_name) —
+        status None when no replica was available at all."""
+        state: _RouterState = self.server.state
+        headers = {k: v for k, v in self.headers.items()
+                   if k.lower() not in _SKIP_HEADERS}
+        headers["Content-Type"] = "application/json"
+        tried: list[str] = []
+        last: Optional[tuple] = None
+        for attempt in (0, 1):
+            slot = state.pick(exclude=tried)
+            if slot is None:
+                break
+            tried.append(slot.name)
+            if attempt == 1:
+                state.count("dryad_fleet_retry_total",
+                            "Requests retried on a second replica")
+            slot.inflight_inc()
+            if not slot.routable:
+                # closed the pick->inc window: a drain (rolling swap) or
+                # health flip between pick() and the in-flight mark must
+                # not slip this request onto the slot — the drain's
+                # inflight==0 wait reads the count AFTER the flag
+                slot.inflight_dec()
+                continue
+            try:
+                conn = http.client.HTTPConnection(
+                    slot.proc.host, slot.proc.port,
+                    timeout=state.request_timeout_s)
+                try:
+                    conn.request("POST", "/predict", body=body,
+                                 headers=headers)
+                    resp = conn.getresponse()
+                    status, payload = resp.status, resp.read()
+                finally:
+                    conn.close()
+            except (OSError, http.client.HTTPException, socket.timeout):
+                state.count("dryad_fleet_upstream_error_total",
+                            "Forwards that died on the wire",
+                            replica=slot.name)
+                last = (502, json.dumps(
+                    {"error": f"replica {slot.name} unreachable"}).encode(),
+                    slot.name)
+                continue
+            finally:
+                slot.inflight_dec()
+            if status in RETRYABLE_STATUSES:
+                state.count("dryad_fleet_upstream_5xx_total",
+                            "5xx answers from replicas",
+                            replica=slot.name)
+                last = (status, payload, slot.name)
+                continue
+            return status, payload, slot.name
+        if last is not None:
+            return last
+        return None, b"", None
+
+
+def make_fleet_router(supervisor, host: str = "127.0.0.1", port: int = 0, *,
+                      registry: Optional[Registry] = None,
+                      max_inflight: int = 64,
+                      bulk_max_inflight: Optional[int] = None,
+                      model_caps: Optional[dict] = None,
+                      request_timeout_s: float = 30.0,
+                      min_healthy: int = 1,
+                      auth_token: Optional[str] = None,
+                      verbose: bool = False) -> ThreadingHTTPServer:
+    """Bind the fleet router (port 0 picks a free one; read it back from
+    ``httpd.server_address``); the caller runs ``serve_forever()`` /
+    ``shutdown()``, exactly like ``serve.http.make_http_server``."""
+    httpd = ThreadingHTTPServer((host, port), _Handler)
+    httpd.daemon_threads = True
+    httpd.verbose = verbose
+    httpd.state = _RouterState(
+        supervisor, registry=registry, max_inflight=max_inflight,
+        bulk_max_inflight=bulk_max_inflight, model_caps=model_caps,
+        request_timeout_s=request_timeout_s, min_healthy=min_healthy,
+        auth_token=auth_token)
+    return httpd
+
+
+class FleetRouter:
+    """Bind-and-serve wrapper around ``make_fleet_router`` (the shape of
+    ``obs.exporter.MetricsExporter``): ``start()`` serves on a daemon
+    thread, ``stop()`` shuts down; tests and the fleet bench drive it
+    in-process."""
+
+    def __init__(self, supervisor, host: str = "127.0.0.1", port: int = 0,
+                 **kw):
+        self._args = (supervisor, host, port)
+        self._kw = kw
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def host(self) -> str:
+        return self._httpd.server_address[0] if self._httpd else self._args[1]
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1] if self._httpd else self._args[2]
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def start(self) -> "FleetRouter":
+        if self._httpd is not None:
+            return self
+        supervisor, host, port = self._args
+        self._httpd = make_fleet_router(supervisor, host, port, **self._kw)
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True,
+                                        name="dryad-fleet-router")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._httpd is None:
+            return
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._httpd = None
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    def __enter__(self) -> "FleetRouter":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+
+def main_loop(httpd: ThreadingHTTPServer, quiet: bool = False) -> None:
+    """Foreground serve_forever with a clean KeyboardInterrupt exit (the
+    CLI's inner loop; split out so tests can cover the construction
+    without serving)."""
+    try:
+        httpd.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        httpd.server_close()
+        if not quiet:
+            print("fleet router stopped", file=sys.stderr)

@@ -1,0 +1,474 @@
+"""Replica lifecycle supervision: spawn N, probe, respawn, swap.
+
+The training supervisor (resilience/supervisor.py) survives device faults
+by classify -> degrade -> resume; this is its serving twin.  The fault
+surface is different — a replica is a PROCESS, so death is an exit code
+and sickness is a failing ``/healthz`` — but the policy machinery is
+deliberately the same ``RetryPolicy`` (budgeted retries, exponential
+backoff) and the same append-only ``RunJournal``, so a fleet incident
+reads exactly like a training incident: a stream of classified events
+with every decision on the record.
+
+Detection model (the monitor thread, one pass per ``probe_interval_s``):
+
+* **crash** — ``proc.poll()`` returns an exit code.  Respawn under the
+  budget.  An injected ``replica_crash`` drill dies with the recorded
+  ``REPLICA_CRASH_EXIT`` so tests can tell drills from real bugs.
+* **hang / slow health** — the process is alive but ``/healthz`` times
+  out or refuses.  ``unhealthy_after`` consecutive bad probes take the
+  replica out of routing (the cheap, reversible remedy — the router
+  simply stops picking it); ``recycle_after`` consecutive bad probes
+  kill + respawn it (the expensive remedy, same budget as a crash).
+* **stuck-503** — ``/healthz`` ANSWERS, but 503 (the serve tripwire
+  latched, or the ``reject_503`` drill): same ladder — out of routing
+  first, recycled if it never recovers.  A 503 that clears (e.g. the
+  deploy-window recompile case) costs only the routing pause.
+
+Respawn budget is PER SLOT: ``policy.retry_budget`` respawns, backoff
+``policy.backoff_s(n)`` between attempts, then the slot FAILS CLOSED
+(journaled; the rest of the fleet keeps serving — shared-nothing means
+one bad slot never takes the pool down).  Drill faults ride the spawn
+environment for generation 0 only: a respawned replica is clean, so a
+crash drill proves exactly one death + one recovery.
+
+``rolling_push`` is the zero-drop deploy: replica by replica it DRAINS
+(router stops routing to the slot, in-flight requests finish at the
+version they resolved — per-process pinning is serve/registry.py's
+submit-time contract), then loads + activates the new model through the
+replica's own ``/models/load``, waits for health, and restores routing.
+In-flight requests are never cut: a drain that cannot reach zero within
+``drain_timeout_s`` ABORTS that replica's swap (old model keeps serving)
+rather than dropping work.  NOTE a later respawn re-runs the spawn argv,
+so a respawned replica comes back with the spawn-time model set — ship a
+push by also updating the argv the supervisor was built with (the CLI
+does this by restarting the fleet on the new path).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Optional
+
+from dryad_tpu.fleet.replica import ReplicaProcess, ReplicaStartupError
+from dryad_tpu.obs.registry import Registry, default_registry
+from dryad_tpu.resilience.faults import REPLICA_FAULTS_ENV
+from dryad_tpu.resilience.journal import RunJournal
+from dryad_tpu.resilience.policy import RetryPolicy
+
+
+class ReplicaSlot:
+    """One position in the fleet: the live process (across respawns) plus
+    the routing state the router reads.  ``inflight`` is the router's
+    in-flight request count against this slot — the drain condition."""
+
+    def __init__(self, index: int):
+        self.index = index
+        self.name = f"r{index}"
+        self.proc: Optional[ReplicaProcess] = None
+        self.healthy = False
+        self.draining = False
+        self.recovering = False
+        self.fail_closed = False
+        self.generation = 0
+        self.respawns = 0
+        self.consecutive_bad = 0
+        self.last_status: Optional[int] = None
+        self._inflight = 0
+        self._lock = threading.Lock()
+
+    @property
+    def inflight(self) -> int:
+        with self._lock:
+            return self._inflight
+
+    def inflight_inc(self) -> None:
+        with self._lock:
+            self._inflight += 1
+
+    def inflight_dec(self) -> None:
+        with self._lock:
+            self._inflight -= 1
+
+    @property
+    def routable(self) -> bool:
+        """Whether the router may pick this slot for a new request."""
+        return (self.healthy and not self.draining and not self.fail_closed
+                and self.proc is not None and self.proc.alive)
+
+    def state(self) -> dict:
+        """The observability view (/healthz + /stats on the router)."""
+        return {
+            "healthy": self.healthy, "draining": self.draining,
+            "fail_closed": self.fail_closed, "generation": self.generation,
+            "respawns": self.respawns, "inflight": self.inflight,
+            "alive": self.proc is not None and self.proc.alive,
+            "url": (self.proc.url if self.proc is not None
+                    and self.proc.host is not None else None),
+        }
+
+
+class FleetSupervisor:
+    """Own ``n_replicas`` serve processes; keep them alive and swappable.
+
+    ``make_argv(index, port_file)`` builds each replica's command line
+    (``fleet.replica.serve_argv`` for production; tests pass a stub).
+    ``fault_env`` maps replica index -> a ``DRYAD_REPLICA_FAULTS`` spec
+    string armed for that replica's FIRST generation only (drills).
+    ``journal`` takes a path (owned/closed here) or an open RunJournal,
+    exactly like ``supervise_train``.
+    """
+
+    def __init__(self, make_argv, n_replicas: int, *,
+                 policy: Optional[RetryPolicy] = None,
+                 journal: "RunJournal | str | None" = None,
+                 registry: Optional[Registry] = None,
+                 probe_interval_s: float = 0.25,
+                 probe_timeout_s: float = 2.0,
+                 unhealthy_after: int = 2,
+                 recycle_after: int = 8,
+                 startup_timeout_s: float = 60.0,
+                 fault_env: Optional[dict] = None,
+                 log_dir: Optional[str] = None):
+        if n_replicas < 1:
+            raise ValueError("a fleet needs at least one replica")
+        if recycle_after < unhealthy_after:
+            raise ValueError("recycle_after must be >= unhealthy_after "
+                             "(out-of-routing is the first rung)")
+        self.make_argv = make_argv
+        self.policy = policy or RetryPolicy()
+        self.probe_interval_s = float(probe_interval_s)
+        self.probe_timeout_s = float(probe_timeout_s)
+        self.unhealthy_after = int(unhealthy_after)
+        self.recycle_after = int(recycle_after)
+        self.startup_timeout_s = float(startup_timeout_s)
+        self.fault_env = dict(fault_env or {})
+        self.log_dir = log_dir
+        self.slots = [ReplicaSlot(i) for i in range(int(n_replicas))]
+        self._registry = registry
+        self._own_journal = isinstance(journal, (str, os.PathLike))
+        self._journal = (RunJournal(os.fspath(journal)) if self._own_journal
+                         else journal)
+        self._stop = threading.Event()
+        self._monitor: Optional[threading.Thread] = None
+        self._swap_lock = threading.Lock()
+        self._journal_lock = threading.Lock()
+        self._recoveries: list[threading.Thread] = []
+
+    # ---- plumbing ----------------------------------------------------------
+    def _reg(self) -> Registry:
+        return (self._registry if self._registry is not None
+                else default_registry())
+
+    def _event(self, kind: str, /, **fields) -> None:
+        # recovery threads journal concurrently with the monitor — one
+        # lock keeps event lines whole (and guards the close in stop())
+        with self._journal_lock:
+            if self._journal is not None:
+                self._journal.event(kind, **fields)
+
+    def _gauge_healthy(self, slot: ReplicaSlot) -> None:
+        reg = self._reg()
+        if reg.enabled:
+            reg.gauge("dryad_fleet_replica_healthy",
+                      "1 while the replica is in routing").labels(
+                replica=slot.name).set(1 if slot.routable else 0)
+
+    def _count(self, name: str, help: str, slot: ReplicaSlot) -> None:
+        reg = self._reg()
+        if reg.enabled:
+            reg.counter(name, help).labels(replica=slot.name).inc()
+
+    # ---- lifecycle ---------------------------------------------------------
+    def start(self) -> "FleetSupervisor":
+        self._event("fleet_start", replicas=len(self.slots),
+                    retry_budget=self.policy.retry_budget,
+                    probe_interval_s=self.probe_interval_s)
+        for slot in self.slots:
+            if not self._spawn(slot, first=True):
+                # budget burned before the slot ever served: fail closed
+                # and keep bringing up the REST of the fleet
+                continue
+        if not any(s.routable for s in self.slots):
+            self.stop()
+            raise ReplicaStartupError("no replica became ready at fleet "
+                                      "start (see the journal / logs)")
+        self._monitor = threading.Thread(target=self._monitor_loop,
+                                         daemon=True,
+                                         name="dryad-fleet-monitor")
+        self._monitor.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._monitor is not None:
+            self._monitor.join(timeout=5.0)
+            self._monitor = None
+        # terminate children FIRST: a recovery thread mid-ready-wait sees
+        # its child die, raises, observes _stop, and exits — then the
+        # joins below converge instead of waiting out a startup timeout
+        for slot in self.slots:
+            if slot.proc is not None:
+                slot.proc.stop()
+            slot.healthy = False
+            self._gauge_healthy(slot)
+        for t in self._recoveries:
+            t.join(timeout=5.0)
+        self._recoveries = []
+        # one more sweep: a recovery thread may have spawned a replica
+        # between the first sweep and its _stop check
+        for slot in self.slots:
+            if slot.proc is not None:
+                slot.proc.stop()
+        self._event("fleet_stop",
+                    respawns=sum(s.respawns for s in self.slots))
+        with self._journal_lock:
+            if self._own_journal and self._journal is not None:
+                self._journal.close()
+                self._journal = None
+
+    def __enter__(self) -> "FleetSupervisor":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # ---- spawn / recover ---------------------------------------------------
+    def _spawn_env(self, slot: ReplicaSlot) -> dict:
+        """Drill faults arm generation 0 ONLY: a respawned replica is
+        clean, so one crash drill proves one death + one recovery instead
+        of a crash loop that burns the budget.  The override is ALWAYS
+        returned (empty when not arming) because replicas inherit this
+        process's environment — a DRYAD_REPLICA_FAULTS set on the fleet
+        process itself would otherwise re-arm EVERY generation and turn
+        one drill into a budget-exhausting fleet outage; supervisor-owned
+        replicas take drills only through ``fault_env``."""
+        if slot.generation == 0 and slot.index in self.fault_env:
+            return {REPLICA_FAULTS_ENV: self.fault_env[slot.index]}
+        return {REPLICA_FAULTS_ENV: ""}
+
+    def _spawn(self, slot: ReplicaSlot, first: bool = False) -> bool:
+        """Spawn (or respawn) the slot's process; on startup failure keep
+        retrying under the slot's budget.  True when the slot serves."""
+        while True:
+            if self._stop.is_set():
+                # a fleet stop() mid-recovery must not leak a fresh
+                # subprocess the teardown loop will never see
+                return False
+            self._event("replica_spawn", replica=slot.name,
+                        generation=slot.generation, first=first)
+            proc = ReplicaProcess(
+                lambda pf: self.make_argv(slot.index, pf),
+                name=f"{slot.name}g{slot.generation}",
+                env=self._spawn_env(slot),
+                startup_timeout_s=self.startup_timeout_s,
+                log_dir=self.log_dir)
+            # registered on the slot BEFORE the (long) ready wait: a fleet
+            # stop() terminates this child even while it is still paying
+            # its jax import — the slot is not routable until healthy
+            # flips below, so nothing routes to the half-born process
+            slot.proc = proc
+            try:
+                proc.start()
+            except ReplicaStartupError as e:
+                self._event("replica_spawn_failed", replica=slot.name,
+                            generation=slot.generation,
+                            exit_code=e.exit_code, message=str(e)[:300])
+                proc.stop()
+                if not self._charge_budget(slot):
+                    return False
+                continue
+            if self._stop.is_set():
+                proc.stop()
+                return False
+            slot.healthy = True
+            slot.consecutive_bad = 0
+            slot.last_status = 200
+            self._gauge_healthy(slot)
+            self._event("replica_ready", replica=slot.name,
+                        generation=slot.generation, url=proc.url)
+            return True
+
+    def _charge_budget(self, slot: ReplicaSlot) -> bool:
+        """One respawn attempt against the slot's budget; sleeps the
+        backoff.  False (and fail-closed) when the budget is exhausted."""
+        slot.respawns += 1
+        if slot.respawns > self.policy.retry_budget:
+            slot.fail_closed = True
+            slot.healthy = False
+            self._gauge_healthy(slot)
+            self._event("replica_fail_closed", replica=slot.name,
+                        reason="retry_budget_exhausted",
+                        respawns=slot.respawns - 1)
+            return False
+        sleep_s = self.policy.backoff_s(slot.respawns - 1)
+        self._event("replica_backoff", replica=slot.name,
+                    attempt=slot.respawns, sleep_s=sleep_s)
+        if sleep_s > 0:
+            # interruptible: a fleet stop() must not wait out a backoff
+            self._stop.wait(sleep_s)
+        slot.generation += 1
+        return True
+
+    def _recover(self, slot: ReplicaSlot, reason: str,
+                 exit_code: Optional[int] = None) -> None:
+        self._count("dryad_fleet_respawn_total",
+                    "Replica respawns by the fleet supervisor", slot)
+        slot.healthy = False
+        self._gauge_healthy(slot)
+        if slot.proc is not None:
+            slot.proc.stop()
+        self._event("replica_respawn", replica=slot.name, reason=reason,
+                    exit_code=exit_code, generation=slot.generation)
+        if self._charge_budget(slot):
+            self._spawn(slot)
+
+    def _recover_async(self, slot: ReplicaSlot, reason: str,
+                       exit_code: Optional[int] = None) -> None:
+        """Run the (slow: backoff + spawn + ready wait) recovery on its
+        own thread so the monitor keeps probing the OTHER slots — a
+        second failure during one slot's recovery must still be detected
+        and taken out of routing.  ``slot.recovering`` keeps the monitor
+        from double-dispatching the same slot."""
+        slot.recovering = True
+
+        def run() -> None:
+            try:
+                self._recover(slot, reason, exit_code=exit_code)
+            finally:
+                slot.recovering = False
+
+        t = threading.Thread(target=run, daemon=True,
+                             name=f"dryad-fleet-recover-{slot.name}")
+        self._recoveries.append(t)
+        self._recoveries = [x for x in self._recoveries
+                            if x.is_alive() or x is t]
+        t.start()
+
+    # ---- monitor -----------------------------------------------------------
+    def _monitor_loop(self) -> None:
+        while not self._stop.wait(self.probe_interval_s):
+            for slot in self.slots:
+                if slot.fail_closed or slot.recovering or slot.proc is None:
+                    continue
+                if self._stop.is_set():
+                    return
+                code = slot.proc.poll()
+                if code is not None:
+                    self._count("dryad_fleet_crash_total",
+                                "Replica processes found dead", slot)
+                    self._event("replica_crash", replica=slot.name,
+                                exit_code=code, generation=slot.generation)
+                    self._recover_async(slot, "crash", exit_code=code)
+                    continue
+                status, _latency = slot.proc.health(
+                    timeout_s=self.probe_timeout_s)
+                slot.last_status = status
+                if status == 200:
+                    if not slot.healthy:
+                        self._event("replica_recovered", replica=slot.name,
+                                    generation=slot.generation)
+                    slot.healthy = True
+                    slot.consecutive_bad = 0
+                    self._gauge_healthy(slot)
+                    continue
+                # alive but sick: probe timeout/refused (None) or a 503
+                slot.consecutive_bad += 1
+                if (slot.consecutive_bad == self.unhealthy_after
+                        and slot.healthy):
+                    slot.healthy = False
+                    self._gauge_healthy(slot)
+                    self._event("replica_unhealthy", replica=slot.name,
+                                status=status,
+                                consecutive=slot.consecutive_bad)
+                if slot.consecutive_bad >= self.recycle_after:
+                    self._count("dryad_fleet_recycle_total",
+                                "Hung/stuck replicas killed and respawned",
+                                slot)
+                    self._event("replica_hang", replica=slot.name,
+                                status=status,
+                                consecutive=slot.consecutive_bad)
+                    slot.consecutive_bad = 0
+                    self._recover_async(slot, "hang")
+
+    # ---- routing / observability views -------------------------------------
+    def routable_slots(self) -> list[ReplicaSlot]:
+        return [s for s in self.slots if s.routable]
+
+    def fleet_ok(self, min_healthy: int = 1) -> bool:
+        return len(self.routable_slots()) >= int(min_healthy)
+
+    def states(self) -> dict:
+        return {s.name: s.state() for s in self.slots}
+
+    # ---- rolling model push -------------------------------------------------
+    def rolling_push(self, path: str, *, name: Optional[str] = None,
+                     activate: bool = True,
+                     drain_timeout_s: float = 30.0,
+                     load_timeout_s: float = 120.0,
+                     auth_token: Optional[str] = None) -> dict:
+        """Push ``path`` replica by replica with a version-pinned drain.
+
+        Per replica: stop routing to it (``draining``), wait for its
+        in-flight count to reach zero (those requests complete at the
+        version they resolved at submit — serve pins versions, so a swap
+        can never change a queued request), POST ``/models/load`` through
+        the replica's own registry (hot-swap + rollback stay available
+        per process), wait for health, restore routing.  Replicas swap
+        ONE at a time, so the rest of the pool serves throughout.
+
+        Returns ``{"versions": {replica: version}, "errors": {replica:
+        reason}, "skipped": [replica, ...]}``; a drain timeout or load
+        failure aborts THAT replica's swap (it keeps serving the old
+        model) and the push continues — zero in-flight requests are
+        dropped in every outcome.
+        """
+        with self._swap_lock:
+            versions: dict = {}
+            errors: dict = {}
+            skipped: list = []
+            self._event("push_start", path=path, name=name,
+                        activate=bool(activate))
+            for slot in self.slots:
+                if not slot.routable:
+                    skipped.append(slot.name)
+                    continue
+                self._event("replica_drain", replica=slot.name,
+                            inflight=slot.inflight)
+                slot.draining = True
+                self._gauge_healthy(slot)
+                try:
+                    deadline = time.monotonic() + float(drain_timeout_s)
+                    while slot.inflight > 0:
+                        if time.monotonic() > deadline:
+                            raise TimeoutError(
+                                f"drain timed out with {slot.inflight} "
+                                "in flight")
+                        time.sleep(0.002)
+                    version = slot.proc.load_model(
+                        path, name=name, activate=activate,
+                        auth_token=auth_token, timeout_s=load_timeout_s)
+                    status, _ = slot.proc.health(
+                        timeout_s=self.probe_timeout_s)
+                    if status != 200:
+                        raise RuntimeError(
+                            f"post-swap health probe answered {status}")
+                    versions[slot.name] = version
+                    self._event("replica_swapped", replica=slot.name,
+                                version=version)
+                except Exception as e:  # noqa: BLE001 — per-replica verdict
+                    errors[slot.name] = repr(e)
+                    self._event("replica_swap_failed", replica=slot.name,
+                                message=str(e)[:300])
+                finally:
+                    slot.draining = False
+                    self._gauge_healthy(slot)
+            reg = self._reg()
+            if reg.enabled:
+                reg.counter("dryad_fleet_push_total",
+                            "Rolling model pushes").inc()
+            self._event("push_complete", swapped=sorted(versions),
+                        errors=sorted(errors), skipped=skipped)
+            return {"versions": versions, "errors": errors,
+                    "skipped": skipped}

@@ -60,6 +60,10 @@ STAGE_SPREAD_PREFIX = "stage_spread_"
 HIGHER_BETTER = frozenset({
     "value", "vs_baseline", "final_train_auc", "iters_per_sec_10m",
     "rows_per_s", "requests_per_s", "pipeline_speedup",
+    # r14 fleet arm (scripts/bench_serve.py --fleet): closed-loop rows/s
+    # through the router at N replicas, and the N-vs-1 scaling ratios
+    "fleet_rows_per_s_n1", "fleet_rows_per_s_n2", "fleet_rows_per_s_n4",
+    "fleet_scaling_n2", "fleet_scaling_n4",
 })
 LOWER_BETTER = frozenset({
     "marginal_s_per_iter_10m", "wall_2tree_10m", "wall_8tree_10m",
@@ -84,6 +88,12 @@ _SPREAD_FIELDS = {
     "obs_overhead_ms": ("obs_overhead_spread",),
     "obs_overhead_pct": ("obs_overhead_spread",),
     "rows_per_s": ("spread_rows_per_s",),
+    "fleet_rows_per_s_n1": ("fleet_spread_n1",),
+    "fleet_rows_per_s_n2": ("fleet_spread_n2",),
+    "fleet_rows_per_s_n4": ("fleet_spread_n4",),
+    # the ratios inherit both arms' capture quality
+    "fleet_scaling_n2": ("fleet_spread_n1", "fleet_spread_n2"),
+    "fleet_scaling_n4": ("fleet_spread_n1", "fleet_spread_n4"),
 }
 
 _ROUND_RE = re.compile(r"_r0*(\d+)\.json$")

@@ -18,10 +18,16 @@ from dryad_tpu.resilience.faults import (
     FETCH_DEATH,
     OOM,
     PREEMPTION,
+    REJECT_503,
+    REPLICA_CRASH,
+    REPLICA_CRASH_EXIT,
+    REPLICA_KINDS,
     RETRYABLE,
+    SLOW_HEALTH,
     UNKNOWN,
     FaultInjector,
     FaultPoint,
+    InjectedReject,
     classify_fault,
     make_fault,
 )
@@ -31,7 +37,9 @@ from dryad_tpu.resilience.supervisor import FaultError, supervise_train
 
 __all__ = [
     "DEVICE_UNAVAILABLE", "FETCH_DEATH", "OOM", "PREEMPTION", "RETRYABLE",
-    "UNKNOWN", "FaultInjector", "FaultPoint", "classify_fault", "make_fault",
+    "REJECT_503", "REPLICA_CRASH", "REPLICA_CRASH_EXIT", "REPLICA_KINDS",
+    "SLOW_HEALTH", "UNKNOWN", "FaultInjector", "FaultPoint", "InjectedReject",
+    "classify_fault", "make_fault",
     "RunJournal", "ChunkCapPolicy", "RetryPolicy", "FaultError",
     "supervise_train",
 ]

@@ -31,6 +31,27 @@ errors classify identically.
 classes at configured (site, iteration) points, so every resilience path
 runs under ``JAX_PLATFORMS=cpu`` in tier-1.  Not passing one costs
 nothing — the trainers skip the hook entirely when it is None.
+
+Replica-level kinds (r14, the serving-fleet drills): the same injector
+shape doubles as a SERVE-process hook — the HTTP front end calls it at
+``("request", n)`` per /predict and ``("health", n)`` per /healthz probe
+(serve/http.py), with the points wired through the environment
+(``DRYAD_REPLICA_FAULTS``; encode/decode below) so a fleet supervisor can
+arm drills in subprocess replicas it spawns:
+
+* **replica_crash** — the process hard-exits (``os._exit(REPLICA_CRASH_EXIT)``,
+  no cleanup) at the configured point: the deterministic twin of a
+  segfault/OOM-kill, used to test crash detection + respawn.
+* **slow_health** — the hook sleeps ``stall_s`` at the point (usually the
+  ``health`` site) and then proceeds: a probe that exceeds its timeout,
+  the hang-detection twin.
+* **reject_503** — raises ``InjectedReject``, which the HTTP front end
+  maps to a 503 answer at that site (a replica stuck shedding, the
+  stuck-503 twin).  Mark the point ``sticky`` for the latched form.
+
+These are injection KINDS, not classification classes: ``classify_fault``
+never returns them (a fleet supervisor observes replica death through the
+process exit code / probe, not through a raised exception).
 """
 
 from __future__ import annotations
@@ -50,11 +71,30 @@ UNKNOWN = "unknown"
 #: watch_fetch bracket, so the in-flight age gauge sees the hang)
 STALL = "stall"
 
+#: replica-level injection KINDS (r14; see module docstring) — executed by
+#: the injector / the serve HTTP front end, never returned by classify_fault
+REPLICA_CRASH = "replica_crash"
+SLOW_HEALTH = "slow_health"
+REJECT_503 = "reject_503"
+REPLICA_KINDS = (REPLICA_CRASH, SLOW_HEALTH, REJECT_503)
+#: the exit code an injected replica_crash dies with — fleet tests and the
+#: ci smoke identify the injected death by it (any OTHER nonzero exit in a
+#: drill is a real bug, not the drill)
+REPLICA_CRASH_EXIT = 23
+
 #: classes the supervisor may retry; UNKNOWN always fails closed
 RETRYABLE = (FETCH_DEATH, DEVICE_UNAVAILABLE, OOM, PREEMPTION)
 
 #: the site vocabulary of the trainers' chunk_hook
 SITES = ("dispatch", "fetch")
+#: the site vocabulary of the serve front end's replica fault hook
+REPLICA_SITES = ("request", "health")
+
+
+class InjectedReject(RuntimeError):
+    """The REJECT_503 drill: the HTTP front end answers 503 at this site.
+    Deliberately NOT classifiable (classify_fault -> UNKNOWN): a drilled
+    rejection must never be mistaken for a recorded tunnel fault class."""
 
 _OOM_PAT = re.compile(r"RESOURCE_EXHAUSTED|out of memory|hbm.*exceeds",
                       re.IGNORECASE)
@@ -144,21 +184,41 @@ class FaultPoint:
     """One configured injection: fire at the FIRST chunk-hook event with
     ``site`` at/after ``iteration`` (>=, not ==: chunked dispatch only
     visits chunk-start iterations, so an exact match could never hit).
-    ``kind=STALL`` sleeps ``stall_s`` seconds at the hook instead of
-    raising (the hung-fetch twin; the run then proceeds normally)."""
+    ``kind=STALL``/``SLOW_HEALTH`` sleeps ``stall_s`` seconds at the hook
+    instead of raising (the hung-fetch / slow-probe twins; the run then
+    proceeds normally).  ``sticky=True`` keeps the point armed after it
+    fires — the latched form the stuck-503 drill needs (a replica that
+    sheds ONE request is a blip; one that sheds every request from a
+    point on is the recorded failure shape)."""
 
     iteration: int
     kind: str = DEVICE_UNAVAILABLE
     site: str = "dispatch"
     stall_s: float = 0.0
+    sticky: bool = False
 
     def __post_init__(self):
-        if self.site not in SITES:
-            raise ValueError(f"site must be one of {SITES}, got {self.site!r}")
-        if self.kind != STALL and self.kind not in _CANONICAL_MSG:
+        if self.site not in SITES + REPLICA_SITES:
+            raise ValueError(f"site must be one of {SITES + REPLICA_SITES}, "
+                             f"got {self.site!r}")
+        if (self.kind not in (STALL,) + REPLICA_KINDS
+                and self.kind not in _CANONICAL_MSG):
             raise ValueError(f"unknown fault kind {self.kind!r}")
-        if self.kind == STALL and self.stall_s <= 0:
-            raise ValueError("a STALL point needs stall_s > 0")
+        # kinds and sites partition strictly: a replica kind at a trainer
+        # site would never fire (or worse, os._exit a training run), and a
+        # tunnel class at a replica site decodes cleanly but arms nothing —
+        # both are the silent-typo'd-drill shape that must fail loudly
+        if self.kind in REPLICA_KINDS and self.site not in REPLICA_SITES:
+            raise ValueError(
+                f"replica fault kind {self.kind!r} fires only at replica "
+                f"sites {REPLICA_SITES}, got site {self.site!r}")
+        if self.kind not in REPLICA_KINDS and self.site in REPLICA_SITES:
+            raise ValueError(
+                f"fault kind {self.kind!r} is a trainer class and never "
+                f"fires at replica site {self.site!r}; use one of "
+                f"{REPLICA_KINDS}")
+        if self.kind in (STALL, SLOW_HEALTH) and self.stall_s <= 0:
+            raise ValueError(f"a {self.kind} point needs stall_s > 0")
 
 
 class FaultInjector:
@@ -171,28 +231,131 @@ class FaultInjector:
     """
 
     def __init__(self, points):
+        import threading
+
         self.points = [p if isinstance(p, FaultPoint) else FaultPoint(*p)
                        for p in points]
         self._armed = [True] * len(self.points)
         self.fired: list[dict] = []
+        # the serve front end calls the hook from ThreadingHTTPServer
+        # handler threads: the armed check-and-clear must be atomic or a
+        # one-shot drill fires once per in-flight request (the trainer
+        # path is single-threaded and pays one uncontended acquire)
+        self._lock = threading.Lock()
 
     def __call__(self, site: str, iteration: int) -> None:
-        for i, pt in enumerate(self.points):
-            if self._armed[i] and site == pt.site and iteration >= pt.iteration:
-                self._armed[i] = False
-                self.fired.append({"point": i, "site": site,
-                                   "iteration": int(iteration),
-                                   "kind": pt.kind})
-                if pt.kind == STALL:
-                    # a hang, not a death: hold the hook (inside the
-                    # trainer's watch_fetch bracket) so the watchdog sees
-                    # the in-flight age rise, then let the run continue
-                    import time
+        to_fire: list[FaultPoint] = []
+        with self._lock:
+            for i, pt in enumerate(self.points):
+                if (self._armed[i] and site == pt.site
+                        and iteration >= pt.iteration):
+                    if not pt.sticky:
+                        self._armed[i] = False
+                    self.fired.append({"point": i, "site": site,
+                                       "iteration": int(iteration),
+                                       "kind": pt.kind})
+                    to_fire.append(pt)
+                    if pt.kind not in (STALL, SLOW_HEALTH):
+                        # a raising/exiting point ends THIS call's scan:
+                        # later points stay armed for later events (three
+                        # identical points = three successive faults, the
+                        # repeated-same-point drill)
+                        break
+        # actions run OUTSIDE the lock: a SLOW_HEALTH sleep must stall
+        # only its own probe, never serialize concurrent injections
+        for pt in to_fire:
+            if pt.kind in (STALL, SLOW_HEALTH):
+                # a hang, not a death: hold the hook (inside the
+                # trainer's watch_fetch bracket / the replica's probe
+                # handler) so the watcher sees the latency rise, then
+                # let the run continue
+                import time
 
-                    time.sleep(pt.stall_s)
-                    continue
-                raise make_fault(pt.kind)
+                time.sleep(pt.stall_s)
+                continue
+            if pt.kind == REPLICA_CRASH:
+                # the deterministic twin of a segfault/OOM-kill: no
+                # atexit, no flushes — the fleet supervisor must see
+                # exactly what a real crash leaves behind
+                import os
+
+                os._exit(REPLICA_CRASH_EXIT)
+            if pt.kind == REJECT_503:
+                raise InjectedReject(
+                    f"injected 503 rejection at {site} #{iteration}")
+            raise make_fault(pt.kind)
 
     @property
     def pending(self) -> int:
-        return sum(self._armed)
+        with self._lock:
+            return sum(self._armed)
+
+
+# ---------------------------------------------------------------------------
+# environment wire format (fleet drills -> subprocess replicas)
+#
+# A fleet supervisor arms drills in replicas it SPAWNS, so the points must
+# survive an exec boundary: one env var, ``DRYAD_REPLICA_FAULTS``, holding
+# comma-separated ``site:iteration:kind[:stall_s][:sticky]`` specs —
+# e.g. ``request:3:replica_crash`` or ``health:1:slow_health:6.0:sticky``.
+# The serve CLI decodes it at startup and threads the injector into the
+# HTTP front end's fault hook; an absent/empty var costs nothing.
+
+REPLICA_FAULTS_ENV = "DRYAD_REPLICA_FAULTS"
+
+
+def encode_points(points) -> str:
+    """``FaultPoint``s (or their tuple spellings) -> the env-var string."""
+    specs = []
+    for p in points:
+        if not isinstance(p, FaultPoint):
+            p = FaultPoint(*p)
+        spec = f"{p.site}:{p.iteration}:{p.kind}"
+        if p.stall_s:
+            spec += f":{p.stall_s}"
+        if p.sticky:
+            spec += ":sticky" if p.stall_s else ":0:sticky"
+        specs.append(spec)
+    return ",".join(specs)
+
+
+def decode_points(value: str) -> list[FaultPoint]:
+    """The env-var string -> validated ``FaultPoint``s (raises ValueError
+    on malformed specs: a typo'd drill must fail loudly at replica start,
+    not silently arm nothing)."""
+    points = []
+    for spec in (value or "").split(","):
+        spec = spec.strip()
+        if not spec:
+            continue
+        parts = spec.split(":")
+        if len(parts) < 3:
+            raise ValueError(f"malformed replica fault spec {spec!r} "
+                             "(want site:iteration:kind[:stall_s][:sticky])")
+        sticky = False
+        if parts[-1] == "sticky":
+            sticky = True
+            parts = parts[:-1]
+        if len(parts) > 4:
+            # a misspelt "sticky" (or any extra token) must not silently
+            # arm the non-latched form of the drill
+            raise ValueError(
+                f"malformed replica fault spec {spec!r}: unrecognized "
+                f"trailing field {parts[4]!r} "
+                "(want site:iteration:kind[:stall_s][:sticky])")
+        stall_s = float(parts[3]) if len(parts) > 3 else 0.0
+        points.append(FaultPoint(site=parts[0], iteration=int(parts[1]),
+                                 kind=parts[2], stall_s=stall_s,
+                                 sticky=sticky))
+    return points
+
+
+def injector_from_env(environ=None) -> "FaultInjector | None":
+    """Build the replica's injector from ``DRYAD_REPLICA_FAULTS`` (None
+    when unset/empty — the production path)."""
+    import os
+
+    value = (environ if environ is not None else os.environ).get(
+        REPLICA_FAULTS_ENV, "")
+    points = decode_points(value)
+    return FaultInjector(points) if points else None

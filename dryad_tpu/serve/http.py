@@ -51,11 +51,28 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 import numpy as np
 
 from dryad_tpu.obs.registry import default_registry
+from dryad_tpu.resilience.faults import InjectedReject
 from dryad_tpu.serve.batcher import ServeOverloaded, ServeTimeout
 
 
 class _Handler(BaseHTTPRequestHandler):
     # the PredictServer rides on the HTTP server object (see make_http_server)
+
+    def _fire_fault(self, site: str) -> None:
+        """The replica fault-drill hook (resilience/faults.py, r14): the
+        fleet supervisor arms deterministic drills through the
+        DRYAD_REPLICA_FAULTS env var and the serve CLI threads the decoded
+        injector here.  Sites: one ``("request", n)`` per /predict, one
+        ``("health", n)`` per /healthz probe.  May raise InjectedReject
+        (mapped to 503 by the caller), sleep (slow_health), or hard-exit
+        the process (replica_crash).  No hook, no cost."""
+        hook = getattr(self.server, "fault_hook", None)
+        if hook is None:
+            return
+        with self.server.fault_lock:
+            n = self.server.fault_counts.get(site, 0) + 1
+            self.server.fault_counts[site] = n
+        hook(site, n)
     def _send(self, code: int, payload: dict) -> None:
         self._send_raw(code, json.dumps(payload).encode(), "application/json")
 
@@ -117,6 +134,14 @@ class _Handler(BaseHTTPRequestHandler):
             # an unexpected serve recompile after warmup (obs/tripwire.py)
             from dryad_tpu.obs.health import healthz_payload
 
+            try:
+                self._fire_fault("health")
+            except InjectedReject as e:
+                # the stuck-503 drill: a probe answer that LOOKS like a
+                # latched degradation, without touching real health state
+                self._send(503, {"ok": False, "degraded": ["injected"],
+                                 "error": str(e)})
+                return
             code, body = healthz_payload()
             self._send(code, body)
             return
@@ -145,6 +170,7 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             body = self._read_json()
             if self.path == "/predict":
+                self._fire_fault("request")
                 # resolve the entry up front: pre-binned rows must arrive in
                 # the model's bin dtype (not float), and the response must
                 # name the version that actually served — not whatever is
@@ -182,6 +208,9 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send(200, {"version": version})
             else:
                 self._send(404, {"error": f"unknown path {self.path}"})
+        except InjectedReject as e:
+            # the reject_503 drill answers exactly like queue overload
+            self._send(503, {"error": str(e)})
         except ServeOverloaded as e:
             self._send(503, {"error": str(e)})
         except ServeTimeout as e:
@@ -196,11 +225,14 @@ def make_http_server(predict_server, host: str = "127.0.0.1",
                      port: int = 8000, *, verbose: bool = False,
                      log_requests: bool = False,
                      log_stream=None, auth_token=None,
-                     obs_registry=None) -> ThreadingHTTPServer:
+                     obs_registry=None, fault_hook=None) -> ThreadingHTTPServer:
     """Bind (port 0 picks a free one: ``httpd.server_address``); caller
     runs ``serve_forever()`` / ``shutdown()``.  ``auth_token`` turns on
     bearer auth (``/healthz`` exempt); ``obs_registry`` backs ``/metrics``
-    (defaults to the process-wide registry serve already records into)."""
+    (defaults to the process-wide registry serve already records into);
+    ``fault_hook`` arms the replica fault drills (``resilience.faults``
+    injector shape — the fleet supervisor wires it via the
+    DRYAD_REPLICA_FAULTS env through the serve CLI)."""
     httpd = ThreadingHTTPServer((host, port), _Handler)
     httpd.predict_server = predict_server
     httpd.verbose = verbose
@@ -208,6 +240,9 @@ def make_http_server(predict_server, host: str = "127.0.0.1",
     httpd.log_stream = log_stream if log_stream is not None else sys.stderr
     httpd.log_lock = threading.Lock()
     httpd.auth_token = auth_token
+    httpd.fault_hook = fault_hook
+    httpd.fault_lock = threading.Lock()
+    httpd.fault_counts = {}
     httpd.obs_registry = (obs_registry if obs_registry is not None
                           else default_registry())
     predict_server.start()

@@ -19,6 +19,12 @@ Arms:
   --smoke     short CI mode: tiny model, short loops, exit 1 unless BOTH
               the bucketed and sharded arms report zero recompiles after
               warmup (scripts/ci.sh runs this)
+  --fleet     closed-loop fleet arm (r14, dryad_tpu/fleet/bench.py): REAL
+              subprocess replicas behind the router at N=1/2/4
+              (``fleet_rows_per_s_nN`` + spreads + ``fleet_scaling_nN``)
+              plus a rolling-swap drill under load (``fleet_swap_*``;
+              zero failed requests is the acceptance bar).  Standalone
+              mode: the in-process arms are skipped.
 
 Acceptance gate: a forced-CPU run must report
 ``recompiles_after_warmup: 0`` — the shape-bucketed cache makes warm
@@ -41,6 +47,72 @@ def _train_throwaway(n_rows: int = 4000, num_trees: int = 50):
     ds = dryad.Dataset(X, y, max_bins=64)
     return dryad.train(dict(objective="binary", num_trees=num_trees,
                             num_leaves=31, max_bins=64), ds, backend="cpu")
+
+
+def run_fleet_arm(args) -> int:
+    """The r14 fleet arm: spawn real serve replicas (they pay the jax
+    import; this process only drives HTTP), measure scaling + the
+    rolling-swap drill, stamp, and print the bench.py-format summary."""
+    import os
+    import tempfile
+
+    from dryad_tpu.fleet.bench import run_fleet_bench
+    from dryad_tpu.obs.trends import artifact_stamp
+
+    tmpdir = None
+    if args.model:
+        model_path = args.model
+        from dryad_tpu.booster import Booster
+
+        booster = Booster.load_any(model_path)
+    else:
+        booster = _train_throwaway(n_rows=1500 if args.smoke else 4000,
+                                   num_trees=20 if args.smoke else 50)
+        tmpdir = tempfile.TemporaryDirectory(prefix="dryad-fleet-bench-")
+        model_path = os.path.join(tmpdir.name, "model.dryad")
+        booster.save(model_path)
+    mapper = booster.mapper
+    num_features = getattr(mapper, "base", mapper).num_features
+
+    sizes = [int(s) for s in (args.sizes or "1,3,9,17").split(",")]
+    duration = args.duration if args.duration is not None else 2.0
+    replicas = tuple(int(n) for n in args.fleet_replicas.split(","))
+    if args.smoke:
+        duration, replicas = min(duration, 1.0), (1, 2)
+    try:
+        report = run_fleet_bench(
+            model_path, num_features, backend=args.backend,
+            replica_counts=replicas, clients=args.clients,
+            duration_s=duration, sizes=sizes, arms=args.arms,
+            seed=args.seed,
+            max_batch_rows=args.max_batch_rows or 256,
+            max_wait_ms=args.max_wait_ms or 1.0,
+            swap_replicas=min(2, max(replicas)), verbose=not args.smoke)
+    finally:
+        if tmpdir is not None:
+            tmpdir.cleanup()
+    report.update(artifact_stamp(device_kind=None))
+
+    print(json.dumps(report, indent=1))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(report, f, indent=1)
+    if report.get("suspect_capture"):
+        print("WARNING: per-arm spread > 5% — suspect capture (CLAUDE.md)",
+              file=sys.stderr)
+    # the one-line summary is the LAST stdout line (bench.py's format)
+    print(json.dumps(report))
+    failed = report.get("fleet_swap_failed", 0) + sum(
+        v for k, v in report.items() if k.startswith("fleet_failures_n"))
+    if failed:
+        print(f"ERROR: {failed} failed fleet request(s) — the zero-drop "
+              "contract is broken", file=sys.stderr)
+        return 1
+    if report.get("fleet_swap_versions_seen", 2) < 2:
+        print("ERROR: the swap drill never observed both versions — the "
+              "push did not happen under load", file=sys.stderr)
+        return 1
+    return 0
 
 
 def main(argv=None) -> int:
@@ -66,9 +138,18 @@ def main(argv=None) -> int:
     ap.add_argument("--smoke", action="store_true",
                     help="short CI mode: bucketed + sharded arms, exit 1 "
                          "on any recompile after warmup")
+    ap.add_argument("--fleet", action="store_true",
+                    help="closed-loop fleet arm: real subprocess replicas "
+                         "at N=1/2/4 + a rolling-swap drill (standalone; "
+                         "exit 1 on any failed swap-drill request)")
+    ap.add_argument("--fleet-replicas", default="1,2,4",
+                    help="comma-separated fleet sizes for the scaling arm")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--json", help="also write the report here")
     args = ap.parse_args(argv)
+
+    if args.fleet:
+        return run_fleet_arm(args)
 
     from dryad_tpu.serve.bench import run_bench, run_bench_compare, summary_line
 

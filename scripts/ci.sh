@@ -86,6 +86,19 @@ if ! env JAX_PLATFORMS=cpu \
 fi
 tail -1 /tmp/_sup_smoke.log
 
+# Fleet smoke (r14): REAL subprocess serve replicas behind the router —
+# an injected replica_crash (DRYAD_REPLICA_FAULTS drill wire) mid-load
+# must cost ZERO failed interactive requests (single-retry budget), and
+# the supervisor must journal the crash and respawn the slot.
+if ! env JAX_PLATFORMS=cpu \
+    PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}" \
+    python scripts/smoke_fleet.py > /tmp/_fleet_smoke.log 2>&1; then
+  echo "FLEET SMOKE FAIL: scripts/smoke_fleet.py (see /tmp/_fleet_smoke.log)" >&2
+  tail -5 /tmp/_fleet_smoke.log >&2
+  exit 1
+fi
+tail -1 /tmp/_fleet_smoke.log
+
 # Serving bench smoke (r7): zero recompiles after warmup across BOTH the
 # bucketed (forced-CPU) and sharded (8 fake devices) compiled-entry
 # families — warm traffic must be structurally recompile-free.

@@ -1,0 +1,150 @@
+"""Protocol stub replica for fleet tests (NOT a test module).
+
+Speaks the slice of the serve HTTP surface the fleet layer touches —
+``/healthz``, ``/predict``, ``/metrics``, ``/models/load`` and the
+``--port-file`` readiness handshake — in pure stdlib, so a fleet test
+spawns replicas in ~100 ms instead of paying the jax import per
+subprocess.  The REAL serve replica path is covered by
+``scripts/smoke_fleet.py`` (ci.sh) and the fleet bench; these tests pin
+the supervisor/router logic, which only ever sees the wire protocol.
+
+Deterministic failure shapes, flag-armed:
+
+    --crash-on-path     GET /boom hard-exits with code 23 (injected-crash
+                        twin; same exit code as faults.REPLICA_CRASH_EXIT)
+    --predict-503       every /predict answers 503 (stuck-shedding replica)
+    --health-503-after N  /healthz answers 200 for the first N probes
+                        (startup readiness passes), then latches 503
+                        forever (the stuck-503 replica)
+    --fail-start        exit(7) before binding (spawn-failure drill)
+    --predict-delay S   hold each /predict S seconds (in-flight windows)
+    --load-delay S      hold each /models/load S seconds
+
+``/predict`` answers like serve does ({"predictions": [...], "version"})
+with the version READ AT REQUEST START — the same pin-at-submit
+semantics serve's registry gives, which is what makes the rolling-swap
+drain assertions meaningful.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+class _Handler(BaseHTTPRequestHandler):
+    def log_message(self, fmt, *args):
+        pass
+
+    def _send(self, code: int, payload, ctype="application/json") -> None:
+        body = (payload if isinstance(payload, bytes)
+                else json.dumps(payload).encode())
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _authorized(self) -> bool:
+        """Serve's bearer scheme: everything but /healthz 401s without
+        the token (pins the router's authed replica scrape)."""
+        token = self.server.cfg.auth_token
+        if not token or self.path == "/healthz":
+            return True
+        if self.headers.get("Authorization") == f"Bearer {token}":
+            return True
+        self._send(401, {"error": "unauthorized"})
+        return False
+
+    def do_GET(self):  # noqa: N802 — stdlib handler API
+        cfg = self.server.cfg
+        if not self._authorized():
+            return
+        if self.path == "/healthz":
+            self.server.health_probes += 1
+            latched = (cfg.health_503_after >= 0
+                       and self.server.health_probes > cfg.health_503_after)
+            if latched:
+                self._send(503, {"ok": False, "degraded": ["stub"]})
+            else:
+                self._send(200, {"ok": True})
+        elif self.path == "/metrics":
+            text = ("# HELP stub_requests_total requests seen\n"
+                    "# TYPE stub_requests_total counter\n"
+                    f"stub_requests_total {self.server.requests}\n"
+                    'stub_latency_ms{path="/predict"} 1.5\n')
+            self._send(200, text.encode(),
+                       "text/plain; version=0.0.4; charset=utf-8")
+        elif self.path == "/boom" and cfg.crash_on_path:
+            os._exit(23)
+        else:
+            self._send(404, {"error": "unknown path"})
+
+    def do_POST(self):  # noqa: N802 — stdlib handler API
+        cfg = self.server.cfg
+        if not self._authorized():
+            return
+        length = int(self.headers.get("Content-Length") or 0)
+        body = self.rfile.read(length) if length else b"{}"
+        if self.path == "/predict":
+            self.server.requests += 1
+            version = self.server.version     # pin at request start
+            if cfg.predict_503:
+                self._send(503, {"error": "stub shedding"})
+                return
+            if cfg.predict_delay > 0:
+                time.sleep(cfg.predict_delay)
+            try:
+                rows = json.loads(body).get("rows", [])
+            except ValueError:
+                rows = []
+            self._send(200, {"predictions": [0.5] * len(rows),
+                             "version": version})
+        elif self.path == "/models/load":
+            if cfg.load_delay > 0:
+                time.sleep(cfg.load_delay)
+            with self.server.version_lock:
+                self.server.version += 1
+                v = self.server.version
+            self._send(200, {"version": v})
+        else:
+            self._send(404, {"error": "unknown path"})
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--port-file", required=True)
+    ap.add_argument("--version", type=int, default=1)
+    ap.add_argument("--predict-delay", type=float, default=0.0)
+    ap.add_argument("--load-delay", type=float, default=0.0)
+    ap.add_argument("--crash-on-path", action="store_true")
+    ap.add_argument("--predict-503", action="store_true")
+    ap.add_argument("--health-503-after", type=int, default=-1)
+    ap.add_argument("--auth-token", default=None)
+    ap.add_argument("--fail-start", action="store_true")
+    cfg = ap.parse_args()
+    if cfg.fail_start:
+        return 7
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), _Handler)
+    httpd.daemon_threads = True
+    httpd.cfg = cfg
+    httpd.version = cfg.version
+    httpd.version_lock = threading.Lock()
+    httpd.requests = 0
+    httpd.health_probes = 0
+    host, port = httpd.server_address[:2]
+    tmp = cfg.port_file + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(f"{host} {port}\n")
+    os.replace(tmp, cfg.port_file)
+    httpd.serve_forever()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -120,6 +120,51 @@ def test_obs_lazy_function_level_jax_import_also_banned():
     assert _rule_hits(rep, "obs-jax-free")
 
 
+# ---------------------------------------------------------------------------
+# fleet-jax-free (direct + transitive, r14)
+
+def test_fleet_direct_jax_import_seeded():
+    src = SourceTree(ROOT).read("dryad_tpu/fleet/router.py")
+    rep = _violations("fleet-jax-free",
+                      {"dryad_tpu/fleet/router.py": src + "\nimport jax\n"})
+    assert _rule_hits(rep, "fleet-jax-free")
+
+
+def test_fleet_lazy_jax_import_also_banned():
+    src = SourceTree(ROOT).read("dryad_tpu/fleet/supervisor.py")
+    bad = src + "\ndef _lazy():\n    from jax import numpy\n    return numpy\n"
+    rep = _violations("fleet-jax-free",
+                      {"dryad_tpu/fleet/supervisor.py": bad})
+    assert _rule_hits(rep, "fleet-jax-free")
+
+
+def test_fleet_transitive_jax_import_seeded():
+    # an innocent-looking module-level import of an engine helper pulls
+    # jax into `import dryad_tpu.fleet` — the chain must be reported
+    src = SourceTree(ROOT).read("dryad_tpu/fleet/replica.py")
+    bad = "from dryad_tpu.engine.jax_compat import shard_map\n" + src
+    rep = _violations("fleet-jax-free",
+                      {"dryad_tpu/fleet/replica.py": bad})
+    hits = _rule_hits(rep, "fleet-jax-free")
+    assert hits and any("transitive" in v.message for v in hits)
+
+
+def test_fleet_device_fetch_shape_banned():
+    src = SourceTree(ROOT).read("dryad_tpu/fleet/router.py")
+    bad = src + "\ndef _peek(x):\n    return x.addressable_data(0)\n"
+    rep = _violations("fleet-jax-free", {"dryad_tpu/fleet/router.py": bad})
+    assert _rule_hits(rep, "fleet-jax-free")
+
+
+def test_block_until_ready_seeded_in_fleet():
+    # the real-fetch discipline covers fleet throttles like serve's
+    src = SourceTree(ROOT).read("dryad_tpu/fleet/supervisor.py")
+    bad = src + "\ndef _wait(x):\n    return x.block_until_ready()\n"
+    rep = _violations("no-block-until-ready",
+                      {"dryad_tpu/fleet/supervisor.py": bad})
+    assert _rule_hits(rep, "no-block-until-ready")
+
+
 def test_obs_transitive_jax_import_seeded():
     # registry.py -> engine.jax_compat -> jax: no obs file mentions jax,
     # only the import-graph walk can see it (the r11 upgrade over grep)
