@@ -1,25 +1,36 @@
-"""CLI: ``python -m dryad_tpu.analysis [--ci|--lint|--audit] [...]``.
+"""CLI: ``python -m dryad_tpu.analysis [--ci|--lint|--audit|--concurrency]``.
 
 Exit codes (scripts/ci.sh keys off them):
 
     0  everything passed
-    2  dryadlint violations (or malformed waivers)
+    2  dryadlint violations (or malformed waivers, or the waiver count
+       exceeding the committed budget — goldens/waiver_budget.json)
     3  jaxpr audit invariant failure (collective census / _comm_stats
        mismatch, row-sort contract, kernel dtype discipline)
     4  program-digest drift vs the committed goldens
-    5  internal error (a rule or an arm crashed — never "pass by crash")
+    5  internal error (a rule, an arm, or a drill crashed — never "pass
+       by crash")
+    6  concurrency-contract violation (r15): a guarded-by /
+       no-blocking-under-lock / lock-order lint hit, or a schedule-
+       harness drill failure (invariant, deadlock, or lock-order cycle)
 
 ``--update-goldens`` re-traces every arm and rewrites
 ``dryad_tpu/analysis/goldens/program_digests.json``; run it when a program
 change is INTENTIONAL and commit the diff — the review of that diff is
-the human half of the fusion-shape tripwire.
+the human half of the fusion-shape tripwire.  The lock partial order
+(``goldens/lock_order.json``) and the waiver budget
+(``goldens/waiver_budget.json``) are edited BY HAND, consciously, in the
+same diff as the change that needs them.
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import sys
+
+WAIVER_BUDGET_PATH = "dryad_tpu/analysis/goldens/waiver_budget.json"
 
 
 def _force_cpu_env():
@@ -32,14 +43,36 @@ def _force_cpu_env():
             flags + " --xla_force_host_platform_device_count=8").strip()
 
 
+def check_waiver_budget(n_waived: int, budget_path: str):
+    """(ok, message): the waiver-count ratchet — growing the waiver set
+    requires bumping the committed budget in the same diff."""
+    try:
+        with open(budget_path) as f:
+            budget = int(json.load(f)["waivers"])
+    except (OSError, ValueError, KeyError) as e:
+        return False, f"waiver budget unreadable ({budget_path}): {e!r}"
+    if n_waived > budget:
+        return False, (
+            f"waiver ratchet: {n_waived} waived > budget {budget} "
+            f"({budget_path}) — a new waiver is a review event; bump the "
+            "budget consciously in the same diff or fix the violation")
+    slack = budget - n_waived
+    note = (f"waivers {n_waived}/{budget}"
+            + (f" (budget can ratchet down by {slack})" if slack else ""))
+    return True, note
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m dryad_tpu.analysis",
-        description="dryadlint + jaxpr auditor (see dryad_tpu/analysis)")
+        description="dryadlint + jaxpr auditor + concurrency harness "
+                    "(see dryad_tpu/analysis)")
     ap.add_argument("--ci", action="store_true",
-                    help="run both layers (what scripts/ci.sh runs)")
+                    help="run all three layers (what scripts/ci.sh runs)")
     ap.add_argument("--lint", action="store_true", help="dryadlint only")
     ap.add_argument("--audit", action="store_true", help="jaxpr audit only")
+    ap.add_argument("--concurrency", action="store_true",
+                    help="schedule-harness drills only (layer 3 dynamic)")
     ap.add_argument("--update-goldens", action="store_true",
                     help="re-trace arms and rewrite the digest goldens")
     ap.add_argument("--list-rules", action="store_true",
@@ -48,10 +81,16 @@ def main(argv=None) -> int:
                     help="restrict lint to the named rule(s)")
     ap.add_argument("--arm", action="append", default=None,
                     help="restrict the audit to the named arm(s)")
+    ap.add_argument("--drill", action="append", default=None,
+                    help="restrict the concurrency drills by name")
+    ap.add_argument("--schedules", type=int, default=None,
+                    help="schedules per drill (default: each drill's own)")
     ap.add_argument("--root", default=None,
                     help="repo root (default: the package's parent)")
     ap.add_argument("--goldens", default=None,
                     help="goldens path override (tests use a tmp file)")
+    ap.add_argument("--waiver-budget", default=None,
+                    help="waiver budget path override (tests)")
     ap.add_argument("-q", "--quiet", action="store_true")
     args = ap.parse_args(argv)
 
@@ -66,12 +105,16 @@ def main(argv=None) -> int:
             print(f"{'':24s}   targets: {', '.join(rule.targets)}")
         return 0
 
-    do_lint = args.ci or args.lint or not (args.audit or args.update_goldens)
+    explicit = args.lint or args.audit or args.concurrency \
+        or args.update_goldens
+    do_lint = args.ci or args.lint or not explicit
     do_audit = args.ci or args.audit or args.update_goldens
+    do_conc = args.ci or args.concurrency
 
     rc = 0
     try:
         if do_lint:
+            from dryad_tpu.analysis.concurrency import RULE_NAMES as CONC
             from dryad_tpu.analysis.lint import run_lint
 
             report = run_lint(root, rule_names=args.rule)
@@ -83,9 +126,37 @@ def main(argv=None) -> int:
                 for v, w in report.waived:
                     print(f"waived   {v.path}:{v.line} [{v.rule}] -- "
                           f"{w.reason}")
-            print(report.summary())
-            if not report.ok:
+            budget_path = args.waiver_budget or os.path.join(
+                root, WAIVER_BUDGET_PATH)
+            if args.waiver_budget is None and not os.path.exists(budget_path):
+                # fixture roots (tests) carry no goldens: ratchet against
+                # the package's committed budget
+                budget_path = os.path.join(os.path.dirname(
+                    os.path.abspath(__file__)), "goldens",
+                    "waiver_budget.json")
+            budget_ok, budget_msg = check_waiver_budget(
+                len(report.waived), budget_path)
+            if not budget_ok:
+                print("ERROR", budget_msg)
+            print(report.summary() + " | " + (budget_msg if budget_ok
+                                              else "over budget"))
+            if any(v.rule in CONC for v in report.violations):
+                rc = max(rc, 6)
+            if (not report.ok and any(v.rule not in CONC
+                                      for v in report.violations)) \
+                    or report.errors or not budget_ok:
                 rc = max(rc, 2)
+
+        if do_conc:
+            from dryad_tpu.analysis.schedules import run_ci_drills
+
+            failures = run_ci_drills(schedules=args.schedules,
+                                     quiet=args.quiet, drills=args.drill)
+            for f in failures:
+                print("CONCURRENCY FAIL", f)
+            print(f"schedule harness: {len(failures)} failing drill(s)")
+            if failures:
+                rc = max(rc, 6)
 
         if do_audit:
             _force_cpu_env()

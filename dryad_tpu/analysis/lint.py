@@ -147,8 +147,9 @@ def register(rule: Rule) -> Rule:
 
 
 def registry() -> dict[str, Rule]:
-    # rules.py registers on import; keep the import here so ``registry()``
-    # is always complete regardless of import order
+    # rules.py / concurrency.py register on import; keep the imports here
+    # so ``registry()`` is always complete regardless of import order
+    from dryad_tpu.analysis import concurrency as _concurrency  # noqa: F401
     from dryad_tpu.analysis import rules as _rules  # noqa: F401
 
     return dict(_REGISTRY)

@@ -80,7 +80,14 @@ def relabel_exposition(text: str, replica: str) -> str:
 
 
 class _RouterState:
-    """Everything the handler threads share (rides on the HTTP server)."""
+    """Everything the handler threads share (rides on the HTTP server).
+    ``_lock`` guards the admission ledger (total + per-model in-flight)
+    and the round-robin cursor; admit/release are single short critical
+    sections so shedding decisions are atomic against concurrent handler
+    threads, and no forward/scrape I/O ever happens under it."""
+
+    GUARDED_BY = {"_inflight_total": "_lock", "_inflight_model": "_lock",
+                  "_rr": "_lock"}
 
     def __init__(self, supervisor, *, registry: Optional[Registry],
                  max_inflight: int, bulk_max_inflight: Optional[int],

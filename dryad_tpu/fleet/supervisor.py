@@ -61,7 +61,13 @@ from dryad_tpu.resilience.policy import RetryPolicy
 class ReplicaSlot:
     """One position in the fleet: the live process (across respawns) plus
     the routing state the router reads.  ``inflight`` is the router's
-    in-flight request count against this slot — the drain condition."""
+    in-flight request count against this slot — the drain condition, so
+    it is the one field that must never tear: router handler threads
+    inc/dec it while ``rolling_push`` waits on it reaching zero.  The
+    remaining flags (``healthy``/``draining``/...) are single-writer
+    (monitor or push path) with benignly racy reads — ``routable`` is a
+    point-in-time answer by design, and the router re-checks it AFTER
+    the in-flight mark to close the pick->inc window."""
 
     def __init__(self, index: int):
         self.index = index
@@ -75,7 +81,7 @@ class ReplicaSlot:
         self.respawns = 0
         self.consecutive_bad = 0
         self.last_status: Optional[int] = None
-        self._inflight = 0
+        self._inflight = 0        # guarded-by: _lock
         self._lock = threading.Lock()
 
     @property
@@ -118,7 +124,20 @@ class FleetSupervisor:
     string armed for that replica's FIRST generation only (drills).
     ``journal`` takes a path (owned/closed here) or an open RunJournal,
     exactly like ``supervise_train``.
+
+    Lock contract (r15): two locks, committed order ``_swap_lock`` before
+    ``_journal_lock`` (analysis/goldens/lock_order.json).  ``_journal_lock``
+    guards the journal HANDLE — monitor, recovery threads, and the push
+    path all journal concurrently, and ``stop()`` swaps the owned handle
+    to None under it (each ``event()`` line is additionally atomic under
+    the journal's own lock).  ``_swap_lock`` is a pure serialization
+    mutex — one rolling push at a time; nothing else ever acquires it,
+    which is why blocking inside it (the drain wait) is waived rather
+    than redesigned.  Slot state crosses threads via each slot's own
+    lock (the in-flight count) and single-writer flags.
     """
+
+    GUARDED_BY = {"_journal": "_journal_lock"}
 
     def __init__(self, make_argv, n_replicas: int, *,
                  policy: Optional[RetryPolicy] = None,
@@ -445,6 +464,7 @@ class FleetSupervisor:
                             raise TimeoutError(
                                 f"drain timed out with {slot.inflight} "
                                 "in flight")
+                        # dryadlint: disable=no-blocking-under-lock -- the swap mutex has a sole acquirer; the drain wait under it IS the zero-drop design
                         time.sleep(0.002)
                     version = slot.proc.load_model(
                         path, name=name, activate=activate,

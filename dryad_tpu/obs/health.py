@@ -34,6 +34,8 @@ from dryad_tpu.obs.registry import Registry, default_registry
 class HealthState:
     """A named set of active degradation reasons, mirrored to a gauge."""
 
+    GUARDED_BY = {"_reasons": "_lock"}
+
     def __init__(self, registry: Optional[Registry] = None):
         self._lock = threading.Lock()
         self._reasons: dict[str, str] = {}   # reason -> detail

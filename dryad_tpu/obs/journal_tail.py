@@ -44,7 +44,12 @@ class JournalTail:
     ``poll()`` consumes everything appended since the last poll and
     returns the number of events folded; ``start()`` polls on a daemon
     thread for live runs (``stop()`` runs one final poll so no tail
-    events are lost at shutdown)."""
+    events are lost at shutdown).  ``_lock`` serializes whole polls —
+    offset, carry, and the fold are one atomic unit, so a caller's poll
+    racing the background tick can never double-fold a line."""
+
+    GUARDED_BY = {"_offset": "_lock", "_carry": "_lock",
+                  "events_seen": "_lock"}
 
     def __init__(self, path: str, registry: Optional[Registry] = None,
                  poll_interval_s: float = 0.25):

@@ -44,6 +44,8 @@ class SpanTrace:
     """Bounded thread-safe ring of completed spans ``(path, t0_s, dur_s,
     tid)`` — the span trace sink (spans.set_trace_sink)."""
 
+    GUARDED_BY = {"_events": "_lock", "dropped": "_lock"}
+
     def __init__(self, capacity: int = DEFAULT_CAPACITY):
         self._events: deque = deque(maxlen=int(capacity))
         self._lock = threading.Lock()

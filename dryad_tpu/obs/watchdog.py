@@ -76,7 +76,15 @@ class _Watch:
 class FetchWatchdog:
     """Tracks in-flight fetches and exports their age from a monitor
     thread.  One instance serves the whole process (``default_watchdog``);
-    tests build private ones with tiny thresholds."""
+    tests build private ones with tiny thresholds.
+
+    ``_lock`` guards the in-flight table and its token counter (producer
+    threads begin/end watches while the monitor ticks ages); gauge and
+    counter publication happens OUTSIDE the lock so a contended registry
+    family never extends this critical section."""
+
+    GUARDED_BY = {"_inflight": "_lock", "_next_token": "_lock",
+                  "_last_stall": "_lock", "_thread": "_lock"}
 
     def __init__(self, registry: Optional[Registry] = None,
                  threshold_s: Optional[float] = None,
@@ -166,6 +174,9 @@ class FetchWatchdog:
 
     # ---- monitor thread ----------------------------------------------------
     def _ensure_thread(self) -> None:
+        # double-checked fast path: the per-begin() liveness probe; the
+        # locked re-check below is the authoritative spawn decision
+        # dryadlint: disable=guarded-by -- benign double-checked read (see above)
         if self._thread is None or not self._thread.is_alive():
             with self._lock:
                 if self._thread is None or not self._thread.is_alive():

@@ -228,7 +228,19 @@ class FaultInjector:
     keeps one injector across retries, so a resumed segment replays past
     the already-fired point instead of dying on it again.  ``fired``
     records (point index, site, iteration, kind) for test assertions.
+
+    Lock contract (r15): ``_lock`` (declared below) makes the armed
+    check-and-clear atomic — the serve front end calls the hook from
+    ThreadingHTTPServer handler threads, and without the lock a one-shot
+    drill fired once per in-flight request (found by the r14 review,
+    now pinned by the schedule harness's concurrent-fire drill).  The
+    fault ACTIONS (sleep, raise, os._exit) run strictly OUTSIDE the
+    lock: a SLOW_HEALTH stall must hold up only its own probe, never
+    serialize concurrent injections — the no-blocking-under-lock lint
+    keeps it that way.
     """
+
+    GUARDED_BY = {"_armed": "_lock", "fired": "_lock"}
 
     def __init__(self, points):
         import threading

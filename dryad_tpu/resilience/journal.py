@@ -15,25 +15,44 @@ Event vocabulary (the ``event`` field; producers in supervisor.py):
 from __future__ import annotations
 
 import json
+import threading
 import time
 
 
 class RunJournal:
-    """Append one JSON event line per supervision event to ``path``."""
+    """Append one JSON event line per supervision event to ``path``.
+
+    Lock contract (r15): a journal is written from more than one thread —
+    the training supervisor's loop plus the tripwire listener it
+    registers, and the fleet supervisor's monitor plus its per-slot
+    recovery threads — so ``_lock`` (declared below) makes each
+    ``event()`` line atomic: serialize + write happen under it, and
+    ``close()`` takes the same lock so a concurrent event can never hit a
+    closed handle.  The r14 review found the unlocked-write race by hand;
+    the guarded-by lint and the schedule harness now pin the fix.
+    Owners that also swap the journal OBJECT itself (the fleet
+    supervisor's owned-journal close) keep their own outer lock for that
+    — the two never nest in the journal->owner direction."""
+
+    GUARDED_BY = {"_fh": "_lock"}
 
     def __init__(self, path: str):
         self.path = path
         self._t0 = time.perf_counter()
+        self._lock = threading.Lock()
         self._fh = open(path, "a", buffering=1)
 
     def event(self, kind: str, /, **fields) -> None:
         rec = {"event": kind,
                "elapsed_s": round(time.perf_counter() - self._t0, 6)}
         rec.update(fields)
-        self._fh.write(json.dumps(rec) + "\n")
+        line = json.dumps(rec) + "\n"
+        with self._lock:
+            self._fh.write(line)
 
     def close(self) -> None:
-        self._fh.close()
+        with self._lock:
+            self._fh.close()
 
     def __enter__(self) -> "RunJournal":
         return self

@@ -105,7 +105,22 @@ class MicroBatcher:
     ``execute`` are also given (``dispatch ≡ execute ∘ prepare``) and
     ``pipeline_depth >= 2``, dispatch runs as the overlapped two-stage
     pipeline described in the module docstring.
+
+    Lock contract (r15, pinned by the guarded-by lint + the schedule
+    harness): ``_lock`` guards the lifecycle triple — the worker handle
+    ``_thread``, the stop-token generation ``_gen``, and the timed-out
+    marker ``_stop_timed_out``.  Only ``start()``/``stop()``/
+    ``_stop_live()`` take it, always briefly and never around the queue
+    or a join: ``stop()`` snapshots the handle under the lock, blocks
+    OUTSIDE it, then re-validates under the lock before clearing — the
+    r9 stop/start generation race lived exactly in that window, and the
+    harness drill re-opens it whenever ``_stop_live`` stops comparing
+    generations.  The queue itself is the synchronization for the
+    request path; per-request state rides each ``Request``'s own event.
     """
+
+    GUARDED_BY = {"_thread": "_lock", "_gen": "_lock",
+                  "_stop_timed_out": "_lock"}
 
     def __init__(self, dispatch, *, prepare=None, execute=None,
                  pipeline_depth: int = 2, max_batch_rows: int = 4096,

@@ -85,6 +85,8 @@ class CompiledPredictCache:
     accumulate, optionally sharded over ``mesh``) or 'cpu' (canonical
     numpy predict)."""
 
+    GUARDED_BY = {"_fns": "_lock", "_warm": "_lock"}
+
     def __init__(self, backend: str = "cpu", metrics=None, *,
                  min_bucket: int = 8, max_bucket: int = 4096,
                  mesh=None, sharded_threshold: Optional[int] = None):
@@ -127,7 +129,8 @@ class CompiledPredictCache:
     def num_entries(self) -> int:
         """Warm (version, bucket, shards) keys — compiled shapes, not
         closures."""
-        return len(self._warm)
+        with self._lock:
+            return len(self._warm)
 
     def warmup_complete(self) -> None:
         """Declare the expected-compile budget spent: every bucket this

@@ -38,7 +38,20 @@ from dryad_tpu.booster import Booster
 
 
 class ModelEntry:
-    """A registered model plus its lazily staged predict state."""
+    """A registered model plus its lazily staged predict state.
+
+    ``_lock`` guards the staging state (declared below); ``version``/
+    ``booster``/``name`` are immutable after construction, ``last_used``
+    is written by the REGISTRY under ITS lock (the registry's tick), and
+    ``closed`` is flipped once by ``ModelRegistry.unload`` and only ever
+    read under this lock.  The lock is never held across the registry
+    lock — the eviction path deliberately picks victims under the
+    registry lock and evicts them OUTSIDE it (see ``_on_staged``), which
+    is why the lock-order goldens commit no edge between the two."""
+
+    GUARDED_BY = {"_staged": "_lock", "_device": "_lock",
+                  "_staged_bytes": "_lock", "_stage_count": "_lock",
+                  "closed": "_lock"}
 
     def __init__(self, version: int, booster: Booster, path: Optional[str] = None,
                  num_iteration: Optional[int] = None,
@@ -82,7 +95,7 @@ class ModelEntry:
     def staged(self):
         """(trees, init, n_iter) reshaped numpy tables, built once (again
         after an eviction); notifies the registry so the budget can react."""
-        notify = False
+        notify = restage = False
         with self._lock:
             if self.closed:
                 # an unloaded entry must never re-stage (a stale compiled
@@ -98,9 +111,10 @@ class ModelEntry:
                                       + init_np.nbytes)
                 self._stage_count += 1
                 notify = True
+                restage = self._stage_count > 1
             staged = self._staged
         if notify and self._registry is not None:
-            self._registry._on_staged(self, restage=self._stage_count > 1)
+            self._registry._on_staged(self, restage=restage)
         return staged
 
     def device_state(self, mesh=None):
@@ -151,6 +165,17 @@ class ModelEntry:
 
 
 class ModelRegistry:
+    """The version/alias/active bookkeeping lives under ``_lock``
+    (declared below).  The lock is held only for dict/stack updates —
+    never across staging, eviction, or any entry-lock acquisition:
+    ``_on_staged`` chooses victims under this lock but calls their
+    ``evict_staged()`` (which takes each ENTRY's lock) after releasing
+    it, the inversion-avoidance rule its docstring records."""
+
+    GUARDED_BY = {"_models": "_lock", "_aliases": "_lock",
+                  "_active": "_lock", "_history": "_lock",
+                  "_next_version": "_lock", "_tick": "_lock"}
+
     def __init__(self, budget_bytes: Optional[int] = None, metrics=None):
         self._lock = threading.Lock()
         self._models: dict[int, ModelEntry] = {}

@@ -7,17 +7,23 @@
 #
 cd "$(dirname "$0")/.." || exit 1
 
-# Static analysis (r11): dryadlint + the jaxpr auditor replace the r6-r10
-# grep lints (wired-grower tile_plan/row-sort ban, serve/resilience/obs
-# block_until_ready bans, the batcher fetch ban, the obs jax-freedom check
-# — now TRANSITIVE over imports, not a text match) and add the invariants
-# greps never could: the trip-weighted collective census cross-checked
-# against train._comm_stats on every grower arm, the wired-path zero-row-
-# sort contract, kernel-boundary u8/u16 tile discipline, and committed
-# program digests that catch fusion-shape drift (the argmax-flip class).
-# Exit codes: 2 = lint, 3 = IR invariant, 4 = digest drift, 5 = crash.
+# Static analysis (r11, +concurrency r15): dryadlint + the jaxpr auditor
+# + the schedule harness.  Layer 1 replaces the r6-r10 grep lints and (r15)
+# machine-checks the threaded host plane's lock discipline (guarded-by
+# declarations, no blocking under a lock, the committed lock partial
+# order in analysis/goldens/lock_order.json) with the waiver count
+# RATCHETED against analysis/goldens/waiver_budget.json.  Layer 2 checks
+# the trip-weighted collective census against train._comm_stats, the
+# wired-path zero-row-sort contract, kernel-boundary u8/u16 discipline,
+# and the committed program digests.  Layer 3 (r15) runs the recorded
+# race classes as seed-deterministic schedule drills (batcher stop/start,
+# supervisor recovery, rolling push vs death, registry snapshot tearing,
+# injector concurrent fire) with runtime deadlock/lock-cycle verdicts.
+# Exit codes: 2 = lint/ratchet, 3 = IR invariant, 4 = digest drift,
+# 5 = crash, 6 = concurrency contract (static rule or failing drill).
 # Intentional program changes: python -m dryad_tpu.analysis --update-goldens
-# and commit the goldens diff.  CPU-only (traces, never compiles).
+# and commit the goldens diff; new lock nestings edit lock_order.json in
+# the same spirit.  CPU-only (traces, never compiles).
 env JAX_PLATFORMS=cpu \
     PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}" \
     python -m dryad_tpu.analysis --ci -q > /tmp/_analysis.log 2>&1

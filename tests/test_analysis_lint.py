@@ -35,8 +35,14 @@ def test_shipped_tree_clean_all_rules():
     report = run_lint(ROOT)
     assert report.ok, "\n".join(v.format() for v in report.violations)
     # the waiver budget is intentional and visible — additions are a
-    # review event, not background noise (update the bound consciously)
-    assert len(report.waived) <= 30
+    # review event, not background noise; since r15 the bound is the
+    # COMMITTED ratchet the CLI enforces (goldens/waiver_budget.json),
+    # so the test and CI can never disagree about it
+    import json
+
+    with open(f"{ROOT}/dryad_tpu/analysis/goldens/waiver_budget.json") as f:
+        budget = json.load(f)["waivers"]
+    assert len(report.waived) <= budget
 
 
 # ---------------------------------------------------------------------------
