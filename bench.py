@@ -19,7 +19,9 @@ is made of.  Set BENCH_10M=0 to skip (~5 min: two compiles + four runs).
 
 Env knobs: BENCH_ROWS (default 200000), BENCH_TREES (default 50),
 BENCH_LEAVES (default 255), BENCH_GROWTH (default depthwise),
-BENCH_10M (default 1).
+BENCH_10M (default 1), BENCH_DEEP / BENCH_LEAFWISE / BENCH_WIDE
+(default 1 — the wired-vs-legacy level probes and the r16 Epsilon-shaped
+hist_reduce fused-vs-feature scan probe).
 
 r9 adds ``obs_overhead_ms``/``obs_overhead_pct``: instrumented-vs-
 disabled telemetry registry (dryad_tpu/obs) on the 200k series, min-of-3
@@ -306,6 +308,42 @@ def leafwise_level_probe(rows: int, D: int = 7, B: int = 256,
     }
 
 
+def hist_reduce_probe(rows: int = 400_000, F: int = 2000, B: int = 256,
+                      P: int = 32, K: int = 3, reps: int = 2) -> dict | None:
+    """Epsilon-shaped (2000 x 256) per-arm wall of the split-finding stage
+    the r16 feature-parallel reduction changes: the fused full-F scan
+    (the split_scan registry probe at this width — each device scans every
+    feature) vs the feature arm's per-device stage (the hist_reduce
+    registry probe: sliced F/8 scan + packed record combine).  Both ride
+    ``engine/probes`` — liveness-proven timed-fori programs with the
+    histogram arrays as jit ARGUMENTS and a scale-class perturbation that
+    must reach the gains (run_probe applies the harness rules).  The wire
+    win itself ((n-1)/n of the reduced payload) is static accounting
+    (train._comm_stats, jaxpr-census-verified), not a single-device wall
+    — these fields track the compute side of the trade across rounds.
+    None on CPU — Epsilon-width scans take minutes there and the walls
+    mean nothing."""
+    import jax
+
+    if jax.devices()[0].platform == "cpu":
+        return None
+    from dryad_tpu.engine.probes import run_probe
+
+    fused = run_probe("split_scan", rows=rows, K=K, reps=reps,
+                      num_features=F, total_bins=B, num_slots=P)
+    feat = run_probe("hist_reduce", rows=rows, K=K, reps=reps,
+                     num_features=F, total_bins=B, num_slots=P)
+    return {
+        "hist_reduce_ms_fused": round(fused["ms"], 2),
+        "hist_reduce_ms_feature": round(feat["ms"], 2),
+        "hist_reduce_spread_fused": round(fused["spread"], 3),
+        "hist_reduce_spread_feature": round(feat["spread"], 3),
+        "hist_reduce_features": F,
+        "hist_reduce_bins": B,
+        "hist_reduce_slots": P,
+    }
+
+
 def main() -> None:
     # Pin the device-resident chunked boosting path: the bench estimates the
     # LONG-run (500-tree-scale) steady state from short timed runs, and the
@@ -515,6 +553,14 @@ def main() -> None:
     if os.environ.get("BENCH_LEAFWISE", "1") != "0":
         probe_rows = out.get("rows_10m", rows)
         probe = leafwise_level_probe(probe_rows)
+        if probe:
+            out.update(probe)
+
+    # ---- wide-shape split-scan walls per hist-reduce arm (r16) --------------
+    # Epsilon-shaped fused vs feature-parallel scan stage; trend fields
+    # like the wired/legacy pairs above.  BENCH_WIDE=0 skips.
+    if os.environ.get("BENCH_WIDE", "1") != "0":
+        probe = hist_reduce_probe()
         if probe:
             out.update(probe)
 

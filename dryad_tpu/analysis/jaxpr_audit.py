@@ -5,8 +5,12 @@ inputs (``jax.make_jaxpr`` over ``ShapeDtypeStruct``s — nothing compiles,
 nothing runs, so the Pallas/TPU programs trace on a CPU-only box) and
 walks the closed jaxprs for the invariants the repo documents:
 
-* **Collective census** — the ONLY collective inside the growers is the
-  fused grad/hess/count psum in the histogram builders; GOSS adds one
+* **Collective census** — the growers' collective plan is per-arm (r16):
+  on the fused arm the ONLY collective is the fused grad/hess/count psum
+  in the histogram builders; on the feature arm (hist_reduce="feature")
+  each level's builder issues one reduce-scatter and each level ONE
+  combine all-gather, with the root still on the fused psum — counts of
+  all three are cross-checked against ``_comm_stats``.  GOSS adds one
   global sort per iteration, the L1-family leaf renewal one global
   (leaf, residual) sort per tree; sharded predict has ZERO collectives.
   Counts are TRIP-WEIGHTED: ``fori_loop`` with static bounds lowers to
@@ -306,6 +310,26 @@ def _arm_leafwise_wired():
                                           "wired": True},)
 
 
+def _arm_levelwise_feature():
+    # the SAME wired config as levelwise_wired with the reduce-scatter
+    # arm forced on (F=8 is far below the auto gate — explicit "feature"
+    # keeps the trace cheap while the collective plan is fully live:
+    # root psum + per-level reduce_scatter + per-level combine all_gather)
+    return _train_arm(dict(objective="binary", num_trees=1, num_leaves=127,
+                           max_depth=7, growth="depthwise", max_bins=32,
+                           hist_backend="pallas", hist_reduce="feature"),
+                      platform="tpu") + ({"expected_row_sorts": 0,
+                                          "wired": True},)
+
+
+def _arm_leafwise_feature():
+    return _train_arm(dict(objective="binary", num_trees=1, num_leaves=31,
+                           max_depth=5, growth="leafwise", max_bins=32,
+                           hist_backend="pallas", hist_reduce="feature"),
+                      platform="tpu") + ({"expected_row_sorts": 0,
+                                          "wired": True},)
+
+
 def _arm_goss():
     return _train_arm(dict(objective="binary", num_trees=1, num_leaves=127,
                            max_depth=7, growth="depthwise", max_bins=32,
@@ -370,6 +394,16 @@ ARMS: dict[str, Arm] = {
         "leafwise_wired",
         "layout-wired batched leaf-wise expansion + selection, sharded",
         _arm_leafwise_wired),
+    "levelwise_feature": Arm(
+        "levelwise_feature",
+        "feature-parallel reduction arm: reduce-scatter + combine "
+        "all-gather per level, root psum (hist_reduce='feature')",
+        _arm_levelwise_feature),
+    "leafwise_feature": Arm(
+        "leafwise_feature",
+        "feature-parallel batched leaf-wise expansion (reduce-scatter + "
+        "combine all-gather per expansion level)",
+        _arm_leafwise_feature),
     "goss_iteration": Arm(
         "goss_iteration",
         "GOSS boosting iteration: +1 global row sort over the psums",
@@ -450,7 +484,18 @@ def trace_arm(name: str) -> ArmReport:
     rep = ArmReport(name, digest, census, meta["expected_psums"])
 
     psums = census.collectives.get("psum", 0)
-    others = {k: v for k, v in census.collectives.items() if k != "psum"}
+    comm = meta.get("comm") or {}
+    rs = census.collectives.get("reduce_scatter", 0)
+    ag = census.collectives.get("all_gather", 0)
+    exp_rs = comm.get("reduce_scatter_calls_per_iter", 0)
+    exp_ag = comm.get("all_gather_calls_per_iter", 0)
+    allowed = {"psum", "reduce_scatter", "all_gather"}
+    if comm.get("hist_reduce") == "feature":
+        # the feature arm derives each shard's owned slice/offset from
+        # axis_index — communication-free, not a payload
+        allowed.add("axis_index")
+    others = {k: v for k, v in census.collectives.items()
+              if k not in allowed}
     if census.dynamic_loop:
         rep.failures.append(
             "collective/sort inside a dynamic-trip while loop — census "
@@ -464,14 +509,22 @@ def trace_arm(name: str) -> ArmReport:
             f"psum census {psums} != _comm_stats accounting "
             f"{meta['expected_psums']} (comm={meta.get('comm')}) — the "
             "traced program and the observability accounting drifted")
+    if (rs, ag) != (exp_rs, exp_ag):
+        rep.failures.append(
+            f"reduce_scatter/all_gather census ({rs}, {ag}) != _comm_stats "
+            f"accounting ({exp_rs}, {exp_ag}) (comm={comm}) — only the "
+            "feature arm's per-level reduce-scatter + combine all-gather "
+            "may appear, and in exactly the accounted counts")
     if expect.get("collective_free") and census.collectives:
         rep.failures.append(
             f"collectives {dict(census.collectives)} in a collective-free "
             "arm — sharded predict must stay per-row")
     if not expect.get("collective_free") and others:
         rep.failures.append(
-            f"non-psum collectives {others} — the fused histogram psum "
-            "(+ documented global sorts) is the growers' ONLY collective")
+            f"unexpected collectives {others} — the per-arm histogram "
+            "reduction (fused psum, or feature-arm reduce-scatter + "
+            "combine all-gather) + documented global sorts are the "
+            "growers' ONLY collectives")
     if "expected_row_sorts" in expect \
             and census.global_row_sorts != expect["expected_row_sorts"]:
         rep.failures.append(

@@ -166,6 +166,28 @@ class Params:
     # them (the documented chunked-vs-dispatch tolerance class in
     # engine/train.py); model quality is unaffected.
     deep_layout: str = "auto"    # auto | legacy
+    # Cross-shard histogram reduction for the level-synchronous growers
+    # (levelwise + the batched leaf-wise expansion) under shard_map:
+    # "fused" keeps the classic one fused grad/hess/count psum of the full
+    # (P, 3, F, B) stack per builder call (the XGBoost-style allreduce —
+    # the comparison arm); "feature" reduce-scatters a static contiguous
+    # feature partition instead (each shard owns F/n fully-reduced
+    # columns), runs the split scan on the owned slice only, and combines
+    # tiny per-shard best-split records with one all-gather per level
+    # (LightGBM's reduce-scatter data-parallel mode) — at Epsilon shape
+    # (F=2000, B=256) the per-device reduced payload shrinks ~n-fold.
+    # "auto" picks "feature" iff F * B * bin_bytes clears
+    # HIST_REDUCE_WIDE_BYTES AND more than one shard participates — a pure
+    # function of (params, feature/bin shape, shard count), never of rows
+    # (CLAUDE.md same-program rule).  An explicit "feature" at 1 shard
+    # runs the degenerate full-slice program, so near-tie argmaxes can
+    # never flip between shard counts within the arm; switching ARMS
+    # (fused <-> feature) is same-program per shard count by construction
+    # (reduce-scatter slices measured bitwise-equal to the psum's), and
+    # pinned bitwise on the tie-free parity fixtures.  The sequential
+    # (unbounded-depth leaf-wise) grower ignores this knob — its per-split
+    # masked pass always rides the fused psum.
+    hist_reduce: str = "auto"    # auto | fused | feature
     # Cap on boosting iterations fused into one device program (the chunked
     # dispatch path in engine/train.py).  0 = no cap beyond the calibrated
     # watchdog budget.  Precedence (single documented order): the
@@ -285,6 +307,8 @@ class Params:
             raise ValueError("hist_backend must be auto|xla|pallas")
         if self.deep_layout not in ("auto", "legacy"):
             raise ValueError("deep_layout must be auto|legacy")
+        if self.hist_reduce not in ("auto", "fused", "feature"):
+            raise ValueError("hist_reduce must be auto|fused|feature")
         if self.ch_max < 0:
             raise ValueError("ch_max must be >= 0 (0 = uncapped)")
         if self.hist_precision not in ("exact", "fast"):
@@ -335,6 +359,30 @@ MAX_FAST_DEPTH = 14
 # pure function of params + data shape — NEVER of backend — so the CPU
 # mirror routes identically and parity holds.
 LEAFWISE_TOTAL_BYTES_BUDGET = 12 << 30
+
+
+# Wide-shape threshold for hist_reduce="auto": the feature-parallel
+# reduction pays one combine all-gather per level, so it only wins where
+# the per-slot histogram column is big — F * B * bin_bytes at or past
+# 256 KB (Epsilon's 2000 x 256 u8 = 500 KB clears it; Higgs' 28 x 256 =
+# 7 KB stays fused).  bin_bytes is the binned-matrix itemsize (1 below
+# 257 bins, else 2) so the gate is jax-free and shard-count aware only
+# through its explicit argument.
+HIST_REDUCE_WIDE_BYTES = 1 << 18
+
+
+def hist_reduce_resolved(p: Params, num_features: int, total_bins: int,
+                         n_shards: int) -> str:
+    """The ONE hist_reduce gate — shared by both level-synchronous growers
+    AND train._comm_stats so the observability accounting can never drift
+    from the program choice (the nat-gate/phase-plan precedent, ADVICE
+    r4).  A pure function of (params, feature/bin shape, shard count) —
+    NEVER of the row count (CLAUDE.md same-program rule)."""
+    if p.hist_reduce != "auto":
+        return p.hist_reduce
+    bin_bytes = 1 if total_bins <= 256 else 2
+    wide = num_features * total_bins * bin_bytes >= HIST_REDUCE_WIDE_BYTES
+    return "feature" if (wide and n_shards > 1) else "fused"
 
 
 def leafwise_fast_supported(p: Params, num_features: int,

@@ -21,11 +21,13 @@ built directly and the larger obtained by subtraction (LightGBM trick —
 halves histogram work); ties broken by first index.
 
 Distribution (SURVEY.md §2 #13-14): under ``shard_map`` with rows sharded,
-every device runs this same program on its shard; the only cross-device
-exchange is the fused grad/hess/count histogram psum inside ``build_hist``
-— exactly where the reference placed its NCCL allreduce.  G/H/C stats are
-derived from the (replicated) histogram, so all devices take identical
-split decisions without further collectives.
+every device runs this same program on its shard; this SEQUENTIAL grower's
+only cross-device exchange is the fused grad/hess/count histogram psum
+inside ``build_hist`` — exactly where the reference placed its NCCL
+allreduce (it ignores ``Params.hist_reduce``; the level-synchronous
+growers own the r16 feature-parallel arm).  G/H/C stats are derived from
+the (replicated) histogram, so all devices take identical split decisions
+without further collectives.
 """
 
 from __future__ import annotations

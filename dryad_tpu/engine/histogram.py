@@ -17,9 +17,12 @@ Accumulation is fp32: exact for counts below 2**24 and within last-ulp of
 the CPU reference's f64 histograms for gain argmax purposes (documented
 tolerance, SURVEY.md §7 hard part c).
 
-When ``axis_name`` is set the per-shard partial histogram is allreduced with
-``jax.lax.psum`` — the NCCL-allreduce replacement (SURVEY.md §2 #14); grad,
-hess, and count ride one fused psum per call.
+When ``axis_name`` is set the per-shard partial histogram is reduced
+cross-shard by ``distributed.reduce_hist`` — the fused ``jax.lax.psum``
+(the NCCL-allreduce replacement, SURVEY.md §2 #14; grad, hess, and count
+ride one fused collective per call) or, for the level builders under
+``hist_reduce="feature"`` (r16), a feature-partition reduce-scatter that
+leaves each shard its owned fully-reduced F/n slice.
 """
 
 from __future__ import annotations
@@ -250,6 +253,7 @@ def build_hist_multi(
     rows_per_chunk: int = 65536,
     axis_name: str | None = None,
     precision: str = "exact",
+    hist_reduce: str = "fused",
 ) -> jnp.ndarray:
     """Histograms for ``num_cols`` leaves in ONE pass -> (P, 3, F, B) fp32.
 
@@ -301,7 +305,9 @@ def build_hist_multi(
     acc, _ = jax.lax.scan(body, acc0, (Xc, gc, hc, sc))
     hist = acc.reshape(3, P, F, B).transpose(1, 0, 2, 3)
     if axis_name is not None:
-        hist = jax.lax.psum(hist, axis_name)
+        from dryad_tpu.engine.distributed import reduce_hist
+
+        hist = reduce_hist(hist, axis_name, hist_reduce)
     return hist
 
 
@@ -331,6 +337,7 @@ def build_hist_segmented(
     records: jnp.ndarray | None = None,
     sel_counts: jnp.ndarray | None = None,
     stage_gather: bool = True,
+    hist_reduce: str = "fused",
 ) -> jnp.ndarray:
     """Histograms for ``num_cols`` leaves -> (P, 3, F, B) fp32, O(N·F·B) work.
 
@@ -353,6 +360,7 @@ def build_hist_segmented(
                 Xb, g, h, sel, num_cols, total_bins, axis_name=axis_name,
                 rows_bound=rows_bound, platform=platform, records=records,
                 sel_counts=sel_counts, stage_gather=stage_gather,
+                hist_reduce=hist_reduce,
             )
     N, F = Xb.shape
     B = int(total_bins)
@@ -417,5 +425,7 @@ def build_hist_segmented(
         preferred_element_type=jnp.float32,
     ).reshape(P, 3, F, B)
     if axis_name is not None:
-        hist = jax.lax.psum(hist, axis_name)
+        from dryad_tpu.engine.distributed import reduce_hist
+
+        hist = reduce_hist(hist, axis_name, hist_reduce)
     return hist

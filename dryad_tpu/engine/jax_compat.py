@@ -34,6 +34,22 @@ else:                                                 # pragma: no cover
         # explicit psums
         return _shard_map_old(f, check_rep=False, **kw)
 
+def shard_map_norep(f, **kw):
+    """``shard_map`` with replication checking off — required by the
+    feature-parallel histogram arm: its combine's ``all_gather`` yields
+    device-identical values, but the 0.4.x replication checker has no rep
+    rule for all_gather outputs and rejects the replicated out_specs the
+    tree arrays need.  Correctness there is pinned by the N-shard ≡
+    1-shard ≡ fused parity tests instead; the fused arm keeps the full
+    checker.  Tries the kwarg spellings across the supported releases."""
+    for kwarg in ("check_rep", "check_vma"):
+        try:
+            return shard_map(f, **{kwarg: False}, **kw)
+        except TypeError:
+            continue
+    return shard_map(f, **kw)
+
+
 _HAS_PCAST = hasattr(jax.lax, "pcast")
 
 

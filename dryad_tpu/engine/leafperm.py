@@ -339,7 +339,8 @@ def hist_from_layout(rec: jnp.ndarray, seg_first: jnp.ndarray,
                      total_bins: int, num_features: int, bin_dtype,
                      n_sel_tiles: int, *,
                      axis_name: str | None = None,
-                     platform: str | None = None) -> jnp.ndarray:
+                     platform: str | None = None,
+                     hist_reduce: str = "fused") -> jnp.ndarray:
     """(P, 3, F, B) histograms for P selected segments of a leaf-ordered
     layout — NO sort, NO per-row gather: each segment is a CONTIGUOUS
     tile run, so the only data movement is a tile-granular gather
@@ -399,9 +400,12 @@ def hist_from_layout(rec: jnp.ndarray, seg_first: jnp.ndarray,
         total_bins=int(total_bins), num_features=int(num_features),
         axis_name=axis_name, platform=platform)
     if axis_name is not None:
-        # the same fused grad/hess/count psum every histogram builder
-        # issues — still the growers' ONLY collective
-        hist = jax.lax.psum(hist, axis_name)
+        # the same per-arm histogram reduction every builder tail issues:
+        # the fused grad/hess/count psum (default) or the feature-arm
+        # reduce-scatter (distributed.reduce_hist)
+        from dryad_tpu.engine.distributed import reduce_hist
+
+        hist = reduce_hist(hist, axis_name, hist_reduce)
     return hist
 
 

@@ -218,6 +218,56 @@ def grow_tree_leafwise_batched(
             bundled_mask=bundled_mask,
         )
 
+    # ---- histogram-reduction arm (r16) — levelwise.py's twin wiring:
+    # feature-parallel reduce-scatter per expansion-level builder call,
+    # sliced scan over the owned feature partition, one per-level
+    # all_gather combine; the root keeps the fused psum + full scan
+    # (root_stats reads feature 0's bins).  The selection replay below is
+    # collective-free either way.
+    from dryad_tpu.config import hist_reduce_resolved
+    from dryad_tpu.engine import distributed as _dist
+    from dryad_tpu.engine.split import find_best_split_sliced
+
+    n_shards = _dist.axis_shards(axis_name)
+    hr_mode = hist_reduce_resolved(p, F, B, n_shards)
+    feat_par = hr_mode == "feature"
+    FH = _dist.feature_slice_width(F, n_shards) if feat_par else F
+    if feat_par:
+        f_off = _dist.feature_shard_offset(axis_name, F)
+        fmask_s = _dist.feature_shard_slice(feat_mask, axis_name)
+        iscat_s = _dist.feature_shard_slice(is_cat_feat, axis_name)
+        mono_s = (_dist.feature_shard_slice(mono, axis_name)
+                  if mono is not None else None)
+        bund_s = (_dist.feature_shard_slice(bundled_mask, axis_name)
+                  if bundled_mask is not None else None)
+
+        def best_sliced(hist, G, H, C, lo, hi):
+            return find_best_split_sliced(
+                hist, G, H, C,
+                feat_offset=f_off,
+                num_features_total=F,
+                lambda_l2=p.lambda_l2,
+                min_child_weight=p.min_child_weight,
+                min_data_in_leaf=p.min_data_in_leaf,
+                feat_mask=fmask_s,
+                is_cat_feat=iscat_s,
+                has_cat=has_cat,
+                monotone=mono_s,
+                lo=lo,
+                hi=hi,
+                learn_missing=learn_missing,
+                bundled_mask=bund_s,
+            )
+
+    def level_scan(ch_hist, ch_G, ch_H, ch_C, allow, ch_lo, ch_hi):
+        if not feat_par:
+            return jax.vmap(best)(ch_hist, ch_G, ch_H, ch_C, allow,
+                                  ch_lo, ch_hi)
+        loc = jax.vmap(best_sliced)(ch_hist, ch_G, ch_H, ch_C, ch_lo, ch_hi)
+        return _dist.combine_best_splits(
+            loc, axis_name, allow=allow,
+            min_split_gain=p.min_split_gain, has_cat=has_cat)
+
     # ---- root ----------------------------------------------------------------
     # ALL rows are routed (bag gates histograms only); derived from
     # bag_mask so the init inherits the shard's varying-manual-axes under
@@ -251,7 +301,10 @@ def grow_tree_leafwise_batched(
     nd_lo = jnp.full((HN,), ninf, jnp.float32)
     nd_hi = jnp.full((HN,), pinf, jnp.float32)
 
-    hists = jnp.zeros((Pf, 3, F, B), jnp.float32).at[0].set(hist0)
+    # feature arm: the expansion buffer carries each shard's OWNED slice
+    hist0_loc = (_dist.feature_shard_slice(hist0, axis_name, axis=1)
+                 if feat_par else hist0)
+    hists = jnp.zeros((Pf, 3, FH, B), jnp.float32).at[0].set(hist0_loc)
 
     exp_st = {
         "row_node": row_node, "hists": hists,
@@ -440,7 +493,8 @@ def grow_tree_leafwise_batched(
                     jnp.where(left_smaller, lt_l[rjc], lt_r[rjc]), 0)
                 hist_small = leafperm.hist_from_layout(
                     lay_rec, seg_first, seg_nt, P, B, F, Xb.dtype,
-                    n_sel_tiles, axis_name=axis_name, platform=platform)
+                    n_sel_tiles, axis_name=axis_name, platform=platform,
+                    hist_reduce=hr_mode)
             else:
                 small_heap = 2 * idx + jnp.where(left_smaller, 0, 1)
                 colof = jnp.full((HN,), P, jnp.int32).at[
@@ -452,7 +506,8 @@ def grow_tree_leafwise_batched(
 
                     hist_small = pallas_hist.build_hist_small(
                         nat_tiles, g, h, smallsel, P, B, F,
-                        axis_name=axis_name, platform=platform)
+                        axis_name=axis_name, platform=platform,
+                        hist_reduce=hr_mode)
                 else:
                     # exact per-column counts (smaller-child C off the
                     # parent histogram) admit the pad-injected aligned
@@ -472,6 +527,7 @@ def grow_tree_leafwise_batched(
                         # exactly where staged gather prefixes pay (see
                         # levelwise.py)
                         stage_gather=L < Pf,
+                        hist_reduce=hr_mode,
                     )
             hist_large = st["hists"][jnp.minimum(jarr, Pf - 1)] - hist_small
             ls = left_smaller[:, None, None, None]
@@ -503,8 +559,7 @@ def grow_tree_leafwise_batched(
             ch_lo = jnp.concatenate([lo_l, lo_r])
             ch_hi = jnp.concatenate([hi_l, hi_r])
             allow = ch_do & (d + 1 < D) & (ch_C >= 2 * p.min_data_in_leaf)
-            res = jax.vmap(best)(ch_hist, ch_G, ch_H, ch_C, allow,
-                                 ch_lo, ch_hi)
+            res = level_scan(ch_hist, ch_G, ch_H, ch_C, allow, ch_lo, ch_hi)
 
             cidx = jnp.where(ch_do, ch_heap, HN)
             st_new = dict(st)

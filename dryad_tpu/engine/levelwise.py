@@ -256,6 +256,65 @@ def grow_tree_levelwise(
             bundled_mask=bundled_mask,
         )
 
+    # ---- histogram-reduction arm (r16): fused psum vs feature-parallel ------
+    # reduce-scatter.  The gate (config.hist_reduce_resolved) is a pure
+    # function of (params, F/B shape, shard count) — same-program rule.
+    # On the feature arm every per-LEVEL builder reduce-scatters a static
+    # contiguous feature partition (each shard owns Fs = ceil(F/n) fully
+    # reduced columns, bitwise equal to the psum's slice), the split scan
+    # runs on the owned slice only (find_best_split_sliced over sliced
+    # masks), and one tiny per-level all_gather of packed records
+    # (combine_best_splits) makes every shard pick the fused scan's
+    # winner.  The ROOT stays on the fused psum + full scan: root_stats
+    # reads feature 0's bins (only shard 0 would own them) and the root
+    # is one slot — its payload is noise next to the P-wide levels.
+    from dryad_tpu.config import hist_reduce_resolved
+    from dryad_tpu.engine import distributed as _dist
+    from dryad_tpu.engine.split import find_best_split_sliced
+
+    n_shards = _dist.axis_shards(axis_name)
+    hr_mode = hist_reduce_resolved(p, F, B, n_shards)
+    feat_par = hr_mode == "feature"
+    FH = _dist.feature_slice_width(F, n_shards) if feat_par else F
+    if feat_par:
+        f_off = _dist.feature_shard_offset(axis_name, F)
+        fmask_s = _dist.feature_shard_slice(feat_mask, axis_name)
+        iscat_s = _dist.feature_shard_slice(is_cat_feat, axis_name)
+        mono_s = (_dist.feature_shard_slice(mono, axis_name)
+                  if mono is not None else None)
+        bund_s = (_dist.feature_shard_slice(bundled_mask, axis_name)
+                  if bundled_mask is not None else None)
+
+        def best_sliced(hist, G, H, C, lo, hi):
+            return find_best_split_sliced(
+                hist, G, H, C,
+                feat_offset=f_off,
+                num_features_total=F,
+                lambda_l2=p.lambda_l2,
+                min_child_weight=p.min_child_weight,
+                min_data_in_leaf=p.min_data_in_leaf,
+                feat_mask=fmask_s,
+                is_cat_feat=iscat_s,
+                has_cat=has_cat,
+                monotone=mono_s,
+                lo=lo,
+                hi=hi,
+                learn_missing=learn_missing,
+                bundled_mask=bund_s,
+            )
+
+    def level_scan(ch_hist, ch_G, ch_H, ch_C, allow, ch_lo, ch_hi):
+        """One level's children split finding — per-arm: the fused full
+        scan, or sliced scan + replicated combine (ONE all_gather for the
+        whole candidate batch)."""
+        if not feat_par:
+            return jax.vmap(best)(ch_hist, ch_G, ch_H, ch_C, allow,
+                                  ch_lo, ch_hi)
+        loc = jax.vmap(best_sliced)(ch_hist, ch_G, ch_H, ch_C, ch_lo, ch_hi)
+        return _dist.combine_best_splits(
+            loc, axis_name, allow=allow,
+            min_split_gain=p.min_split_gain, has_cat=has_cat)
+
     # ---- root (shared canonical construction) --------------------------------
     # ALL rows are partitioned (bag gates histograms only) so the final
     # row_slot yields each row's leaf without a separate traversal pass;
@@ -288,7 +347,12 @@ def grow_tree_levelwise(
     sp_CL = jnp.zeros((L,), jnp.float32).at[0].set(root.c_left)
     sp_catmask = jnp.zeros((L, Bc), bool).at[0].set(root.cat_mask)
     sp_dleft = jnp.ones((L,), bool).at[0].set(root.default_left)
-    hists = jnp.zeros((L, 3, F, B), jnp.float32).at[0].set(hist0)
+    # feature arm: the carried histogram buffer holds each shard's OWNED
+    # slice only (an n-fold HBM saving to boot); the replicated root hist
+    # is sliced once here so level-0 subtraction stays slice-local
+    hist0_loc = (_dist.feature_shard_slice(hist0, axis_name, axis=1)
+                 if feat_par else hist0)
+    hists = jnp.zeros((L, 3, FH, B), jnp.float32).at[0].set(hist0_loc)
 
     cover_arr = jnp.zeros((M,), jnp.float32).at[0].set(C0)
     feature = jnp.full((M,), -1, jnp.int32)
@@ -578,7 +642,8 @@ def grow_tree_levelwise(
                         jnp.where(left_smaller, lt_l[rjc], lt_r[rjc]), 0)
                     hist_small = leafperm.hist_from_layout(
                         lay_rec, seg_first, seg_nt, P, B, F, Xb.dtype,
-                        n_sel_tiles, axis_name=axis_name, platform=platform)
+                        n_sel_tiles, axis_name=axis_name, platform=platform,
+                        hist_reduce=hr_mode)
                     hist_large = hists[sj] - hist_small
                     ls = left_smaller[:, None, None, None]
                     hist_l = jnp.where(ls, hist_small, hist_large)
@@ -597,7 +662,8 @@ def grow_tree_levelwise(
                         jnp.where(sel_ok, lt_r[rjc], 0)])
                     h2 = leafperm.hist_from_layout(
                         lay_rec, segf2, segn2, 2 * P, B, F, Xb.dtype,
-                        n_sel_tiles, axis_name=axis_name, platform=platform)
+                        n_sel_tiles, axis_name=axis_name, platform=platform,
+                        hist_reduce=hr_mode)
                     hist_l, hist_r = h2[:P], h2[P:]
                 st = dict(st, lay_rec=lay_rec, lay_tile_run=lay_tr_new,
                           lay_run_slot=lay_rs_new)
@@ -628,7 +694,8 @@ def grow_tree_levelwise(
 
                     hist_small = pallas_hist.build_hist_small(
                         nat_tiles, g, h, smallsel, P, B, F,
-                        axis_name=axis_name, platform=platform)
+                        axis_name=axis_name, platform=platform,
+                        hist_reduce=hr_mode)
                 else:
                     # exact per-column counts (smaller-child C off the
                     # parent histogram, integer-exact in f32 below 2**24)
@@ -652,6 +719,7 @@ def grow_tree_levelwise(
                         # keeps every prefix ~100% and the extra gather
                         # branches only bloat (remote) compile
                         stage_gather=(L - 1) < (1 << (depth_cap - 1)),
+                        hist_reduce=hr_mode,
                     )
                 if p.hist_subtraction:
                     hist_large = hists[sj] - hist_small
@@ -665,7 +733,7 @@ def grow_tree_levelwise(
                                   largesel[jnp.minimum(row_slot, L)], P),
                         P, B,
                         rows_per_chunk=p.rows_per_chunk, axis_name=axis_name,
-                        precision=p.hist_precision,
+                        precision=p.hist_precision, hist_reduce=hr_mode,
                     )
                 ls = left_smaller[:, None, None, None]
                 hist_l = jnp.where(ls, hist_small, hist_large)
@@ -692,7 +760,7 @@ def grow_tree_levelwise(
             ch_lo = jnp.concatenate([lo_l, lo_r])
             ch_hi = jnp.concatenate([hi_l, hi_r])
             allow = ch_do & (d + 1 < depth_cap) & (ch_C >= 2 * p.min_data_in_leaf)
-            res = jax.vmap(best)(ch_hist, ch_G, ch_H, ch_C, allow, ch_lo, ch_hi)
+            res = level_scan(ch_hist, ch_G, ch_H, ch_C, allow, ch_lo, ch_hi)
 
             cidx = jnp.where(ch_do, ch_slot, L)
             slot_node = slot_node.at[cidx].set(ch_node, mode="drop")

@@ -499,6 +499,7 @@ def hist_from_plan(
     platform: str | None = None,
     records: jnp.ndarray | None = None,
     stage_gather: bool = True,
+    hist_reduce: str = "fused",
 ) -> jnp.ndarray:
     """Histogram leaf-grouped rows given a precomputed tile plan.
 
@@ -585,7 +586,9 @@ def hist_from_plan(
         axis_name=axis_name, platform=platform,
     )
     if axis_name is not None:
-        hist = jax.lax.psum(hist, axis_name)
+        from dryad_tpu.engine.distributed import reduce_hist
+
+        hist = reduce_hist(hist, axis_name, hist_reduce)
     return hist
 
 
@@ -603,6 +606,7 @@ def build_hist_segmented_pallas(
     records: jnp.ndarray | None = None,
     sel_counts: jnp.ndarray | None = None,
     stage_gather: bool = True,
+    hist_reduce: str = "fused",
 ) -> jnp.ndarray:
     """Per-leaf histograms for a whole tree level -> (P, 3, F, B) f32.
 
@@ -625,7 +629,7 @@ def build_hist_segmented_pallas(
     return hist_from_plan(
         Xb, g, h, buf, tile_leaf, tile_first, num_cols, total_bins,
         axis_name=axis_name, platform=platform, records=records,
-        stage_gather=stage_gather,
+        stage_gather=stage_gather, hist_reduce=hist_reduce,
     )
 
 # ---------------------------------------------------------------------------
@@ -680,7 +684,8 @@ def maybe_natural_tiles(Xb: jnp.ndarray, total_bins: int,
 
 def build_hist_small(nat_tiles, g, h, sel, num_cols: int, total_bins: int,
                      num_features: int, *, axis_name: str | None = None,
-                     platform: str | None = None) -> jnp.ndarray:
+                     platform: str | None = None,
+                     hist_reduce: str = "fused") -> jnp.ndarray:
     """(P, 3, F, B) via the natural-order pass: owns the drop-sentinel
     mapping (callers use sel == P for "drop") and the slot-budget check.
 
@@ -696,7 +701,8 @@ def build_hist_small(nat_tiles, g, h, sel, num_cols: int, total_bins: int,
                           total_bins=int(total_bins),
                           num_features=int(num_features),
                           num_cols=P,
-                          axis_name=axis_name, platform=platform)
+                          axis_name=axis_name, platform=platform,
+                          hist_reduce=hist_reduce)
 
 
 def natural_tiles(Xb: jnp.ndarray, total_bins: int) -> jnp.ndarray:
@@ -747,11 +753,12 @@ def _nat_kernel(x_ref, w_ref, o_ref, *, padded_bins: int):
 
 @functools.partial(jax.jit, static_argnames=("total_bins", "num_features",
                                              "num_cols", "axis_name",
-                                             "platform"))
+                                             "platform", "hist_reduce"))
 def build_hist_nat(Xt_nat, g, h, sel, *, total_bins: int, num_features: int,
                    num_cols: int = _NAT_SLOTS,
                    axis_name: str | None = None,
-                   platform: str | None = None) -> jnp.ndarray:
+                   platform: str | None = None,
+                   hist_reduce: str = "fused") -> jnp.ndarray:
     """(num_cols, 3, F, B) histograms from natural-order tiles; ``sel`` (N,)
     in [0, 16); values >= 16 drop the row.  Replaces the plan+gather
     pipeline for levels with few candidates — measured 154 vs 281 ms at
@@ -804,5 +811,7 @@ def build_hist_nat(Xt_nat, g, h, sel, *, total_bins: int, num_features: int,
     hc = out[:, 6]
     hist = jnp.stack([hg, hh, hc], axis=1)         # (num_cols, 3, F, B)
     if axis_name is not None:
-        hist = jax.lax.psum(hist, axis_name)
+        from dryad_tpu.engine.distributed import reduce_hist
+
+        hist = reduce_hist(hist, axis_name, hist_reduce)
     return hist

@@ -223,7 +223,10 @@ def _build_split_scan(rows, F, B, P, seed):
     allow = jnp.ones((2 * P,), bool)
 
     def step(s, hh, fmask, iscat, allow):
-        smod = s - jnp.floor(s / 4.0) * 4.0
+        # period-8 walk (the module rule: a period inside K would repeat
+        # the contrib multiset across the liveness seeds — gap 7 mod 4
+        # collides at K=4, which the K < WALK_PERIOD guard admits)
+        smod = s - jnp.floor(s / 8.0) * 8.0
         hh2 = hh * (1.0 + 0.01 * smod)       # gains are scale-sensitive
         G = hh2[:, 0].sum(axis=(1, 2))       # (lambda_l2 breaks homogeneity)
         H = hh2[:, 1].sum(axis=(1, 2))
@@ -346,6 +349,83 @@ def _build_route_gather(rows, F, B, P, seed):
     return step, (tile_run, run_slot), {"rows": rows, "num_slots": P}
 
 
+def _build_hist_reduce_scan(rows, F, B, P, seed, n_shards: int = 8):
+    """The feature-parallel reduction's per-device scan stage (r16): the
+    sliced best-split scan over ONE owned F/n feature slice + the packed
+    record combine over all n shards' records — exactly what each shard
+    computes per level under hist_reduce="feature" (the n-fold wire-
+    payload cut itself is static accounting, _comm_stats / jaxpr census,
+    not a single-device wall).  The other shards' records ride as fixed
+    all-masked (-inf) args, so the perturbed owned slice always wins and
+    the liveness signal flows scan -> combine -> contrib; the
+    perturbation scales the histogram (gains are lambda_l2-inhomogeneous,
+    same class as the split_scan probe — its fused scan is this probe's
+    comparison arm at the same shape, bench.py hist_reduce_probe)."""
+    import jax
+    import jax.numpy as jnp
+
+    from dryad_tpu.engine.split import (
+        combine_local_splits,
+        find_best_split_sliced,
+        pack_local_split,
+    )
+
+    rng = np.random.default_rng(seed)
+    Fs = -(-F // n_shards)
+    # the jit ARGUMENT is the per-device operand — the OWNED (2P, 3, Fs,
+    # B) slice, exactly what each shard scans under the feature arm (the
+    # full-width stack would make the perturbation multiply ~n_shards
+    # times wider than the measured stage and bias the fused-vs-feature
+    # bench comparison toward parity, besides shipping n times the bytes
+    # through the tunnel)
+    hists = np.stack([
+        rng.normal(size=(2 * P, Fs, B)),
+        rng.uniform(0.1, 1.0, size=(2 * P, Fs, B)),
+        rng.uniform(0.5, 2.0, size=(2 * P, Fs, B)),
+    ], axis=1).astype(np.float32) * (rows / max(B, 1))
+    hh0 = jnp.asarray(hists)
+    fmask0 = jnp.ones((Fs,), bool)
+    iscat0 = jnp.zeros((Fs,), bool)
+    allow = jnp.ones((2 * P,), bool)
+    # global node stats: scalars in the real arm (root/prefix records,
+    # never histogram re-sums) — scaled with the perturbation below so
+    # the gain grids stay consistent with the perturbed slice
+    G0 = jnp.asarray(hists[:, 0].sum(axis=(1, 2)) * n_shards)
+    H0 = jnp.asarray(hists[:, 1].sum(axis=(1, 2)) * n_shards)
+    C0 = jnp.asarray(hists[:, 2].sum(axis=(1, 2)) * n_shards)
+
+    def sliced(hh_slice, G_, H_, C_, fmask):
+        def one(hh_, g_, h_, c_):
+            return find_best_split_sliced(
+                hh_, g_, h_, c_, feat_offset=jnp.int32(0),
+                num_features_total=F, lambda_l2=1.0, min_child_weight=1e-3,
+                min_data_in_leaf=20, feat_mask=fmask, is_cat_feat=iscat0,
+                has_cat=False)
+        return jax.vmap(one)(hh_slice, G_, H_, C_)
+
+    # the non-owned shards' records: the SAME sliced scan, fully masked
+    # (-inf gains) — realistic combine width, deterministic loser rows
+    dead = pack_local_split(sliced(hh0, G0, H0, C0,
+                                   jnp.zeros((Fs,), bool)))
+    other_words = jnp.broadcast_to(dead[None],
+                                   (n_shards - 1,) + dead.shape)
+
+    def step(s, hh, G_, H_, C_, other):
+        smod = s - jnp.floor(s / 8.0) * 8.0  # period-8 walk (module rule)
+        scale = 1.0 + 0.01 * smod
+        hh2 = hh * scale                     # gains are scale-sensitive
+        words0 = pack_local_split(sliced(hh2, G_ * scale, H_ * scale,
+                                         C_ * scale, fmask0))
+        words = jnp.concatenate([words0[None], other], axis=0)
+        res = combine_local_splits(words, None, allow=allow,
+                                   min_split_gain=0.0, has_cat=False)
+        return s + 1.0, res.gain[0] + res.gain[-1]
+
+    return step, (hh0, G0, H0, C0, other_words), {"rows": rows,
+                                                  "num_slots": P,
+                                                  "n_shards": n_shards}
+
+
 def _build_predict_traversal(rows, F, B, P, seed, depth: int = 6):
     """Per-tree traversal (tree_leaves) on a synthetic complete tree.  The
     thresholds shift by the carried parity — ~N/B rows per node change
@@ -436,6 +516,10 @@ PROBES: dict[str, StageProbe] = {p.name: p for p in (
     StageProbe("split_scan",
                "vmapped best-split scan over 2P children",
                _build_split_scan),
+    StageProbe("hist_reduce",
+               "feature-parallel per-device stage: sliced F/8 split scan "
+               "+ packed record combine (hist_reduce='feature')",
+               _build_hist_reduce_scan),
     StageProbe("permute_records",
                "leafperm movement kernel (sides + level_moves + permute)",
                _build_permute_records),

@@ -348,20 +348,41 @@ def _comm_stats(p, F: int, B: int, K: int, n_shards: int,
                 shared_roots: bool = False,
                 num_rows: int | None = None,
                 padded_rows: int | None = None,
-                platform: str | None = None) -> dict:
-    """Static per-iteration histogram-allreduce payload (SURVEY.md §5
-    observability).  Every histogram builder issues ONE fused
-    grad/hess/count psum of its (..., 3, F, B) f32 output per call, so the
-    payload is a pure function of the growth policy's per-level candidate
-    widths — no runtime instrumentation needed (and none would survive jit
-    without a host sync).  Exact for the histogram psums — including
+                platform: str | None = None,
+                has_cat: bool = False) -> dict:
+    """Static per-iteration collective payload, PER ARM (SURVEY.md §5
+    observability; r16).  The payload is a pure function of the growth
+    policy's per-level candidate widths — no runtime instrumentation
+    needed (and none would survive jit without a host sync) — and the
+    jaxpr auditor cross-checks every call count against the traced
+    program (analysis/jaxpr_audit.py).
+
+    Byte convention: each collective is accounted by the REDUCED/GATHERED
+    output it delivers per device — psum: the full (..., 3, F, B) f32
+    stack (each device receives the whole reduced array; the pre-r16
+    numbers are unchanged); reduce-scatter: that stack / n_shards (each
+    device receives only its owned feature slice, the (n-1)/n payload cut
+    the feature arm exists for); all-gather: the gathered record block
+    (n_shards * records).  Exact for the histogram collectives — incl.
     shallow levels on the natural-order pass, which slices its fixed
-    16-slot kernel output to the P live slots BEFORE the psum
-    (pallas_hist.build_hist_small; ADVICE r3 #1/#2) so both histogram
-    paths allreduce the same (P, 3, F, B) payload; the GOSS global sort
-    and init-time collectives are excluded."""
+    16-slot kernel output to the P live slots BEFORE the reduction
+    (pallas_hist.build_hist_small; ADVICE r3 #1/#2); the GOSS global sort
+    and init-time collectives are excluded.
+
+    Per-arm plan (``hist_reduce`` key):
+    * fused — ONE fused grad/hess/count psum per builder call (root +
+      every level), the classic contract.
+    * feature — the ROOT keeps its fused psum (root_stats reads feature
+      0's bins and one slot is noise); every LEVEL builder call issues
+      one reduce-scatter of the feature-padded stack, plus ONE combine
+      all-gather of the level's 2P packed best-split records (~29 + B
+      bytes each).  The sequential (per-split) grower never consults the
+      knob — its arm always reports fused."""
+    from dryad_tpu.config import hist_reduce_resolved
+
     fb = 3 * F * B * 4
     L = p.effective_num_leaves
+    level_synchronous = True
     if p.growth == "depthwise" and p.max_depth > 0:
         D = p.max_depth
         # the gate predicate and phase boundary are the growers' OWN
@@ -387,13 +408,14 @@ def _comm_stats(p, F: int, B: int, K: int, n_shards: int,
                     and pallas_hist.supports(B)
                     and pallas_hist.nat_gate_admits(gate_rows, F, bin_bytes))
         d_switch, P_narrow, P_full = levelwise.phase_plan(D, L, nat_live)
-        widths = [P_narrow] * d_switch + [P_full] * (D - d_switch)
+        scan_widths = [P_narrow] * d_switch + [P_full] * (D - d_switch)
+        widths = list(scan_widths)
         level_calls = len(widths)
         if not p.hist_subtraction:
             # both children are histogrammed (no subtraction): the wired
-            # path (r10 lift) pays ONE 2P-column hist_from_layout psum
-            # per level, the legacy path a P-column small pass PLUS a
-            # P-column build_hist_multi — same bytes, different calls
+            # path (r10 lift) pays ONE 2P-column hist_from_layout
+            # reduction per level, the legacy path a P-column small pass
+            # PLUS a P-column build_hist_multi — same bytes, more calls
             widths = [2 * w for w in widths]
             if not use_layout:
                 level_calls = 2 * level_calls
@@ -404,18 +426,49 @@ def _comm_stats(p, F: int, B: int, K: int, n_shards: int,
                 and leafwise_fast.supports(p, F, B, num_rows)):
             D = p.max_depth
             d_switch, P_narrow, Pf = leafwise_fast.phase_plan(D)
-            widths = [P_narrow] * d_switch + [Pf] * (D - d_switch)
+            scan_widths = [P_narrow] * d_switch + [Pf] * (D - d_switch)
+            widths = list(scan_widths)
         else:
             widths = [1] * (L - 1)          # one masked pass per split
+            scan_widths = list(widths)
+            level_synchronous = False
         level_calls = len(widths)
-    per_tree = fb + sum(w * fb for w in widths)   # root + levels
+    mode = (hist_reduce_resolved(p, F, B, n_shards)
+            if level_synchronous else "fused")
     # multiclass shared-plan roots fold the K root passes into ONE psum of
     # the (K, 3, F, B) classes-builder output (same bytes, fewer calls)
     root_calls = 1 if (shared_roots and K > 1) else K
+    if mode == "feature":
+        n = max(int(n_shards), 1)
+        fs = -(-F // n)                       # owned features per shard
+        fb_slice = 3 * (fs * n) * B * 4 // n  # reduced slice delivered
+        # one packed LocalSplit record per candidate child: the (8,)
+        # uint32 word block (split.pack_local_split), plus the raw (B,)
+        # bool categorical membership row on categorical configs — which
+        # also rides its own gather, hence the per-level call count below
+        rec_b = 8 * 4 + (B if has_cat else 0)
+        ag_per_level = 2 if has_cat else 1
+        psum_calls = root_calls
+        psum_bytes = fb * K
+        rs_calls = level_calls * K
+        rs_bytes = K * sum(w * fb_slice for w in widths)
+        ag_calls = len(scan_widths) * ag_per_level * K
+        ag_bytes = K * sum(n * 2 * w * rec_b for w in scan_widths)
+    else:
+        psum_calls = root_calls + level_calls * K
+        psum_bytes = (fb + sum(w * fb for w in widths)) * K  # root + levels
+        rs_calls = rs_bytes = ag_calls = ag_bytes = 0
     return {
         "n_shards": int(n_shards),
-        "psum_calls_per_iter": root_calls + level_calls * K,
-        "psum_bytes_per_iter": per_tree * K,
+        "hist_reduce": mode,
+        "psum_calls_per_iter": psum_calls,
+        "psum_bytes_per_iter": psum_bytes,
+        "reduce_scatter_calls_per_iter": rs_calls,
+        "reduce_scatter_bytes_per_iter": rs_bytes,
+        "all_gather_calls_per_iter": ag_calls,
+        "all_gather_bytes_per_iter": ag_bytes,
+        "collective_calls_per_iter": psum_calls + rs_calls + ag_calls,
+        "collective_bytes_per_iter": psum_bytes + rs_bytes + ag_bytes,
     }
 
 
@@ -746,8 +799,18 @@ def train_device(
 
     comm = (_comm_stats(p_key, F, B, K, mesh.devices.size,
                         shared_roots=K > 1 and _shared_roots_ok(p, plat),
-                        num_rows=N, padded_rows=NP, platform=plat)
+                        num_rows=N, padded_rows=NP, platform=plat,
+                        has_cat=has_cat)
             if mesh is not None else None)
+    if comm is not None:
+        # comm-payload observability (r16): the static accounting becomes
+        # dryad_comm_* gauges at this compile boundary, so a reduce-payload
+        # regression (or an arm flip) is trend-visible on /metrics.  The
+        # export is jax-free on the obs side (obs/comm.py) and a no-op on
+        # a disabled registry.
+        from dryad_tpu.obs.comm import export_comm_stats
+
+        export_comm_stats(comm, growth=p.growth)
 
     # EFB bundle columns are masked out of the missing-right split plane
     # (their bin 0 means "all default", not "missing"); only materialized

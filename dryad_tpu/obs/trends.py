@@ -69,6 +69,8 @@ LOWER_BETTER = frozenset({
     "marginal_s_per_iter_10m", "wall_2tree_10m", "wall_8tree_10m",
     "deep_level_ms_wired", "deep_level_ms_legacy",
     "leafwise_level_ms_wired", "leafwise_level_ms_legacy",
+    # r16 wide-shape histogram-reduction arms (bench.py hist_reduce_probe)
+    "hist_reduce_ms_fused", "hist_reduce_ms_feature",
     "supervisor_overhead_ms", "obs_overhead_ms", "obs_overhead_pct",
     "p50_ms", "p99_ms",
 })
@@ -84,6 +86,8 @@ _SPREAD_FIELDS = {
     "deep_level_ms_legacy": ("deep_level_spread_legacy",),
     "leafwise_level_ms_wired": ("leafwise_level_spread_wired",),
     "leafwise_level_ms_legacy": ("leafwise_level_spread_legacy",),
+    "hist_reduce_ms_fused": ("hist_reduce_spread_fused",),
+    "hist_reduce_ms_feature": ("hist_reduce_spread_feature",),
     "supervisor_overhead_ms": ("supervisor_overhead_spread",),
     "obs_overhead_ms": ("obs_overhead_spread",),
     "obs_overhead_pct": ("obs_overhead_spread",),

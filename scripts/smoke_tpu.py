@@ -236,6 +236,47 @@ def smoke_leafwise_wired_parity():
     print("leafwise wired expansion: trees bitwise vs legacy on device")
 
 
+def smoke_hist_reduce_parity():
+    """Feature-parallel reduction arm (r16, hist_reduce="feature") vs the
+    fused arm ON THE REAL DEVICE: bitwise-identical trees (values
+    included) on the tie-free fixture.  A single attached TPU runs the
+    DEGENERATE feature program — full slice, packed-record combine, no
+    collectives — which is exactly the program piece interpret-mode CI
+    cannot vouch for: the sliced scan + bitcast pack/combine lower
+    through different fusion shapes than the fused scan, and a lowering
+    drift here would flip near-tie argmaxes on device.  (The collective
+    halves — reduce-scatter bitwise vs psum slices, the all-gather
+    combine — are pinned on the 8-virtual-device mesh in
+    tests/test_hist_reduce.py; a multi-chip session should re-run that
+    parity against real ICI once available.)"""
+    import jax
+    import numpy as np
+
+    import dryad_tpu as dryad
+    from dryad_tpu.config import make_params
+    from dryad_tpu.datasets import higgs_like
+    from dryad_tpu.engine.train import train_device
+
+    if jax.devices()[0].platform == "cpu":
+        print("hist-reduce parity: skipped (no accelerator attached)")
+        return
+    X, y = higgs_like(50_000, seed=47)
+    ds = dryad.Dataset(X, y, max_bins=64)
+    for growth, depth in (("depthwise", 8), ("leafwise", 8)):
+        base = dict(objective="binary", num_trees=4, num_leaves=128,
+                    max_bins=64, growth=growth, max_depth=depth)
+        b_f = train_device(make_params(dict(base, hist_reduce="fused")), ds)
+        b_x = train_device(make_params(dict(base, hist_reduce="feature")),
+                           ds)
+        for k in ("feature", "threshold", "left", "right", "is_cat",
+                  "value", "gain"):
+            np.testing.assert_array_equal(
+                b_f.tree_arrays()[k], b_x.tree_arrays()[k],
+                err_msg=f"hist_reduce fused vs feature ({growth}): {k!r}")
+    print("hist-reduce fused vs feature: trees bitwise on device "
+          "(both growers, degenerate 1-shard feature program)")
+
+
 def smoke_stage_profiler():
     """First per-stage device breakdown (r13): run the cheap tier of the
     stage-probe registry (engine/probes) on the attached device, each
@@ -300,6 +341,7 @@ _ALL_SMOKES = [
     smoke_pallas_natural_order,
     smoke_leafperm_wired_parity,
     smoke_leafwise_wired_parity,
+    smoke_hist_reduce_parity,
     smoke_stage_profiler,
 ]
 

@@ -60,8 +60,10 @@ def test_psum_census_matches_comm_stats_every_arm(audit_report):
 
 
 def test_wired_paths_sort_free(audit_report):
-    """'Nothing on the wired path sorts rows' (r10) — now machine-checked."""
-    for name in ("levelwise_wired", "leafwise_wired"):
+    """'Nothing on the wired path sorts rows' (r10) — now machine-checked
+    (the r16 feature-reduction arms ride the wired layout too)."""
+    for name in ("levelwise_wired", "leafwise_wired",
+                 "levelwise_feature", "leafwise_feature"):
         c = _arm(audit_report, name).census
         assert c.global_row_sorts == 0 and c.local_row_sorts == 0, name
 
@@ -89,11 +91,34 @@ def test_sharded_predict_collective_free(audit_report):
     assert c.global_row_sorts == 0 and c.local_row_sorts == 0
 
 
-def test_only_psum_collectives_anywhere(audit_report):
+def test_only_documented_collectives_anywhere(audit_report):
+    """fused arms: psum only.  feature arms (r16): psum (root) +
+    reduce_scatter + all_gather (+ the communication-free axis_index the
+    slice/offset derivation uses) — nothing else, anywhere."""
+    feature_arms = {"levelwise_feature", "leafwise_feature"}
     for arm in audit_report.arms:
+        allowed = {"psum"}
+        if arm.name in feature_arms:
+            allowed |= {"reduce_scatter", "all_gather", "axis_index"}
         extra = {k: v for k, v in arm.census.collectives.items()
-                 if k != "psum"}
+                 if k not in allowed}
         assert not extra, (arm.name, extra)
+
+
+def test_feature_arm_collective_plan_matches_comm_stats(audit_report):
+    """The r16 collective plan, census-verified: on the feature arms the
+    root keeps ONE psum, every level shows exactly one reduce_scatter and
+    one combine all_gather (cross-checked against _comm_stats inside
+    trace_arm; re-asserted here so the plan is visible in the test)."""
+    for name, levels in (("levelwise_feature", 7), ("leafwise_feature", 5)):
+        c = _arm(audit_report, name).census
+        assert c.collectives.get("psum", 0) == 1, name
+        assert c.collectives.get("reduce_scatter", 0) == levels, name
+        assert c.collectives.get("all_gather", 0) == levels, name
+    # the fused twins are untouched: same configs, psum-only plans
+    for name in ("levelwise_wired", "leafwise_wired"):
+        c = _arm(audit_report, name).census
+        assert set(c.collectives) == {"psum"}, name
 
 
 def test_wired_kernels_present_and_u8(audit_report):
@@ -276,7 +301,8 @@ def test_single_arm_trace_smoke():
     rep = trace_arm("sharded_predict")
     assert rep.ok and rep.digest
     assert set(ARMS) >= {"levelwise_wired", "levelwise_legacy",
-                         "leafwise_wired", "goss_iteration",
+                         "leafwise_wired", "levelwise_feature",
+                         "leafwise_feature", "goss_iteration",
                          "renewal_iteration", "multiclass_shared_roots",
                          "sharded_predict"}
 

@@ -1,0 +1,397 @@
+"""Feature-parallel histogram reduction (r16, ``Params.hist_reduce``):
+
+* the packed combine key reproduces the fused scan's feature-major
+  first-max argmax order EXACTLY (tie-convention unit tests on seeded
+  equal-gain grids, incl. the learn_missing plane order and categorical
+  winners);
+* N-shard ≡ 1-shard ≡ fused bitwise tree structures on the tie-free
+  fixtures across 1/2/8 fake devices — incl. GOSS, L1 renewal,
+  multiclass K=3, and ragged feature counts (28 % 8 != 0, plus an F=10
+  fixture whose tail shards own ONLY padding);
+* the accounted collective payload at the Epsilon shape (F=2000, B=256,
+  8 shards) shrinks ≥ 4x on the feature arm — the same accounting the
+  jaxpr census cross-checks call-for-call (test_analysis_jaxpr).
+
+The sliced scan + combine are exercised both as pure functions (no mesh
+— a host-side simulation of the shard slices) and end-to-end through
+``train_device`` on the virtual 8-CPU-device mesh.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import dryad_tpu as dryad
+from dryad_tpu.config import make_params
+from dryad_tpu.datasets import higgs_like
+from dryad_tpu.engine.split import (
+    NEG_INF,
+    combine_local_splits,
+    find_best_split,
+    find_best_split_sliced,
+    pack_local_split,
+)
+
+pytestmark = pytest.mark.distributed
+
+
+# ---------------------------------------------------------------------------
+# tie-convention unit tests: sliced + combine == fused, field for field
+
+def _sliced_combine(hist, G, H, C, n, *, feat_mask, is_cat_feat, allow,
+                    has_cat=False, learn_missing=False, min_split_gain=0.0,
+                    lambda_l2=1.0, min_child_weight=1e-3,
+                    min_data_in_leaf=1):
+    """Host-side simulation of the feature arm: slice the reduced hist
+    into n contiguous shards (zero/False padding like
+    distributed.feature_shard_slice), run the sliced scan per shard, pack
+    + stack the records like the all_gather would, combine."""
+    F = hist.shape[1]
+    Fs = -(-F // n)
+    pad = Fs * n - F
+    hist_p = jnp.pad(hist, ((0, 0), (0, pad), (0, 0)))
+    fmask_p = jnp.pad(feat_mask, (0, pad))
+    iscat_p = jnp.pad(is_cat_feat, (0, pad))
+    words, cats = [], []
+    for s in range(n):
+        lo, hi = s * Fs, (s + 1) * Fs
+        rec = find_best_split_sliced(
+            hist_p[:, lo:hi], G, H, C,
+            feat_offset=jnp.int32(lo), num_features_total=F,
+            lambda_l2=lambda_l2, min_child_weight=min_child_weight,
+            min_data_in_leaf=min_data_in_leaf,
+            feat_mask=fmask_p[lo:hi], is_cat_feat=iscat_p[lo:hi],
+            has_cat=has_cat, learn_missing=learn_missing)
+        words.append(pack_local_split(rec))
+        cats.append(rec.cat_mask)
+    return combine_local_splits(
+        jnp.stack(words), jnp.stack(cats) if has_cat else None,
+        allow=allow, min_split_gain=min_split_gain, has_cat=has_cat)
+
+
+def _assert_same_split(got, want, msg=""):
+    for field in ("gain", "feature", "threshold", "g_left", "h_left",
+                  "c_left", "default_left"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(got, field)), np.asarray(getattr(want, field)),
+            err_msg=f"{msg}: {field}")
+    np.testing.assert_array_equal(np.asarray(got.cat_mask),
+                                  np.asarray(want.cat_mask),
+                                  err_msg=f"{msg}: cat_mask")
+
+
+def _rand_hist(rng, F, B, scale=100.0):
+    return jnp.asarray(np.stack([
+        rng.normal(size=(F, B)),
+        rng.uniform(0.1, 1.0, size=(F, B)),
+        rng.uniform(0.5, 2.0, size=(F, B)),
+    ]).astype(np.float32) * scale)
+
+
+def test_combine_matches_fused_on_random_grids():
+    rng = np.random.default_rng(5)
+    for F, B in ((28, 32), (10, 16), (5, 8)):
+        hist = _rand_hist(rng, F, B)
+        G, H, C = (hist[k].sum() for k in range(3))
+        fmask = jnp.ones((F,), bool)
+        iscat = jnp.zeros((F,), bool)
+        allow = jnp.bool_(True)
+        want = find_best_split(
+            hist, G, H, C, lambda_l2=1.0, min_child_weight=1e-3,
+            min_data_in_leaf=1, min_split_gain=0.0, feat_mask=fmask,
+            is_cat_feat=iscat, allow=allow, has_cat=False)
+        for n in (1, 2, 4, 8):
+            got = _sliced_combine(hist, G, H, C, n, feat_mask=fmask,
+                                  is_cat_feat=iscat, allow=allow)
+            _assert_same_split(got, want, f"F={F} n={n}")
+
+
+def test_combine_tie_breaks_like_fused_feature_major():
+    """Two IDENTICAL per-feature histogram rows land in DIFFERENT shards:
+    equal gains to the last bit, and the fused first-max picks the lower
+    feature id — the packed min-key combine must agree."""
+    rng = np.random.default_rng(7)
+    F, B = 16, 8
+    hist = np.asarray(_rand_hist(rng, F, B))
+    for f_lo, f_hi in ((1, 9), (0, 15), (3, 12), (7, 8)):
+        h2 = hist.copy()
+        h2[:, f_hi] = h2[:, f_lo]          # bitwise-equal gain rows
+        # make the duplicated feature the undisputed winner: boost its
+        # gradient asymmetry so its best gain dominates the rest
+        h2[0, f_lo] *= 50.0
+        h2[0, f_hi] = h2[0, f_lo]
+        hj = jnp.asarray(h2)
+        G, H, C = (hj[k].sum() for k in range(3))
+        fmask = jnp.ones((F,), bool)
+        iscat = jnp.zeros((F,), bool)
+        want = find_best_split(
+            hj, G, H, C, lambda_l2=1.0, min_child_weight=1e-3,
+            min_data_in_leaf=1, min_split_gain=0.0, feat_mask=fmask,
+            is_cat_feat=iscat, allow=jnp.bool_(True), has_cat=False)
+        assert int(want.feature) == f_lo, "fixture lost its tie"
+        for n in (2, 4, 8):
+            got = _sliced_combine(hj, G, H, C, n, feat_mask=fmask,
+                                  is_cat_feat=iscat, allow=jnp.bool_(True))
+            _assert_same_split(got, want, f"tie {f_lo}/{f_hi} n={n}")
+
+
+def test_combine_tie_breaks_plane_major_with_learn_missing():
+    """learn_missing scans two planes, missing-left FIRST across ALL
+    features: a plane-1 candidate in a LOW shard must lose an equal-gain
+    plane-0 candidate in a HIGH shard (the fused flattened order is
+    plane-major) — the key's plane stride pins exactly this."""
+    rng = np.random.default_rng(11)
+    F, B = 12, 8
+    hist = np.array(np.asarray(_rand_hist(rng, F, B)))
+    hist[:, :, 0] = 0.0                    # no missing stats: the two
+    hj = jnp.asarray(hist)                 # planes are numerically equal
+    G, H, C = (hj[k].sum() for k in range(3))
+    fmask = jnp.ones((F,), bool)
+    iscat = jnp.zeros((F,), bool)
+    want = find_best_split(
+        hj, G, H, C, lambda_l2=1.0, min_child_weight=1e-3,
+        min_data_in_leaf=1, min_split_gain=0.0, feat_mask=fmask,
+        is_cat_feat=iscat, allow=jnp.bool_(True), has_cat=False,
+        learn_missing=True)
+    assert bool(want.default_left), "missing-left plane must win the tie"
+    for n in (1, 2, 4):
+        got = _sliced_combine(hj, G, H, C, n, feat_mask=fmask,
+                              is_cat_feat=iscat, allow=jnp.bool_(True),
+                              learn_missing=True)
+        _assert_same_split(got, want, f"plane tie n={n}")
+
+
+def test_combine_categorical_winner_carries_its_mask():
+    rng = np.random.default_rng(13)
+    F, B = 8, 16
+    hist = _rand_hist(rng, F, B)
+    G, H, C = (hist[k].sum() for k in range(3))
+    fmask = jnp.ones((F,), bool)
+    iscat = jnp.asarray(np.arange(F) % 2 == 1)   # odd features categorical
+    want = find_best_split(
+        hist, G, H, C, lambda_l2=1.0, min_child_weight=1e-3,
+        min_data_in_leaf=1, min_split_gain=0.0, feat_mask=fmask,
+        is_cat_feat=iscat, allow=jnp.bool_(True), has_cat=True)
+    for n in (1, 2, 4):
+        got = _sliced_combine(hist, G, H, C, n, feat_mask=fmask,
+                              is_cat_feat=iscat, allow=jnp.bool_(True),
+                              has_cat=True)
+        _assert_same_split(got, want, f"cat n={n}")
+
+
+def test_combine_all_invalid_matches_fused_defaults():
+    """Every candidate -inf (allow False / empty grids): the combine must
+    reproduce the fused scan's not-ok record (gain -inf, feature -1,
+    default_left True) — shard 0's plane-0 key-0 record wins, exactly the
+    fused flat argmax of an all--inf grid."""
+    F, B = 8, 8
+    hist = jnp.zeros((3, F, B), jnp.float32)
+    G = H = C = jnp.float32(0.0)
+    fmask = jnp.ones((F,), bool)
+    iscat = jnp.zeros((F,), bool)
+    for allow in (jnp.bool_(True), jnp.bool_(False)):
+        want = find_best_split(
+            hist, G, H, C, lambda_l2=1.0, min_child_weight=1e-3,
+            min_data_in_leaf=1, min_split_gain=0.0, feat_mask=fmask,
+            is_cat_feat=iscat, allow=allow, has_cat=False)
+        for n in (1, 4):
+            got = _sliced_combine(hist, G, H, C, n, feat_mask=fmask,
+                                  is_cat_feat=iscat, allow=allow)
+            _assert_same_split(got, want, f"invalid allow={bool(allow)} n={n}")
+        assert float(want.gain) == NEG_INF
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: feature ≡ fused ≡ cross-shard, bitwise on tie-free fixtures
+
+@pytest.fixture(scope="module")
+def meshes():
+    from dryad_tpu.engine.distributed import make_mesh
+
+    assert len(jax.devices()) >= 8, "conftest must provide 8 virtual devices"
+    return {n: make_mesh(jax.devices()[:n]) for n in (1, 2, 8)}
+
+
+def _train(params_dict, ds, mesh=None):
+    from dryad_tpu.engine.train import train_device
+
+    return train_device(make_params(params_dict), ds, mesh=mesh)
+
+
+def _assert_trees_equal(a, b, msg, values="bitwise"):
+    for k in ("feature", "threshold", "left", "right", "is_cat"):
+        np.testing.assert_array_equal(a.tree_arrays()[k], b.tree_arrays()[k],
+                                      err_msg=f"{msg}: {k}")
+    if values == "bitwise":
+        np.testing.assert_array_equal(a.value, b.value, err_msg=f"{msg}: value")
+    else:
+        np.testing.assert_allclose(a.value, b.value, atol=1e-3,
+                                   err_msg=f"{msg}: value")
+
+
+@pytest.fixture(scope="module")
+def depthwise_boosters(meshes):
+    """Fused + feature boosters at every mesh size on ONE tie-free
+    fixture (F=28: 28 % 8 != 0, so the 8-shard slices are ragged) —
+    shared by the bitwise-vs-fused and shard-count-invariance tests."""
+    X, y = higgs_like(4096)
+    ds = dryad.Dataset(X, y, max_bins=64)
+    base = dict(objective="binary", num_trees=3, num_leaves=15, max_depth=4,
+                growth="depthwise", max_bins=64, learning_rate=0.2)
+    return {(arm, n): _train(dict(base, hist_reduce=arm), ds, mesh)
+            for arm in ("fused", "feature")
+            for n, mesh in meshes.items()}
+
+
+def test_feature_equals_fused_bitwise_every_shard_count(depthwise_boosters):
+    """The acceptance anchor: at EVERY shard count the feature arm's trees
+    — values included — are bitwise the fused arm's (the reduce-scattered
+    slices are bitwise the psum's, and the combine picks the fused
+    winner)."""
+    for n in (1, 2, 8):
+        bf = depthwise_boosters[("fused", n)]
+        bx = depthwise_boosters[("feature", n)]
+        _assert_trees_equal(bx, bf, f"depthwise n={n}")
+        np.testing.assert_array_equal(bx.tree_arrays()["gain"],
+                                      bf.tree_arrays()["gain"])
+
+
+def test_feature_arm_shard_count_invariant(depthwise_boosters):
+    """feature @ 1 shard ≡ feature @ 2 ≡ feature @ 8 (tree structures;
+    values to the documented fp32 reduction-order tolerance, same class
+    as the fused arm's own N-shard ≡ 1-shard invariant)."""
+    for n in (2, 8):
+        _assert_trees_equal(depthwise_boosters[("feature", n)],
+                            depthwise_boosters[("feature", 1)],
+                            f"1-vs-{n} shards", values="close")
+
+
+def test_feature_equals_fused_leafwise(meshes):
+    X, y = higgs_like(4096)
+    ds = dryad.Dataset(X, y, max_bins=64)
+    base = dict(objective="binary", num_trees=3, num_leaves=15, max_depth=5,
+                growth="leafwise", max_bins=64)
+    for n in (1, 8):
+        bf = _train(dict(base, hist_reduce="fused"), ds, meshes[n])
+        bx = _train(dict(base, hist_reduce="feature"), ds, meshes[n])
+        _assert_trees_equal(bx, bf, f"leafwise n={n}")
+
+
+def test_feature_arm_goss(meshes):
+    X, y = higgs_like(4096, seed=41)
+    ds = dryad.Dataset(X, y, max_bins=32)
+    base = dict(objective="binary", num_trees=3, num_leaves=15, max_depth=4,
+                growth="depthwise", max_bins=32, boosting="goss",
+                goss_top_rate=0.3, goss_other_rate=0.2, seed=7)
+    for n in (8,):
+        bf = _train(dict(base, hist_reduce="fused"), ds, meshes[n])
+        bx = _train(dict(base, hist_reduce="feature"), ds, meshes[n])
+        _assert_trees_equal(bx, bf, f"goss n={n}")
+
+
+def test_feature_arm_l1_renewal(meshes):
+    X, y = higgs_like(4096, seed=43)
+    ds = dryad.Dataset(X, y, max_bins=32)
+    base = dict(objective="l1", num_trees=3, num_leaves=15, max_depth=4,
+                growth="leafwise", max_bins=32)
+    for n in (8,):
+        bf = _train(dict(base, hist_reduce="fused"), ds, meshes[n])
+        bx = _train(dict(base, hist_reduce="feature"), ds, meshes[n])
+        _assert_trees_equal(bx, bf, f"l1 n={n}")
+
+
+def test_feature_arm_multiclass_k3(meshes):
+    rng = np.random.Generator(np.random.Philox(21))
+    X = rng.normal(size=(4096, 10)).astype(np.float32)
+    y = (X[:, 0] + X[:, 1] > 0).astype(np.float32) + (X[:, 2] > 1) * 1.0
+    ds = dryad.Dataset(X, y, max_bins=32)
+    base = dict(objective="multiclass", num_class=3, num_trees=2,
+                num_leaves=8, max_depth=3, growth="depthwise", max_bins=32)
+    for n in (8,):
+        bf = _train(dict(base, hist_reduce="fused"), ds, meshes[n])
+        bx = _train(dict(base, hist_reduce="feature"), ds, meshes[n])
+        _assert_trees_equal(bx, bf, f"multiclass n={n}")
+
+
+def test_feature_arm_all_padding_shards(meshes):
+    """F=10 over 8 shards: Fs=2, Fpad=16 — shards 5..7 own ONLY padding
+    and must contribute harmless -inf records."""
+    rng = np.random.Generator(np.random.Philox(29))
+    X = rng.normal(size=(2048, 10)).astype(np.float32)
+    y = (X[:, 0] + 0.5 * X[:, 3] > 0).astype(np.float32)
+    ds = dryad.Dataset(X, y, max_bins=32)
+    base = dict(objective="binary", num_trees=3, num_leaves=8, max_depth=3,
+                growth="depthwise", max_bins=32)
+    bf = _train(dict(base, hist_reduce="fused"), ds, meshes[8])
+    bx = _train(dict(base, hist_reduce="feature"), ds, meshes[8])
+    _assert_trees_equal(bx, bf, "all-padding shards")
+
+
+# ---------------------------------------------------------------------------
+# accounting: the ≥4x wide-shape payload cut, and the auto gate
+
+def test_comm_stats_wide_shape_payload_ratio():
+    """Acceptance: at F=2000, B=256, 8 shards the feature arm's accounted
+    per-iteration collective payload (the same accounting the jaxpr
+    census verifies call-for-call) is ≥ 4x below the fused arm's."""
+    from dryad_tpu.engine.train import _comm_stats
+
+    base = dict(objective="binary", num_trees=1, num_leaves=64, max_depth=6,
+                growth="depthwise", max_bins=256)
+    fused = _comm_stats(make_params(dict(base, hist_reduce="fused")),
+                        2000, 256, 1, 8, num_rows=400_000,
+                        padded_rows=400_000, platform="tpu")
+    feat = _comm_stats(make_params(dict(base, hist_reduce="feature")),
+                       2000, 256, 1, 8, num_rows=400_000,
+                       padded_rows=400_000, platform="tpu")
+    assert fused["hist_reduce"] == "fused"
+    assert feat["hist_reduce"] == "feature"
+    ratio = (fused["collective_bytes_per_iter"]
+             / feat["collective_bytes_per_iter"])
+    assert ratio >= 4.0, ratio
+    # the arm swaps the level psums for reduce-scatter + combine gathers
+    assert feat["psum_calls_per_iter"] == 1            # the root only
+    assert feat["reduce_scatter_calls_per_iter"] == 6  # one per level
+    assert feat["all_gather_calls_per_iter"] == 6
+
+
+def test_hist_reduce_auto_gate():
+    """auto = feature iff wide AND sharded — never a function of rows."""
+    from dryad_tpu.config import hist_reduce_resolved
+
+    p = make_params(dict(objective="binary", growth="depthwise",
+                         max_depth=6, num_leaves=64, max_bins=256))
+    assert p.hist_reduce == "auto"
+    assert hist_reduce_resolved(p, 2000, 256, 8) == "feature"
+    assert hist_reduce_resolved(p, 2000, 256, 1) == "fused"   # unsharded
+    assert hist_reduce_resolved(p, 28, 256, 8) == "fused"     # narrow
+    pf = p.replace(hist_reduce="feature")
+    assert hist_reduce_resolved(pf, 28, 256, 1) == "feature"  # explicit
+    with pytest.raises(ValueError):
+        p.replace(hist_reduce="bogus")
+
+
+def test_comm_gauges_exported():
+    from dryad_tpu.obs.comm import export_comm_stats
+    from dryad_tpu.obs.registry import Registry
+
+    comm = {"n_shards": 8, "hist_reduce": "feature",
+            "psum_bytes_per_iter": 3072,
+            "reduce_scatter_bytes_per_iter": 86016,
+            "all_gather_bytes_per_iter": 14336,
+            "collective_bytes_per_iter": 103424,
+            "collective_calls_per_iter": 15}
+    reg = Registry(enabled=True)
+    n = export_comm_stats(comm, growth="depthwise", registry=reg)
+    assert n == 5
+    text = reg.exposition()
+    assert "dryad_comm_psum_bytes_per_iter" in text
+    assert "dryad_comm_collective_calls_per_iter" in text
+    assert 'arm="feature"' in text
+    # zero-cost when disabled
+    off = Registry(enabled=False)
+    assert export_comm_stats(comm, growth="depthwise", registry=off) == 0
