@@ -404,6 +404,14 @@ def cmd_serve(args) -> int:
     from dryad_tpu.resilience.faults import injector_from_env
     from dryad_tpu.serve.http import make_http_server
 
+    # request tracing (r17): install the span ring so /trace serves and
+    # per-request stage spans are captured — DRYAD_TRACE=0 opts out (the
+    # obs registry disabled also keeps the request path allocation-free)
+    if os.environ.get("DRYAD_TRACE", "1") != "0":
+        from dryad_tpu.obs.trace_export import enable_tracing
+
+        enable_tracing()
+
     # replica fault drills (fleet supervisor -> env -> this process):
     # absent/empty env costs nothing; a malformed spec fails startup loudly
     fault_hook = injector_from_env()
@@ -440,7 +448,15 @@ def cmd_fleet(args) -> int:
     the health-routed fleet router (dryad_tpu/fleet)."""
     from dryad_tpu.fleet import FleetSupervisor, make_fleet_router, serve_argv
     from dryad_tpu.fleet.router import main_loop
+    from dryad_tpu.obs.slo import parse_budgets
     from dryad_tpu.resilience.policy import RetryPolicy
+
+    # router-side tracing: the merged /trace endpoint needs the router's
+    # own span ring (replicas enable theirs in cmd_serve)
+    if os.environ.get("DRYAD_TRACE", "1") != "0":
+        from dryad_tpu.obs.trace_export import enable_tracing
+
+        enable_tracing()
 
     model_caps = {}
     for spec in args.model_cap or []:
@@ -486,7 +502,9 @@ def cmd_fleet(args) -> int:
             model_caps=model_caps or None,
             request_timeout_s=args.request_timeout,
             min_healthy=args.min_healthy,
-            auth_token=args.auth_token, verbose=not args.quiet)
+            auth_token=args.auth_token, verbose=not args.quiet,
+            slo_budgets_ms=parse_budgets(args.slo_ms),
+            slo_breach_after=args.slo_breach_after)
         host, port = httpd.server_address[:2]
         if not args.quiet:
             urls = {s.name: s.state()["url"]
@@ -675,6 +693,14 @@ def main(argv=None) -> int:
                          "routable replicas")
     fl.add_argument("--probe-interval", type=float, default=0.25,
                     help="supervisor health-probe cadence (seconds)")
+    fl.add_argument("--slo-ms", default="",
+                    help="per-priority p99 budgets as "
+                         "'interactive=250,bulk=2000' (ms; the defaults) — "
+                         "a SUSTAINED breach degrades the router /healthz; "
+                         "'off' disables SLO health-gating")
+    fl.add_argument("--slo-breach-after", type=int, default=3,
+                    help="consecutive over-budget /healthz evaluations "
+                         "before the SLO degrades the router")
     fl.add_argument("--startup-timeout", type=float, default=120.0,
                     help="per-replica readiness deadline (device replicas "
                          "pay model load + compile here)")

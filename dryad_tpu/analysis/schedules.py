@@ -784,6 +784,11 @@ class _FakeReplicaProc:
     def url(self) -> str:
         return f"http://{self.host}:{self.port}"
 
+    def clock_offset(self, timeout_s: float = 2.0) -> Optional[float]:
+        # the r17 registration handshake: a fake replica has no /clock
+        # (same shape as the protocol stubs' pre-r17 answer)
+        return None
+
     def health(self, timeout_s: float = 2.0):
         self._sched.pause()
         if self.exit_code is not None:
@@ -1031,6 +1036,73 @@ def drill_registry_snapshot(sched: Scheduler):
     return check
 
 
+def drill_loghist_scrape_tear(sched: Scheduler):
+    """r17 log-histogram scrape-tear: concurrent O(1) observes into two
+    registries (two "replicas") while a scraper snapshots both and
+    EXACT-MERGES their series — the fleet router's /metrics shape.
+
+    Invariants: every scraped state is internally consistent (count ==
+    sum of bucket counts — a torn counts/sum/count triple is the race
+    the line-granular preemption exposes), every merged state is too,
+    and the FINAL merge is bitwise-equal to one histogram of the
+    concatenated observations (dyadic values make the float sums
+    associative, so "bitwise" is exact, not approximate)."""
+    from dryad_tpu.obs.registry import (REQUEST_LATENCY, Registry,
+                                        merge_hist_states)
+
+    regs = [Registry(enabled=True), Registry(enabled=True)]
+    fams = [r.log_histogram(REQUEST_LATENCY, "drill") for r in regs]
+    values = [2.0 ** -k for k in range(1, 7)]      # dyadic: exact sums
+    merges: list = []
+
+    def writer(ri: int) -> Callable[[], None]:
+        series = fams[ri].labels(priority="interactive", stage="total")
+
+        def run() -> None:
+            for v in values:
+                series.observe(v)
+        return run
+
+    def scraper() -> None:
+        for _ in range(5):
+            blocks = [r.snapshot()["histograms"].get(REQUEST_LATENCY, {})
+                      for r in regs]
+            per_label: dict = {}
+            for block in blocks:
+                for lbl, st in block.items():
+                    assert st["count"] == sum(st["counts"]), (
+                        f"torn scraped state {lbl}: {st}")
+                    per_label.setdefault(lbl, []).append(
+                        (st["counts"], st["sum"], st["count"]))
+            merged = {lbl: merge_hist_states(sts)
+                      for lbl, sts in per_label.items()}
+            for lbl, (counts, _s, n) in merged.items():
+                assert n == sum(counts), f"torn merge {lbl}"
+            merges.append(merged)
+
+    sched.spawn(writer(0), "replica-a")
+    sched.spawn(writer(1), "replica-b")
+    sched.spawn(scraper, "scraper")
+
+    def check() -> None:
+        ref = Registry(enabled=True)
+        series = ref.log_histogram(REQUEST_LATENCY, "ref").labels(
+            priority="interactive", stage="total")
+        for _ in regs:                     # the concatenated observations
+            for v in values:
+                series.observe(v)
+        final = merge_hist_states(
+            [f.labels(priority="interactive", stage="total").value()
+             for f in fams])
+        want = series.value()
+        assert final[0] == want[0], "merged counts != concatenated"
+        assert final[1] == want[1], "merged sum != concatenated (bitwise)"
+        assert final[2] == want[2]
+        assert merges, "the scraper never ran"
+
+    return check
+
+
 def drill_injector_concurrent_fire(sched: Scheduler):
     """FaultInjector concurrent fire — the r14 atomic check-and-clear.
 
@@ -1075,6 +1147,8 @@ DRILLS: dict = {
                               ("fleet/supervisor.py",)),
     "registry-snapshot": (drill_registry_snapshot, 20, 0.25,
                           ("obs/registry.py",)),
+    "loghist-scrape-tear": (drill_loghist_scrape_tear, 20, 0.25,
+                            ("obs/registry.py",)),
     "injector-concurrent-fire": (drill_injector_concurrent_fire, 20, 0.3,
                                  ("resilience/faults.py",)),
 }

@@ -36,9 +36,14 @@ from typing import Optional, Sequence
 from dryad_tpu.fleet.replica import serve_argv
 from dryad_tpu.fleet.router import FleetRouter
 from dryad_tpu.fleet.supervisor import FleetSupervisor
+from dryad_tpu.obs.registry import (REQUEST_LATENCY, Registry,
+                                    hist_quantile)
 from dryad_tpu.resilience.policy import RetryPolicy
 
 SPREAD_SUSPECT = 0.05    # per-arm spread above this flags the capture
+#: the priorities the bench reports percentiles for (router admission
+#: classes; bulk gets its own short loop so its series is populated)
+BENCH_PRIORITIES = ("interactive", "bulk")
 
 
 def _payloads(num_features: int, sizes: Sequence[int], seed: int) -> dict:
@@ -56,14 +61,19 @@ def _payloads(num_features: int, sizes: Sequence[int], seed: int) -> dict:
 def _closed_loop(host: str, port: int, payloads: dict, *, clients: int,
                  duration_s: float, seed: int,
                  priority: str = "interactive",
+                 trace: bool = False,
                  on_response=None) -> dict:
     """Run the closed loop; returns requests/rows/failures and elapsed.
     ``on_response(status, body_bytes)`` (when set) sees every answer —
-    the swap drill uses it to tally versions."""
+    the swap drill uses it to tally versions.  With ``trace=True`` every
+    request carries a unique ``X-Dryad-Trace`` id and the loop counts
+    responses whose echoed id does not round-trip
+    (``trace_mismatches``; a successful answer MUST echo the id)."""
     sizes = sorted(payloads)
     counts = [0] * clients
     rows = [0] * clients
     failures = [0] * clients
+    mismatches = [0] * clients
     barrier = threading.Barrier(clients + 1)
     stop_at = [float("inf")]
 
@@ -76,20 +86,26 @@ def _closed_loop(host: str, port: int, payloads: dict, *, clients: int,
         try:
             while time.perf_counter() < stop_at[0]:
                 n = crng.choice(sizes)
+                if trace:
+                    headers["X-Dryad-Trace"] = (
+                        f"bench{seed & 0xffff:04x}{ci:02x}{counts[ci]:06x}")
                 try:
                     conn.request("POST", "/predict", body=payloads[n],
                                  headers=headers)
                     resp = conn.getresponse()
                     body = resp.read()
                     status = resp.status
+                    echoed = resp.getheader("X-Dryad-Trace")
                 except (OSError, http.client.HTTPException):
                     conn.close()
                     conn = http.client.HTTPConnection(host, port,
                                                       timeout=30.0)
-                    status, body = 0, b""
+                    status, body, echoed = 0, b"", None
                 counts[ci] += 1
                 if status == 200:
                     rows[ci] += n
+                    if trace and echoed != headers["X-Dryad-Trace"]:
+                        mismatches[ci] += 1
                 else:
                     failures[ci] += 1
                 if on_response is not None:
@@ -109,24 +125,61 @@ def _closed_loop(host: str, port: int, payloads: dict, *, clients: int,
     elapsed = time.perf_counter() - t0
     return {"requests": sum(counts), "rows": sum(rows),
             "failures": sum(failures), "elapsed_s": elapsed,
+            "trace_mismatches": sum(mismatches),
             "rows_per_s": sum(rows) / elapsed if elapsed > 0 else 0.0}
 
 
 def _start_fleet(model_path: str, n_replicas: int, *, backend: str,
                  max_batch_rows: int, max_wait_ms: float,
                  warmup: bool, startup_timeout_s: float,
-                 max_inflight: int) -> tuple[FleetSupervisor, FleetRouter]:
+                 max_inflight: int) -> tuple:
     def make_argv(index: int, port_file: str) -> list:
         return serve_argv([model_path], port_file, backend=backend,
                           max_batch_rows=max_batch_rows,
                           max_wait_ms=max_wait_ms, warmup=warmup)
 
+    # a PRIVATE registry per fleet: the router's per-priority latency
+    # histograms are what the bench reads back as p50/p95/p99, so they
+    # must not mix with a previous arm's (or the process default's)
+    reg = Registry()
     sup = FleetSupervisor(make_argv, n_replicas,
                           policy=RetryPolicy(backoff_base_s=0.1),
+                          registry=reg,
                           startup_timeout_s=startup_timeout_s)
     sup.start()
-    router = FleetRouter(sup, max_inflight=max_inflight).start()
-    return sup, router
+    router = FleetRouter(sup, registry=reg,
+                         max_inflight=max_inflight).start()
+    return sup, router, reg
+
+
+def _router_states(reg: Registry) -> dict:
+    """priority -> the router's end-to-end (stage="router") histogram
+    state — snapshotted after warmup so percentiles cover MEASURED
+    traffic only."""
+    fam = reg.log_histogram(REQUEST_LATENCY)
+    return {p: fam.labels(priority=p, stage="router").value()
+            for p in BENCH_PRIORITIES}
+
+
+def _router_percentiles(reg: Registry,
+                        baseline: Optional[dict] = None) -> dict:
+    """priority -> {p50_ms, p95_ms, p99_ms, count} from the router's
+    log-bucket histograms, minus ``baseline`` (the post-warmup snapshot:
+    cold-start first-connection latencies would otherwise sit exactly in
+    the reported — and trend-gated — p99 tail)."""
+    out = {}
+    for priority, (counts, _total, n) in _router_states(reg).items():
+        if baseline is not None and priority in baseline:
+            bc, _bt, bn = baseline[priority]
+            counts = [a - b for a, b in zip(counts, bc)]
+            n -= bn
+        out[priority] = {
+            "count": int(n),
+            "p50_ms": round(hist_quantile(counts, 0.50) * 1e3, 3),
+            "p95_ms": round(hist_quantile(counts, 0.95) * 1e3, 3),
+            "p99_ms": round(hist_quantile(counts, 0.99) * 1e3, 3),
+        }
+    return out
 
 
 def run_fleet_bench(model_path: str, num_features: int, *,
@@ -152,7 +205,7 @@ def run_fleet_bench(model_path: str, num_features: int, *,
                     "fleet_backend": backend}
     base_n = min(replica_counts)
     for n in replica_counts:
-        sup, router = _start_fleet(
+        sup, router, reg = _start_fleet(
             model_path, n, backend=backend, max_batch_rows=max_batch_rows,
             max_wait_ms=max_wait_ms, warmup=warmup,
             startup_timeout_s=startup_timeout_s, max_inflight=max_inflight)
@@ -162,14 +215,30 @@ def run_fleet_bench(model_path: str, num_features: int, *,
             _closed_loop(router.host, router.port, payloads,
                          clients=clients, duration_s=min(duration_s, 1.0),
                          seed=seed - 1)
+            # percentile baseline AFTER warmup: the reported (and
+            # trend-gated) p99 must cover measured traffic only
+            pct_base = _router_states(reg)
             arm_rates = []
             failures = 0
+            mismatches = 0
             for arm in range(max(1, int(arms))):
                 loop = _closed_loop(router.host, router.port, payloads,
                                     clients=clients, duration_s=duration_s,
-                                    seed=seed + 100 * (arm + 1))
+                                    seed=seed + 100 * (arm + 1),
+                                    trace=True)
                 arm_rates.append(loop["rows_per_s"])
                 failures += loop["failures"]
+                mismatches += loop["trace_mismatches"]
+            # a short bulk pass populates the bulk-priority series so the
+            # percentile report covers BOTH admission classes (kept out
+            # of the timed arms: the rows/s trend keys off the historic
+            # interactive-only workload)
+            bulk = _closed_loop(router.host, router.port, payloads,
+                                clients=min(2, clients),
+                                duration_s=min(duration_s, 1.0),
+                                seed=seed + 7, priority="bulk")
+            failures += bulk["failures"]
+            pcts = _router_percentiles(reg, baseline=pct_base)
         finally:
             router.stop()
             sup.stop()
@@ -179,9 +248,17 @@ def run_fleet_bench(model_path: str, num_features: int, *,
         report[f"fleet_rows_per_s_n{n}"] = round(rate, 1)
         report[f"fleet_spread_n{n}"] = round(spread, 3)
         report[f"fleet_failures_n{n}"] = failures
+        report[f"fleet_trace_mismatches_n{n}"] = mismatches
+        # per-priority latency percentiles (the ROADMAP's "p99 budgets
+        # per priority class, not just rows/s") — obs/trends.py tracks
+        # these fields like bench walls
+        for priority, p in pcts.items():
+            for key in ("p50_ms", "p95_ms", "p99_ms"):
+                report[f"fleet_{priority}_{key}_n{n}"] = p[key]
         if verbose:
             print(f"fleet n={n}: {rate:.0f} rows/s "
-                  f"(spread {spread:.3f}, {failures} failures)")
+                  f"(spread {spread:.3f}, {failures} failures; "
+                  f"interactive p99 {pcts['interactive']['p99_ms']} ms)")
     for n in replica_counts:
         if n != base_n:
             base = report[f"fleet_rows_per_s_n{base_n}"]
@@ -214,7 +291,7 @@ def run_swap_drill(model_path: str, num_features: int, *,
                    verbose: bool = False) -> dict:
     """Rolling swap under load: zero failed requests, both versions seen."""
     payloads = _payloads(int(num_features), sizes, seed)
-    sup, router = _start_fleet(
+    sup, router, _reg = _start_fleet(
         model_path, n_replicas, backend=backend,
         max_batch_rows=max_batch_rows, max_wait_ms=max_wait_ms,
         warmup=False, startup_timeout_s=startup_timeout_s,

@@ -180,6 +180,28 @@ class ReplicaProcess:
         finally:
             conn.close()
 
+    def clock_offset(self, timeout_s: float = 2.0) -> Optional[float]:
+        """The replica's perf_counter→wall-clock offset via the
+        auth-exempt ``GET /clock`` handshake: ``remote_perf + offset ≈
+        wall``, with the local round trip's midpoint standing in for the
+        instant the replica sampled its clocks (halves the RTT error).
+        None when the replica does not speak /clock (e.g. a protocol
+        stub) — the trace assembly then falls back to the replica's
+        self-reported offset or renders unaligned."""
+        t_a = time.time()
+        try:
+            status, body = self.request("GET", "/clock", timeout_s=timeout_s)
+        except OSError:
+            return None
+        t_b = time.time()
+        if status != 200:
+            return None
+        try:
+            perf = float(json.loads(body)["perf_s"])
+        except (ValueError, KeyError, TypeError):
+            return None
+        return (t_a + t_b) / 2.0 - perf
+
     def health(self, timeout_s: float = 2.0) -> tuple[Optional[int], float]:
         """(/healthz status or None on connect/timeout failure, latency)."""
         t0 = time.monotonic()

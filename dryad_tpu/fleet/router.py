@@ -31,10 +31,24 @@ Routing and degradation, in order:
 
 Observability: ``/metrics`` serves the router's own ``dryad_fleet_*``
 series PLUS every live replica's scrape, each sample relabeled with
-``replica="rN"`` — one endpoint scrapes the whole fleet.  ``/healthz``
-(auth-exempt, like every other healthz in this repo) answers 200 while
-at least ``min_healthy`` replicas are routable.  ``/stats`` returns the
-JSON view (slot states + shed/retry counters).  Bearer auth reuses the
+``replica="rN"`` — one endpoint scrapes the whole fleet — and (r17)
+``dryad_fleet_latency_ms{priority,stage,q}`` gauges: fleet-wide
+p50/p95/p99 computed by EXACT count-merge of the replicas'
+fixed-log-bucket ``dryad_request_latency_seconds`` histograms (scraped
+as JSON from each replica's ``/obs``) plus the router's own
+stage="router" series.  ``/healthz`` (auth-exempt, like every other
+healthz in this repo) answers 200 while at least ``min_healthy``
+replicas are routable AND no per-priority p99 SLO budget is in
+sustained breach (obs/slo.py; verdicts ride the payload).  ``/stats``
+returns the JSON view (slot states + shed/retry counters).  ``/trace``
+(r17) assembles the fleet-wide Chrome trace: router spans, every live
+replica's span ring clock-aligned by the registration-time offset
+handshake, and the supervisor journal as an annotation track —
+tail-sampled to the slowest ``?k=`` requests per window.  Request
+tracing: the router mints (or honors) ``X-Dryad-Trace`` per /predict,
+forwards it to the replica, echoes it on the response, and records every
+forward ATTEMPT as a trace-tagged span, so a request that survives a
+replica crash shows both attempts under one id.  Bearer auth reuses the
 obs exporter's scheme.
 """
 
@@ -42,22 +56,35 @@ from __future__ import annotations
 
 import http.client
 import json
+import re
 import socket
 import sys
 import threading
 import time
+import urllib.parse
+import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 from dryad_tpu.obs.exporter import authorized, send_unauthorized
-from dryad_tpu.obs.registry import Registry, default_registry
+from dryad_tpu.obs.health import HealthState
+from dryad_tpu.obs.registry import (LOG_BUCKETS, REQUEST_LATENCY, Registry,
+                                    default_registry, hist_quantile,
+                                    merge_hist_states)
+from dryad_tpu.obs.slo import SloGate
+from dryad_tpu.obs.spans import record_at
+from dryad_tpu.obs.trace_export import (TailSampler, active_trace,
+                                        dumps_fleet_trace, tracing_active)
 
 PRIORITIES = ("interactive", "bulk")
+TRACE_HEADER = "X-Dryad-Trace"
 #: statuses that count as "this replica failed us" for the single retry
 RETRYABLE_STATUSES = (500, 502, 503, 504)
 #: hop-by-hop / recomputed headers never forwarded either direction
 _SKIP_HEADERS = {"host", "content-length", "connection", "transfer-encoding",
                  "keep-alive"}
+#: label parser for registry label blocks ('priority="bulk",stage="total"')
+_LABEL_RE = re.compile(r'(\w+)="([^"]*)"')
 
 
 def relabel_exposition(text: str, replica: str) -> str:
@@ -87,15 +114,31 @@ class _RouterState:
     threads, and no forward/scrape I/O ever happens under it."""
 
     GUARDED_BY = {"_inflight_total": "_lock", "_inflight_model": "_lock",
-                  "_rr": "_lock"}
+                  "_rr": "_lock", "_slo_last": "_lock"}
 
     def __init__(self, supervisor, *, registry: Optional[Registry],
                  max_inflight: int, bulk_max_inflight: Optional[int],
                  model_caps: Optional[dict], request_timeout_s: float,
-                 min_healthy: int, auth_token: Optional[str]):
+                 min_healthy: int, auth_token: Optional[str],
+                 slo_budgets_ms: Optional[dict] = None,
+                 slo_quantile: float = 0.99, slo_breach_after: int = 3,
+                 tail_window: int = 512, tail_keep: int = 16):
         self.supervisor = supervisor
         self.registry = (registry if registry is not None
                          else default_registry())
+        # request-scoped observability (r17): the tail sampler feeds the
+        # merged /trace (full detail for the slowest requests per
+        # window), the SLO gate turns per-priority p99 budgets into
+        # /healthz verdicts.  The gate gets its OWN health state so a
+        # sustained breach degrades THIS router's /healthz, not the
+        # process-global surface another subsystem may be serving.
+        self.sampler = TailSampler(window=tail_window)
+        self.tail_keep = int(tail_keep)
+        self.slo_health = HealthState(registry=self.registry)
+        self.slo = SloGate(slo_budgets_ms, quantile=slo_quantile,
+                           breach_after=slo_breach_after,
+                           registry=self.registry, health=self.slo_health)
+        self._slo_last: dict[str, tuple] = {}
         self.max_inflight = int(max_inflight)
         self.bulk_max_inflight = (int(bulk_max_inflight)
                                   if bulk_max_inflight is not None
@@ -168,6 +211,43 @@ class _RouterState:
                 "Requests currently inside the fleet").set(
                 self.inflight_total)
 
+    def evaluate_slo(self) -> dict:
+        """One SLO evaluation pass from the router's OWN per-priority
+        end-to-end histograms (stage="router" covers queueing, retries
+        and the replica — every request traverses this process, so the
+        local series already IS fleet-wide).  Called on the /healthz
+        cadence; deliberately no replica scrape in the health path.
+
+        The gate sees the WINDOW since the previous evaluation (the
+        delta of the cumulative series — counts subtract exactly), not
+        the lifetime state: cumulative history would both mask a fresh
+        regression after long uptime and keep one past slow burst
+        breaching forever."""
+        fam = self.registry.log_histogram(
+            REQUEST_LATENCY,
+            "Request latency by priority class and pipeline stage")
+        windows: dict = {}
+        # snapshot AND delta under _lock: /healthz is polled by several
+        # probers concurrently, and an out-of-order commit to _slo_last
+        # would hand the gate a negative window (which could spuriously
+        # clear a sustained breach).  The family reads take each
+        # family's own lock inside ours — that order never inverts
+        # (registry code never acquires router state locks).
+        with self._lock:
+            for priority in self.slo.budgets_ms:
+                counts, total, n = fam.labels(priority=priority,
+                                              stage="router").value()
+                last = self._slo_last.get(priority)
+                if last is None:
+                    windows[priority] = (counts, total, n)
+                else:
+                    lc, lt, ln = last
+                    windows[priority] = (
+                        [a - b for a, b in zip(counts, lc)],
+                        total - lt, n - ln)
+                self._slo_last[priority] = (counts, total, n)
+        return self.slo.evaluate(windows)
+
 
 class _Handler(BaseHTTPRequestHandler):
     # the _RouterState rides on the server object (see make_fleet_router)
@@ -176,13 +256,19 @@ class _Handler(BaseHTTPRequestHandler):
         if getattr(self.server, "verbose", False):
             super().log_message(fmt, *args)
 
-    def _send(self, code: int, payload: dict) -> None:
-        self._send_raw(code, json.dumps(payload).encode(), "application/json")
+    def _send(self, code: int, payload: dict,
+              extra_headers: Optional[dict] = None) -> None:
+        self._send_raw(code, json.dumps(payload).encode(),
+                       "application/json", extra_headers)
 
-    def _send_raw(self, code: int, body: bytes, ctype: str) -> None:
+    def _send_raw(self, code: int, body: bytes, ctype: str,
+                  extra_headers: Optional[dict] = None) -> None:
         self.send_response(code)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(body)))
+        if extra_headers:
+            for k, v in extra_headers.items():
+                self.send_header(k, v)
         self.end_headers()
         self.wfile.write(body)
 
@@ -197,9 +283,15 @@ class _Handler(BaseHTTPRequestHandler):
         state: _RouterState = self.server.state
         if self.path == "/healthz":
             states = state.supervisor.states()
-            ok = state.supervisor.fleet_ok(state.min_healthy)
+            fleet_ok = state.supervisor.fleet_ok(state.min_healthy)
+            # SLO verdicts ride the health probe's cadence: a SUSTAINED
+            # per-priority p99 breach degrades the router like a lost
+            # replica would — latency budgets are part of "healthy"
+            slo = state.evaluate_slo()
+            ok = fleet_ok and state.slo_health.ok
             self._send(200 if ok else 503,
-                       {"ok": ok, "replicas": states})
+                       {"ok": ok, "replicas": states, "slo": slo,
+                        "degraded": sorted(state.slo_health.reasons())})
             return
         if not self._authorized():
             return
@@ -215,6 +307,9 @@ class _Handler(BaseHTTPRequestHandler):
                 "model_caps": state.model_caps,
                 "fleet": state.registry.snapshot(),
             })
+        elif self.path == "/trace" or self.path.startswith("/trace?"):
+            self._send_raw(200, self._merged_trace().encode(),
+                           "application/json")
         else:
             self._send(404, {"error": f"unknown path {self.path}"})
 
@@ -229,8 +324,14 @@ class _Handler(BaseHTTPRequestHandler):
                 if s.proc is not None and s.proc.alive
                 and s.proc.host is not None]
         results: dict[str, str] = {}
+        obs_blocks: dict[str, dict] = {}
 
         def scrape(slot) -> None:
+            # ONE ~4 s budget covers BOTH requests to this slot, so the
+            # join below (4.5 s) always outlives the thread — a replica
+            # slow on /metrics cannot push its /obs answer past the
+            # join and get silently dropped from the merged gauges
+            deadline = time.monotonic() + 4.0
             try:
                 status, body = slot.proc.request("GET", "/metrics",
                                                  headers=headers,
@@ -244,18 +345,146 @@ class _Handler(BaseHTTPRequestHandler):
                 state.count("dryad_fleet_scrape_error_total",
                             "Replica /metrics scrapes that failed",
                             replica=slot.name)
+            # the exact-merge feed: the replica's histogram counts as
+            # JSON (/obs).  Optional — a stub replica without /obs just
+            # contributes nothing to the merged percentiles.
+            try:
+                status, body = slot.proc.request(
+                    "GET", "/obs", headers=headers,
+                    timeout_s=max(0.2, deadline - time.monotonic()))
+                if status == 200:
+                    doc = json.loads(body)
+                    block = doc.get("histograms", {}).get(REQUEST_LATENCY)
+                    if block:
+                        obs_blocks[slot.name] = block
+            except (OSError, ValueError):
+                pass
 
-        # concurrent scrapes: one hung replica costs the whole request its
-        # OWN 2 s timeout, not 2 s per sick slot
+        # concurrent scrapes: one hung replica costs the whole request
+        # its OWN per-slot budget (~4 s), not that much per sick slot
         threads = [threading.Thread(target=scrape, args=(s,), daemon=True)
                    for s in live]
         for t in threads:
             t.start()
         for t in threads:
-            t.join(timeout=3.0)
+            t.join(timeout=4.5)
+        self._merged_latency_gauges(state, list(obs_blocks.values()))
         parts = [state.registry.exposition()]
         parts += [results[s.name] for s in live if s.name in results]
         return "".join(parts)
+
+    @staticmethod
+    def _merged_latency_gauges(state: "_RouterState",
+                               blocks: list) -> None:
+        """Fold the replicas' request-latency histograms into fleet-wide
+        per-(priority, stage) p50/p95/p99 gauges by EXACT count-merge
+        (the fixed log-bucket layout makes the merged histogram equal
+        the histogram of the concatenated observations).  The router's
+        own stage="router" series joins through the same path."""
+        if not state.registry.enabled:
+            return
+        own = state.registry.snapshot()["histograms"].get(
+            REQUEST_LATENCY, {})
+        n_bounds = len(LOG_BUCKETS) + 1
+        series: dict[str, list] = {}
+        for block in [own] + blocks:
+            if not isinstance(block, dict):
+                continue
+            for lbl, st in block.items():
+                # defensive shape check: a malformed or mixed-version
+                # replica block (wrong keys, different bucket layout)
+                # is SKIPPED, never allowed to raise out of /metrics —
+                # one bad replica must not kill the whole fleet scrape
+                try:
+                    counts = list(st["counts"])
+                    entry = (counts, float(st["sum"]), int(st["count"]))
+                except (TypeError, KeyError, ValueError):
+                    continue
+                if len(counts) != n_bounds:
+                    continue
+                series.setdefault(str(lbl), []).append(entry)
+        fam = state.registry.gauge(
+            "dryad_fleet_latency_ms",
+            "Fleet-wide latency quantiles by priority/stage "
+            "(exact histogram merge across replicas)")
+        for lbl, sts in series.items():
+            counts, _total, n = merge_hist_states(sts)
+            labels = dict(_LABEL_RE.findall(lbl))
+            if not n or "priority" not in labels:
+                continue
+            for q, name in ((0.50, "p50"), (0.95, "p95"), (0.99, "p99")):
+                fam.labels(q=name, **labels).set(
+                    hist_quantile(counts, q) * 1e3)
+
+    def _merged_trace(self) -> str:
+        """The fleet-wide Chrome trace: the router's span ring, every
+        live replica's ring (clock-aligned by the registration-time
+        offset handshake, falling back to the replica's self-reported
+        wall−perf pair), and the supervisor journal as an annotation
+        track.  Tail-sampled: full span detail only for the slowest
+        ``?k=`` requests in the sampler window (default the router's
+        ``tail_keep``; ``k=0`` keeps everything)."""
+        state: _RouterState = self.server.state
+        params = urllib.parse.parse_qs(
+            urllib.parse.urlparse(self.path).query)
+        try:
+            k = int(params.get("k", [state.tail_keep])[0])
+        except ValueError:
+            k = state.tail_keep
+        keep = state.sampler.slowest(k) if k > 0 else None
+        tracks: list = []
+        buf = active_trace()
+        if buf is not None:
+            # one wall−perf sample maps this process's whole ring: the
+            # perf_counter origin is constant for the process lifetime
+            tracks.append({"pid": 1, "name": "fleet router",
+                           "events": buf.events(),
+                           "offset_s": time.time() - time.perf_counter()})
+        headers = ({"Authorization": f"Bearer {state.auth_token}"}
+                   if state.auth_token else {})
+        live = [s for s in state.supervisor.slots
+                if s.proc is not None and s.proc.alive
+                and s.proc.host is not None]
+        results: dict[str, tuple] = {}
+
+        def scrape(slot) -> None:
+            try:
+                status, body = slot.proc.request("GET", "/trace/events",
+                                                 headers=headers,
+                                                 timeout_s=3.0)
+                if status != 200:
+                    return
+                doc = json.loads(body)
+            except (OSError, ValueError):
+                return
+            offset = slot.clock_offset
+            clock = doc.get("clock") or {}
+            if offset is None and "wall_s" in clock and "perf_s" in clock:
+                offset = float(clock["wall_s"]) - float(clock["perf_s"])
+            results[slot.name] = (doc.get("events") or [], offset)
+
+        threads = [threading.Thread(target=scrape, args=(s,), daemon=True)
+                   for s in live]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=4.0)
+        for slot in live:
+            if slot.name in results:
+                events, offset = results[slot.name]
+                tracks.append({"pid": 10 + slot.index,
+                               "name": f"replica {slot.name}",
+                               "events": events, "offset_s": offset})
+        journal_events: list = []
+        journal_path = getattr(state.supervisor, "journal_path", None)
+        if journal_path:
+            from dryad_tpu.resilience.journal import RunJournal
+
+            try:
+                journal_events = RunJournal.read(journal_path)
+            except (OSError, ValueError):
+                journal_events = []
+        return dumps_fleet_trace(tracks, journal_events, keep)
 
     # ---- POST --------------------------------------------------------------
     def do_POST(self):  # noqa: N802 — stdlib handler API
@@ -307,6 +536,12 @@ class _Handler(BaseHTTPRequestHandler):
     def _route_predict(self, body: bytes) -> None:
         state: _RouterState = self.server.state
         priority, model = self._priority_and_model(body)
+        # trace context: honor a client-supplied id, mint one while
+        # tracing is on (minting rides the traced path only — with
+        # tracing off an id-less request stays allocation-free)
+        trace = self.headers.get(TRACE_HEADER)
+        if trace is None and tracing_active(state.registry):
+            trace = uuid.uuid4().hex[:16]
         state.count("dryad_fleet_request_total",
                     "Requests entering the fleet router",
                     priority=priority)
@@ -316,20 +551,35 @@ class _Handler(BaseHTTPRequestHandler):
                         "Requests shed by fleet admission control",
                         priority=priority)
             self._send(503, {"error": f"shed: {reason}",
-                             "priority": priority})
+                             "priority": priority},
+                       extra_headers=({TRACE_HEADER: trace}
+                                      if trace else None))
             return
         t0 = time.perf_counter()
         try:
-            status, payload, replica = self._forward(body)
+            status, payload, replica = self._forward(body, trace)
             if status is None:
-                self._send(503, {"error": "no healthy replica"})
+                self._send(503, {"error": "no healthy replica"},
+                           extra_headers=({TRACE_HEADER: trace}
+                                          if trace else None))
                 return
-            self._send_raw(status, payload, "application/json")
+            self._send_raw(status, payload, "application/json",
+                           extra_headers=({TRACE_HEADER: trace}
+                                          if trace else None))
             if state.registry.enabled:
-                state.registry.histogram(
-                    "dryad_fleet_request_latency_seconds",
-                    "Wall latency through the router").labels(
-                    priority=priority).observe(time.perf_counter() - t0)
+                dur = time.perf_counter() - t0
+                # the mergeable per-priority family (stage="router" is
+                # the fleet-wide end-to-end view — every request passes
+                # here); the span ring gets the trace-tagged request
+                # span; the tail sampler ranks it for /trace detail
+                state.registry.log_histogram(
+                    REQUEST_LATENCY,
+                    "Request latency by priority class and pipeline "
+                    "stage").labels(
+                    priority=priority, stage="router").observe(dur)
+                record_at("fleet.request", t0, dur, trace=trace,
+                          registry=state.registry)
+                state.sampler.observe(trace, dur)
                 if replica is not None:
                     state.count("dryad_fleet_routed_total",
                                 "Requests served, by replica",
@@ -337,14 +587,20 @@ class _Handler(BaseHTTPRequestHandler):
         finally:
             state.release(model)
 
-    def _forward(self, body: bytes):
+    def _forward(self, body: bytes, trace: Optional[str] = None):
         """Forward to one routable replica; retry once elsewhere on a
         wire failure or 5xx.  Returns (status, payload, replica_name) —
-        status None when no replica was available at all."""
+        status None when no replica was available at all.  Every attempt
+        — including the failed one a retry follows — records a
+        trace-tagged ``fleet.forward/<replica>`` span, so a request that
+        survives a replica crash shows BOTH attempts under one id in the
+        merged trace."""
         state: _RouterState = self.server.state
         headers = {k: v for k, v in self.headers.items()
                    if k.lower() not in _SKIP_HEADERS}
         headers["Content-Type"] = "application/json"
+        if trace is not None:
+            headers[TRACE_HEADER] = trace
         tried: list[str] = []
         last: Optional[tuple] = None
         for attempt in (0, 1):
@@ -363,6 +619,7 @@ class _Handler(BaseHTTPRequestHandler):
                 # inflight==0 wait reads the count AFTER the flag
                 slot.inflight_dec()
                 continue
+            t_a = time.perf_counter()
             try:
                 conn = http.client.HTTPConnection(
                     slot.proc.host, slot.proc.port,
@@ -378,12 +635,18 @@ class _Handler(BaseHTTPRequestHandler):
                 state.count("dryad_fleet_upstream_error_total",
                             "Forwards that died on the wire",
                             replica=slot.name)
+                record_at(f"fleet.forward/{slot.name}", t_a,
+                          time.perf_counter() - t_a, trace=trace,
+                          registry=state.registry)
                 last = (502, json.dumps(
                     {"error": f"replica {slot.name} unreachable"}).encode(),
                     slot.name)
                 continue
             finally:
                 slot.inflight_dec()
+            record_at(f"fleet.forward/{slot.name}", t_a,
+                      time.perf_counter() - t_a, trace=trace,
+                      registry=state.registry)
             if status in RETRYABLE_STATUSES:
                 state.count("dryad_fleet_upstream_5xx_total",
                             "5xx answers from replicas",
@@ -404,10 +667,18 @@ def make_fleet_router(supervisor, host: str = "127.0.0.1", port: int = 0, *,
                       request_timeout_s: float = 30.0,
                       min_healthy: int = 1,
                       auth_token: Optional[str] = None,
-                      verbose: bool = False) -> ThreadingHTTPServer:
+                      verbose: bool = False,
+                      slo_budgets_ms: Optional[dict] = None,
+                      slo_quantile: float = 0.99,
+                      slo_breach_after: int = 3,
+                      tail_window: int = 512,
+                      tail_keep: int = 16) -> ThreadingHTTPServer:
     """Bind the fleet router (port 0 picks a free one; read it back from
     ``httpd.server_address``); the caller runs ``serve_forever()`` /
-    ``shutdown()``, exactly like ``serve.http.make_http_server``."""
+    ``shutdown()``, exactly like ``serve.http.make_http_server``.
+    ``slo_budgets_ms`` declares per-priority p-quantile budgets
+    (obs/slo.py defaults when None); ``tail_window``/``tail_keep`` shape
+    the merged ``/trace`` endpoint's tail sampling."""
     httpd = ThreadingHTTPServer((host, port), _Handler)
     httpd.daemon_threads = True
     httpd.verbose = verbose
@@ -415,7 +686,9 @@ def make_fleet_router(supervisor, host: str = "127.0.0.1", port: int = 0, *,
         supervisor, registry=registry, max_inflight=max_inflight,
         bulk_max_inflight=bulk_max_inflight, model_caps=model_caps,
         request_timeout_s=request_timeout_s, min_healthy=min_healthy,
-        auth_token=auth_token)
+        auth_token=auth_token, slo_budgets_ms=slo_budgets_ms,
+        slo_quantile=slo_quantile, slo_breach_after=slo_breach_after,
+        tail_window=tail_window, tail_keep=tail_keep)
     return httpd
 
 

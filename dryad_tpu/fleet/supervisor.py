@@ -81,6 +81,12 @@ class ReplicaSlot:
         self.respawns = 0
         self.consecutive_bad = 0
         self.last_status: Optional[int] = None
+        # perf→wall clock offset captured at registration (r17): the
+        # router's merged /trace aligns this slot's spans with it; reset
+        # per generation (a respawn is a new perf_counter origin).
+        # Single-writer (the spawning thread) with benignly racy reads,
+        # like the health flags above.
+        self.clock_offset: Optional[float] = None
         self._inflight = 0        # guarded-by: _lock
         self._lock = threading.Lock()
 
@@ -169,6 +175,10 @@ class FleetSupervisor:
         self._own_journal = isinstance(journal, (str, os.PathLike))
         self._journal = (RunJournal(os.fspath(journal)) if self._own_journal
                          else journal)
+        # the readable journal location (when there is one): the router's
+        # merged /trace reads it back as the fleet's annotation track
+        self.journal_path = (os.fspath(journal) if self._own_journal
+                             else getattr(journal, "path", None))
         self._stop = threading.Event()
         self._monitor: Optional[threading.Thread] = None
         self._swap_lock = threading.Lock()
@@ -301,12 +311,18 @@ class FleetSupervisor:
             if self._stop.is_set():
                 proc.stop()
                 return False
+            # the registration-time clock handshake: map this process
+            # generation's perf_counter onto the wall clock so the merged
+            # fleet /trace can align its spans (None for replicas that do
+            # not speak /clock — stubs)
+            slot.clock_offset = proc.clock_offset()
             slot.healthy = True
             slot.consecutive_bad = 0
             slot.last_status = 200
             self._gauge_healthy(slot)
             self._event("replica_ready", replica=slot.name,
-                        generation=slot.generation, url=proc.url)
+                        generation=slot.generation, url=proc.url,
+                        clock_offset_s=slot.clock_offset)
             return True
 
     def _charge_budget(self, slot: ReplicaSlot) -> bool:

@@ -21,6 +21,13 @@ harness that MEASURES them is engine/probes.py, outside this package
 for the same jax-freedom reason) and Chrome trace_event export of the
 span ring / journal / stage walls (trace_export.py, ``GET /trace``).
 
+r17 adds the request-scoped layer: the fixed-log-bucket histogram kind
+(registry.py — O(1) observe, exact cross-process merge, the fleet-wide
+p99 substrate), trace-tagged spans (spans.record_at + the SpanTrace
+``trace`` field), tail sampling (TailSampler) and fleet trace assembly
+(trace_export.fleet_trace_events), and per-priority latency SLO gates
+(slo.SloGate — sustained-breach /healthz degradation).
+
 Hard contracts (see registry.py / scripts/ci.sh):
 
 * host-side only — nothing here may touch jax or fetch from a device;
@@ -38,16 +45,22 @@ from dryad_tpu.obs.exporter import MetricsExporter, start_exporter
 from dryad_tpu.obs.health import HealthState, default_health, healthz_payload
 from dryad_tpu.obs.journal_tail import JournalTail
 from dryad_tpu.obs.registry import (
+    LOG_BUCKETS,
     Registry,
     default_registry,
+    hist_quantile,
+    merge_hist_states,
     set_default_registry,
 )
-from dryad_tpu.obs.spans import record, span
+from dryad_tpu.obs.slo import SloGate, parse_budgets
+from dryad_tpu.obs.spans import record, record_at, span
 from dryad_tpu.obs.trace_export import (
     SpanTrace,
+    TailSampler,
     default_trace,
     disable_tracing,
     enable_tracing,
+    tracing_active,
 )
 from dryad_tpu.obs.tripwire import RecompileTripwire, default_tripwire
 from dryad_tpu.obs.watchdog import (
@@ -79,4 +92,12 @@ __all__ = [
     "enable_tracing",
     "disable_tracing",
     "default_trace",
+    "record_at",
+    "tracing_active",
+    "TailSampler",
+    "SloGate",
+    "parse_budgets",
+    "LOG_BUCKETS",
+    "merge_hist_states",
+    "hist_quantile",
 ]

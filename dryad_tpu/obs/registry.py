@@ -25,10 +25,18 @@ Registries are instantiable (tests use private ones); production code
 records into ``default_registry()``, toggled by ``DRYAD_OBS=0`` at import
 or ``enable()``/``disable()`` at runtime (bench.py measures the
 instrumented-vs-disabled delta as ``obs_overhead_ms``).
+
+r17 adds the **fixed-log-bucket histogram kind** (``log_histogram``):
+one process-invariant bucket layout (``LOG_BUCKETS``), O(1) observe, and
+EXACT cross-process merge (``merge_hist_states`` — integer counts add
+losslessly), which is what lets the fleet router serve one fleet-wide
+p99 from per-replica scrapes.  ``hist_quantile`` is the shared
+nearest-rank readout; never hand a log histogram custom buckets.
 """
 
 from __future__ import annotations
 
+import math
 import os
 import threading
 from typing import Optional, Sequence
@@ -36,11 +44,112 @@ from typing import Optional, Sequence
 COUNTER = "counter"
 GAUGE = "gauge"
 HISTOGRAM = "histogram"
+LOG_HISTOGRAM = "loghistogram"
 
 #: default histogram bounds — tuned for serving/trainer wall times in
 #: seconds (sub-ms batcher hops up to multi-second chunk fetches)
 DEFAULT_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
                    0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0)
+
+# ---- the fixed-log-bucket scheme (r17 request-latency family) --------------
+#
+# One bucket layout for EVERY process, fixed at import: 10 buckets per
+# decade from 0.1 ms to 100 s (61 bounds + overflow).  Because the
+# bounds are code, not configuration, two processes' series can be
+# merged EXACTLY by adding their integer count arrays — the property the
+# fleet router's aggregated /metrics relies on (fleet-wide p99 from
+# per-replica scrapes, bitwise-equal to a single-process histogram of
+# the concatenated observations).  ``observe`` is O(1): the bucket index
+# is one log, not a linear scan over 61 bounds.
+#: the shared per-(priority, stage) request-latency family name — ONE
+#: name at the fleet router and every serve replica, so the router's
+#: exact cross-process merge is a label join (serve/metrics.py records
+#: replica stages; fleet/router.py records stage="router" and merges)
+REQUEST_LATENCY = "dryad_request_latency_seconds"
+
+LOG_MIN = 1e-4            # seconds (0.1 ms) — the first bucket's bound
+LOG_PER_DECADE = 10
+LOG_DECADES = 6           # covers 0.1 ms .. 100 s
+LOG_BUCKETS = tuple(LOG_MIN * 10.0 ** (i / LOG_PER_DECADE)
+                    for i in range(LOG_PER_DECADE * LOG_DECADES + 1))
+_LOG_SCALE = LOG_PER_DECADE / math.log(10.0)
+
+
+def log_bucket_index(value: float) -> int:
+    """The O(1) bucket index under 'le' semantics: the smallest ``i``
+    with ``value <= LOG_BUCKETS[i]``, or ``len(LOG_BUCKETS)`` for the
+    overflow bucket.  The float-log estimate is corrected by at most one
+    step in each direction so edge values land exactly where the linear
+    scan would put them (pinned against the scan in tests)."""
+    if value <= LOG_MIN:
+        return 0
+    n = len(LOG_BUCKETS)
+    i = int(math.ceil(math.log(value / LOG_MIN) * _LOG_SCALE))
+    if i < 0:
+        i = 0
+    elif i > n:
+        i = n
+    while i > 0 and value <= LOG_BUCKETS[i - 1]:
+        i -= 1
+    while i < n and value > LOG_BUCKETS[i]:
+        i += 1
+    return i
+
+
+def new_hist_state(n_bounds: int = len(LOG_BUCKETS)) -> list:
+    """A fresh mutable histogram state ``[counts, sum, count]`` — the
+    same shape the registry stores per series, usable standalone (the
+    serve metrics percentile state)."""
+    return [[0] * (n_bounds + 1), 0.0, 0]
+
+
+def observe_log_state(state: list, value: float) -> None:
+    """O(1) observe into a standalone log-bucket state (caller locks)."""
+    state[0][log_bucket_index(value)] += 1
+    state[1] += float(value)
+    state[2] += 1
+
+
+def merge_hist_states(states: Sequence) -> tuple:
+    """Exact count-merge of ``(counts, sum, count)`` states sharing one
+    bucket layout: integer counts add losslessly, so the merged
+    histogram is the histogram of the concatenated observations."""
+    states = list(states)
+    if not states:
+        return new_hist_state()
+    n = len(states[0][0])
+    counts = [0] * n
+    total = 0.0
+    count = 0
+    for c, s, k in states:
+        if len(c) != n:
+            raise ValueError("cannot merge histograms with different "
+                             f"bucket layouts ({len(c)} vs {n})")
+        for i, v in enumerate(c):
+            counts[i] += v
+        total += s
+        count += k
+    return (counts, total, count)
+
+
+def hist_quantile(counts: Sequence[int], q: float,
+                  bounds: Sequence[float] = LOG_BUCKETS) -> float:
+    """Nearest-rank quantile from bucket counts, in the bounds' unit
+    (seconds for the log scheme).  Each bucket reports its UPPER bound —
+    deterministic, monotone in ``q``, and mergeable (the quantile of a
+    merged state equals the quantile of the concatenated observations up
+    to bucket resolution); the overflow bucket reports the last finite
+    bound.  Empty histogram -> 0.0."""
+    total = sum(counts)
+    if total == 0:
+        return 0.0
+    target = max(1, math.ceil(float(q) * total))
+    cum = 0
+    for i, c in enumerate(counts):
+        cum += c
+        if cum >= target:
+            return bounds[min(i, len(bounds) - 1)]
+    return bounds[-1]
 
 
 def _label_key(labels: dict) -> tuple:
@@ -107,21 +216,24 @@ class _Series:
         fam = self._fam
         if not fam.registry.enabled:
             return
-        if fam.kind != HISTOGRAM:
-            raise TypeError(f"{fam.name} is a {fam.kind}, not a histogram")
-        bounds = fam.buckets
-        with fam.lock:
-            state = fam.values.get(self._key)
-            if state is None:
-                state = fam.values[self._key] = [[0] * (len(bounds) + 1),
-                                                 0.0, 0]
-            counts, _, _ = state
+        if fam.kind == LOG_HISTOGRAM:
+            # O(1) bucket index — no scan over the 61 log bounds
+            i = log_bucket_index(value)
+        elif fam.kind == HISTOGRAM:
+            bounds = fam.buckets
             i = 0
             # Prometheus 'le' semantics: a value ON a bound lands in that
             # bound's bucket (test_histogram_bucket_edges)
             while i < len(bounds) and value > bounds[i]:
                 i += 1
-            counts[i] += 1
+        else:
+            raise TypeError(f"{fam.name} is a {fam.kind}, not a histogram")
+        with fam.lock:
+            state = fam.values.get(self._key)
+            if state is None:
+                state = fam.values[self._key] = [
+                    [0] * (len(fam.buckets) + 1), 0.0, 0]
+            state[0][i] += 1
             state[1] += float(value)
             state[2] += 1
 
@@ -130,7 +242,7 @@ class _Series:
         (counts, sum, count) copy) — 0-initialized if never recorded."""
         fam = self._fam
         with fam.lock:
-            if fam.kind == HISTOGRAM:
+            if fam.kind in (HISTOGRAM, LOG_HISTOGRAM):
                 state = fam.values.get(self._key)
                 if state is None:
                     return ([0] * (len(fam.buckets) + 1), 0.0, 0)
@@ -164,6 +276,13 @@ class _Family:
             self.buckets = self.buckets or DEFAULT_BUCKETS
             if list(self.buckets) != sorted(self.buckets):
                 raise ValueError("histogram buckets must be sorted")
+        elif kind == LOG_HISTOGRAM:
+            # the layout is the fixed scheme or nothing — custom buckets
+            # would silently break the cross-process exact merge
+            if self.buckets is not None:
+                raise ValueError("log histograms use the fixed LOG_BUCKETS "
+                                 "scheme; custom buckets are not mergeable")
+            self.buckets = LOG_BUCKETS
         self.lock = threading.Lock()
         self.values: dict = {}
         self._children: dict = {}
@@ -264,6 +383,13 @@ class Registry:
                   buckets: Optional[Sequence[float]] = None) -> _Family:
         return self._family(name, HISTOGRAM, help, buckets)
 
+    def log_histogram(self, name: str, help: str = "") -> _Family:
+        """A histogram on the process-invariant fixed-log-bucket scheme
+        (``LOG_BUCKETS``): O(1) observe, and series merge EXACTLY across
+        processes (``merge_hist_states``) because every process shares
+        the layout by construction."""
+        return self._family(name, LOG_HISTOGRAM, help)
+
     # ---- consumers (the explicitly-annotated SNAPSHOT PATH: the one place
     # obs is allowed to allocate freely; still jax-free by construction) ----
     def snapshot(self) -> dict:
@@ -274,10 +400,14 @@ class Registry:
             fams = list(self._families.values())
         out: dict = {"counters": {}, "gauges": {}, "histograms": {}}
         for fam in fams:
-            if fam.kind == HISTOGRAM:
+            if fam.kind in (HISTOGRAM, LOG_HISTOGRAM):
+                # log families carry the marker so a cross-process merge
+                # consumer (the fleet router) can find them in a scrape
+                log = fam.kind == LOG_HISTOGRAM
                 out["histograms"][fam.name] = {
                     lbl: {"bounds": list(fam.buckets), "counts": counts,
-                          "sum": total, "count": n}
+                          "sum": total, "count": n,
+                          **({"log": True} if log else {})}
                     for lbl, (counts, total, n) in fam.series().items()}
             else:
                 out[fam.kind + "s"][fam.name] = fam.series()
@@ -291,11 +421,14 @@ class Registry:
         for fam in fams:
             if fam.help:
                 lines.append(f"# HELP {fam.name} {_escape(fam.help)}")
-            lines.append(f"# TYPE {fam.name} {fam.kind}")
+            # the log kind is an implementation detail; on the wire it is
+            # an ordinary Prometheus histogram (scrapers know no other)
+            kind = HISTOGRAM if fam.kind == LOG_HISTOGRAM else fam.kind
+            lines.append(f"# TYPE {fam.name} {kind}")
             with fam.lock:
                 items = sorted(fam.values.items())
                 for key, val in items:
-                    if fam.kind != HISTOGRAM:
+                    if kind != HISTOGRAM:
                         lines.append(
                             f"{fam.name}{_fmt_labels(key)} {_fmt_value(val)}")
                         continue

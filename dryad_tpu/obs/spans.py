@@ -51,10 +51,16 @@ _TRACE_SINK = None
 
 def set_trace_sink(sink) -> None:
     """Install (or clear, with ``None``) the span trace sink.  The sink
-    must be cheap and non-raising; trace_export.SpanTrace.record is the
-    intended one."""
+    must be cheap and non-raising and accept ``(path, t0_s, dur_s)``
+    plus an optional keyword-able 4th ``trace`` argument (r17);
+    trace_export.SpanTrace.record is the intended one."""
     global _TRACE_SINK
     _TRACE_SINK = sink
+
+
+def sink_active() -> bool:
+    """Whether a span trace sink is installed (the ring is listening)."""
+    return _TRACE_SINK is not None
 
 
 class _NullSpan:
@@ -137,6 +143,26 @@ def record(name: str, seconds: float,
         try:
             # the stage just ENDED; back-date its start by its duration
             sink(name, time.perf_counter() - seconds, seconds)
+        except Exception:   # noqa: BLE001 — tracing must never break callers
+            pass
+
+
+def record_at(name: str, t0_s: float, seconds: float,
+              trace: Optional[str] = None,
+              registry: Optional[Registry] = None) -> None:
+    """Record a completed stage with an EXPLICIT start time and an
+    optional request trace id (r17: the serve/fleet request path stamps
+    its per-request stage spans after the fact, from timestamps carried
+    across the batcher hand-off — back-dating via ``record`` would lie
+    about when the stage ran)."""
+    reg = registry if registry is not None else default_registry()
+    if not reg.enabled:
+        return
+    _emit(reg, name, seconds)
+    sink = _TRACE_SINK
+    if sink is not None:
+        try:
+            sink(name, t0_s, seconds, trace)
         except Exception:   # noqa: BLE001 — tracing must never break callers
             pass
 

@@ -42,7 +42,8 @@ DEFAULT_CAPACITY = 65536
 
 class SpanTrace:
     """Bounded thread-safe ring of completed spans ``(path, t0_s, dur_s,
-    tid)`` — the span trace sink (spans.set_trace_sink)."""
+    tid, trace)`` — the span trace sink (spans.set_trace_sink).  ``trace``
+    is the request trace id (r17) or None for untagged spans."""
 
     GUARDED_BY = {"_events": "_lock", "dropped": "_lock"}
 
@@ -51,16 +52,23 @@ class SpanTrace:
         self._lock = threading.Lock()
         self.dropped = 0
 
-    def record(self, path: str, t0_s: float, dur_s: float) -> None:
+    def record(self, path: str, t0_s: float, dur_s: float,
+               trace: Optional[str] = None) -> None:
         tid = threading.get_ident() & 0xFFFF
         with self._lock:
             if len(self._events) == self._events.maxlen:
                 self.dropped += 1
-            self._events.append((path, t0_s, dur_s, tid))
+            self._events.append((path, t0_s, dur_s, tid, trace))
 
     def events(self) -> list:
         with self._lock:
             return list(self._events)
+
+    def export(self) -> tuple:
+        """(events, dropped) in one consistent read — the shape the
+        replica ``/trace/events`` endpoint serializes."""
+        with self._lock:
+            return list(self._events), self.dropped
 
     def clear(self) -> None:
         with self._lock:
@@ -96,6 +104,83 @@ def default_trace() -> Optional[SpanTrace]:
     return _default
 
 
+def active_trace() -> Optional[SpanTrace]:
+    """The SpanTrace actually receiving spans right now: the ring whose
+    bound ``record`` is installed as the sink (a test/caller-scoped ring
+    counts), else the process default.  Consumers that SERVE the ring
+    (the fleet router's /trace) resolve through this, so they follow
+    whatever sink is live instead of insisting on the default."""
+    sink = spans._TRACE_SINK
+    if sink is None:
+        return None
+    owner = getattr(sink, "__self__", None)
+    return owner if isinstance(owner, SpanTrace) else _default
+
+
+def tracing_active(registry=None) -> bool:
+    """Whether request tracing is ON: a span ring is installed AND the
+    registry records.  This is the per-request gate the serve/fleet
+    request paths check FIRST — when it is False the request path mints
+    no trace id and allocates no per-request context (the zero-cost-
+    disabled contract, same idiom as the spans null context)."""
+    from dryad_tpu.obs.registry import default_registry
+
+    reg = registry if registry is not None else default_registry()
+    return reg.enabled and spans.sink_active()
+
+
+class TailSampler:
+    """Tail sampling for merged traces: remember the slowest requests.
+
+    ``observe(trace_id, dur_s)`` is O(window) only on eviction, O(1)
+    amortized; ``slowest(k)`` returns the trace ids of the k slowest
+    requests inside the current window (the last ``window`` observed
+    requests).  The merged ``/trace`` endpoint keeps FULL span detail
+    for those ids and drops the per-request detail of everything else,
+    bounding trace size under sustained load while guaranteeing the
+    interesting (slow) requests keep their whole story."""
+
+    GUARDED_BY = {"_ring": "_lock"}
+
+    def __init__(self, window: int = 512):
+        self._lock = threading.Lock()
+        self._ring: deque = deque(maxlen=int(window))
+
+    def observe(self, trace_id: Optional[str], dur_s: float) -> None:
+        if trace_id is None:
+            return
+        with self._lock:
+            self._ring.append((float(dur_s), str(trace_id)))
+
+    def slowest(self, k: int) -> set:
+        """Trace ids of the ``k`` slowest requests in the window
+        (``k <= 0`` means keep everything observed)."""
+        with self._lock:
+            items = list(self._ring)
+        if k <= 0:
+            return {t for _, t in items}
+        items.sort(key=lambda x: -x[0])
+        return {t for _, t in items[:int(k)]}
+
+
+def _span_event(ev, pid: int, offset_s: float = 0.0) -> dict:
+    """ONE renderer for a ring event ``(path, t0_s, dur_s, tid[,
+    trace])`` → a Chrome complete event — shared by the single-process
+    and fleet documents so the tuple shape has exactly one decoder."""
+    path, t0, dur, tid = ev[:4]
+    trace = ev[4] if len(ev) > 4 else None
+    args = {"path": str(path)}
+    if trace is not None:
+        args["trace"] = str(trace)
+    return {
+        "ph": "X", "cat": "span", "pid": int(pid), "tid": int(tid),
+        "name": str(path).rsplit("/", 1)[-1],
+        "ts": round((float(t0) + offset_s) * 1e6, 3),
+        "dur": round(float(dur) * 1e6, 3),
+        "args": args,
+    }
+
+
 def to_trace_events(span_events: Sequence = (),
                     journal_events: Sequence[dict] = (),
                     stages: Sequence[dict] = ()) -> list:
@@ -110,15 +195,7 @@ def to_trace_events(span_events: Sequence = (),
         {"ph": "M", "pid": 3, "tid": 0, "name": "process_name",
          "args": {"name": "dryad stage walls (timed-fori minima)"}},
     ]
-    evs = []
-    for path, t0, dur, tid in span_events:
-        evs.append({
-            "ph": "X", "cat": "span", "pid": 1, "tid": int(tid),
-            "name": str(path).rsplit("/", 1)[-1],
-            "ts": round(float(t0) * 1e6, 3),
-            "dur": round(float(dur) * 1e6, 3),
-            "args": {"path": str(path)},
-        })
+    evs = [_span_event(ev, pid=1) for ev in span_events]
     for e in journal_events:
         args = {k: v for k, v in e.items()
                 if k not in ("event", "elapsed_s")
@@ -162,3 +239,56 @@ def write_trace(path: str, span_events: Sequence = (),
     with open(path, "w") as f:
         f.write(dumps_trace(span_events, journal_events, stages))
         f.write("\n")
+
+
+# ---------------------------------------------------------------------------
+# fleet trace assembly (r17): one merged, clock-aligned document
+
+
+def fleet_trace_events(tracks: Sequence[dict],
+                       journal_events: Sequence[dict] = (),
+                       keep: Optional[set] = None) -> list:
+    """One merged trace from per-process span tracks.
+
+    Each track is ``{"pid": int, "name": str, "events": [(path, t0_s,
+    dur_s, tid, trace), ...], "offset_s": float}`` — ``offset_s`` maps
+    the process's ``perf_counter`` origin onto the shared wall clock
+    (the registration-time clock handshake), so router and replica spans
+    line up on ONE timeline.  ``keep`` (when not None) is the tail
+    sample: trace-TAGGED events survive only if their id is in it;
+    untagged infrastructure spans always survive.  Journal events ride
+    their own pid-0 track on the journal's run-relative clock (they
+    annotate, not align — same convention as ``to_trace_events``)."""
+    meta = [{"ph": "M", "pid": 0, "tid": 0, "name": "process_name",
+             "args": {"name": "fleet journal (run-relative)"}}]
+    evs = []
+    for e in journal_events:
+        args = {k: v for k, v in e.items()
+                if k not in ("event", "elapsed_s")
+                and isinstance(v, (str, int, float, bool))}
+        evs.append({"ph": "i", "cat": "journal", "pid": 0, "tid": 0,
+                    "s": "p", "name": str(e.get("event", "event")),
+                    "ts": round(float(e.get("elapsed_s", 0.0)) * 1e6, 3),
+                    "args": args})
+    for track in tracks:
+        pid = int(track["pid"])
+        meta.append({"ph": "M", "pid": pid, "tid": 0,
+                     "name": "process_name",
+                     "args": {"name": str(track["name"])}})
+        offset = float(track.get("offset_s") or 0.0)
+        for ev in track["events"]:
+            trace = ev[4] if len(ev) > 4 else None
+            if keep is not None and trace is not None and trace not in keep:
+                continue
+            evs.append(_span_event(ev, pid=pid, offset_s=offset))
+    evs.sort(key=lambda e: (e["ts"], -e.get("dur", 0.0)))
+    return meta + evs
+
+
+def dumps_fleet_trace(tracks: Sequence[dict],
+                      journal_events: Sequence[dict] = (),
+                      keep: Optional[set] = None) -> str:
+    return json.dumps({
+        "traceEvents": fleet_trace_events(tracks, journal_events, keep),
+        "displayTimeUnit": "ms",
+    })

@@ -55,6 +55,12 @@ PROFILE_PATTERN = "PROFILE_r*.json"
 STAGE_MS_PREFIX = "stage_ms_"
 STAGE_SPREAD_PREFIX = "stage_spread_"
 
+#: r17 fleet-bench per-priority latency percentiles
+#: (``fleet_<priority>_p{50,95,99}_ms_n<replicas>``) — pattern rule like
+#: the stage profiler's, so new priorities/fleet sizes are tracked with
+#: no table edit; lower is better, vouched by that fleet size's spread
+_FLEET_PCT_RE = re.compile(r"^fleet_[a-z]+_p\d+_ms_(n\d+)$")
+
 #: metric direction tables — anything in neither set is context, not a
 #: tracked metric (row counts, spreads, tree counts, the stamps)
 HIGHER_BETTER = frozenset({
@@ -105,10 +111,12 @@ _ROUND_RE = re.compile(r"_r0*(\d+)\.json$")
 
 def _direction(name: str) -> Optional[str]:
     """Tracked-metric direction, or None for context fields.  Exact
-    tables first, then the stage-profiler prefix rule."""
+    tables first, then the stage-profiler and fleet-percentile pattern
+    rules."""
     if name in HIGHER_BETTER:
         return "higher_better"
-    if name in LOWER_BETTER or name.startswith(STAGE_MS_PREFIX):
+    if (name in LOWER_BETTER or name.startswith(STAGE_MS_PREFIX)
+            or _FLEET_PCT_RE.match(name)):
         return "lower_better"
     return None
 
@@ -117,6 +125,10 @@ def _spread_fields_of(name: str) -> tuple:
     """The newest point's spread fields vouching for ``name``."""
     if name.startswith(STAGE_MS_PREFIX):
         return (STAGE_SPREAD_PREFIX + name[len(STAGE_MS_PREFIX):],)
+    m = _FLEET_PCT_RE.match(name)
+    if m:
+        # percentile capture quality rides that fleet size's arm spread
+        return (f"fleet_spread_{m.group(1)}",)
     return _SPREAD_FIELDS.get(name, ())
 
 

@@ -47,7 +47,7 @@ from typing import Optional
 
 import numpy as np
 
-from dryad_tpu.obs.spans import span
+from dryad_tpu.obs.spans import record_at, span
 
 
 class ServeOverloaded(RuntimeError):
@@ -58,17 +58,69 @@ class ServeTimeout(TimeoutError):
     """The per-request timeout expired before the batch completed."""
 
 
+class RequestTrace:
+    """Per-request observability context across the batching hand-off.
+
+    A request crosses three threads — the caller (submit), the collector
+    (batch assembly), the executor (dispatch + fetch) — so its stage
+    timestamps are STAMPED in place as it travels and emitted once, at
+    delivery, as trace-tagged spans (the ring, obs/trace_export) and
+    per-(priority, stage) histogram observations (metrics.record_stage).
+    The queue/event hand-offs that move the request between threads
+    already provide the happens-before edges that make the plain-field
+    stamps safe: exactly one thread owns the context at a time.
+
+    Zero-cost when disabled: the server allocates a RequestTrace ONLY
+    when the obs registry records (``ServeMetrics.obs_enabled``); with
+    obs off ``Request.tctx`` stays None and every stamp site is one
+    attribute check (the spans null-context idiom, test-pinned)."""
+
+    __slots__ = ("trace", "priority", "t_submit", "t_collect", "t_execute")
+
+    def __init__(self, trace: Optional[str] = None,
+                 priority: str = "interactive"):
+        self.trace = trace
+        self.priority = priority
+        self.t_submit = 0.0
+        self.t_collect = 0.0
+        self.t_execute = 0.0
+
+    def finish(self, t_end: float, metrics=None) -> None:
+        """Emit the stage spans/observations (called once, at delivery).
+        Spans go to the SAME registry the metrics mirror into — the
+        allocation gate (``metrics.obs_enabled``), the stage histograms,
+        and the span series must agree on one registry, or a private
+        registry (tests) would allocate contexts whose spans then vanish
+        against a disabled process default."""
+        reg = metrics.obs_registry if metrics is not None else None
+        for name, stage, a, b in (
+                ("serve.request/queue_wait", "queue_wait",
+                 self.t_submit, self.t_collect),
+                ("serve.request/batch_assembly", "batch_assembly",
+                 self.t_collect, self.t_execute),
+                ("serve.request/predict", "predict",
+                 self.t_execute, t_end)):
+            dur = max(b - a, 0.0)
+            record_at(name, a, dur, trace=self.trace, registry=reg)
+            if metrics is not None:
+                metrics.record_stage(stage, dur, priority=self.priority)
+
+
 class Request:
     """One submitted predict request.  ``rows`` is pre-binned when
     ``binned`` is True, else raw float32 features — binning then happens
     in the dispatch pipeline's host stage (server._prepare), overlapped
-    with the previous batch's device predict."""
+    with the previous batch's device predict.  ``priority`` is the
+    admission class the fleet router classified (per-priority latency
+    series); ``tctx`` is the optional RequestTrace (None with obs off)."""
 
     __slots__ = ("rows", "version", "raw_score", "binned", "event", "result",
-                 "error", "abandoned")
+                 "error", "abandoned", "priority", "tctx")
 
     def __init__(self, rows: np.ndarray, version: Optional[int] = None,
-                 raw_score: bool = False, binned: bool = True):
+                 raw_score: bool = False, binned: bool = True,
+                 priority: str = "interactive",
+                 tctx: Optional[RequestTrace] = None):
         self.rows = rows
         self.version = version
         self.raw_score = raw_score
@@ -77,6 +129,8 @@ class Request:
         self.result = None
         self.error: Optional[BaseException] = None
         self.abandoned = False
+        self.priority = priority
+        self.tctx = tctx
 
 
 _STOP = object()          # pipeline-internal handoff sentinel only
@@ -200,6 +254,8 @@ class MicroBatcher:
         slice of the results.  Raises ServeOverloaded / ServeTimeout, or
         re-raises the dispatch error."""
         t0 = time.perf_counter()
+        if request.tctx is not None:
+            request.tctx.t_submit = t0
         try:
             self._q.put_nowait(request)
         except queue.Full:
@@ -221,7 +277,8 @@ class MicroBatcher:
         if self.metrics is not None:
             self.metrics.record_request(request.rows.shape[0],
                                         time.perf_counter() - t0,
-                                        request.version)
+                                        request.version,
+                                        priority=request.priority)
         return request.result
 
     # ---- worker ------------------------------------------------------------
@@ -237,6 +294,8 @@ class MicroBatcher:
         deadline instead of the row cap, and the pipeline measures SLOWER
         than serial (observed; the bench compare pins the win now)."""
         batch, rows = [first], first.rows.shape[0]
+        if first.tctx is not None:
+            first.tctx.t_collect = time.perf_counter()
         deadline = time.perf_counter() + self.max_wait_s
         stopping = False
         while rows < self.max_batch_rows:
@@ -256,6 +315,8 @@ class MicroBatcher:
                     stopping = True
                     break
                 continue        # stale: a start() since reinstated service
+            if nxt.tctx is not None:
+                nxt.tctx.t_collect = time.perf_counter()
             batch.append(nxt)
             rows += nxt.rows.shape[0]
         if self.metrics is not None:
@@ -267,7 +328,16 @@ class MicroBatcher:
         return batch, stopping
 
     @staticmethod
-    def _deliver(batch: list, results) -> None:
+    def _stamp_execute(batch: list) -> None:
+        """Mark the batch-assembly → predict boundary on every traced
+        request (called just before dispatch/execute on the owning
+        thread)."""
+        t = time.perf_counter()
+        for req in batch:
+            if req.tctx is not None:
+                req.tctx.t_execute = t
+
+    def _deliver(self, batch: list, results) -> None:
         for req, out in zip(batch, results):
             # the dispatch may fail requests individually (e.g. one
             # group's model version was unloaded mid-queue) without
@@ -277,6 +347,10 @@ class MicroBatcher:
             else:
                 req.result = out
             req.event.set()
+        t_end = time.perf_counter()
+        for req in batch:
+            if req.tctx is not None:
+                req.tctx.finish(t_end, self.metrics)
 
     @staticmethod
     def _fail(batch: list, error: BaseException) -> None:
@@ -305,6 +379,7 @@ class MicroBatcher:
             with span("serve.collect"):
                 batch, stopping = self._collect(item)
             try:
+                self._stamp_execute(batch)
                 with span("serve.dispatch"):
                     results = self._dispatch(batch)
                 self._deliver(batch, results)
@@ -326,6 +401,7 @@ class MicroBatcher:
                     return
                 batch, prepared = item
                 try:
+                    self._stamp_execute(batch)
                     with span("serve.execute"):
                         results = self._execute(prepared)
                     self._deliver(batch, results)

@@ -15,9 +15,26 @@ Endpoints (JSON in/out):
     POST /models/rollback    → {"version": v}
     GET  /metrics            → Prometheus text exposition of the shared
                                telemetry registry (dryad_tpu/obs)
+    GET  /obs                → registry.snapshot() JSON (histogram counts
+                               with bounds — the shape the fleet router
+                               merges exactly across replicas, r17)
+    GET  /trace              → Chrome trace_event JSON of the local span
+                               ring (requires enable_tracing())
+    GET  /trace/events       → raw ring events + a clock sample (the
+                               fleet /trace assembly's per-replica feed)
+    GET  /clock              → {"perf_s", "wall_s"} (auth-exempt: the
+                               supervisor's clock-offset handshake at
+                               replica registration)
     GET  /healthz            → 200 {"ok": true} | 503 {"ok": false,
                                "degraded": [...]} (obs/health.py; always
                                auth-exempt)
+
+Request tracing (r17): ``X-Dryad-Trace`` on /predict is honored (minted
+when absent and tracing is on) and echoed on the response; the id rides
+the Request through the micro-batcher so the replica's queue-wait /
+batch-assembly / predict spans land in the ring tagged with it.
+``X-Dryad-Priority`` labels the per-(priority, stage) latency
+histograms.  With obs disabled neither costs a per-request allocation.
 
 Routing: ``version`` pins an exact registry version, ``model`` routes by
 registry name (multi-model co-serving); default is the active version.
@@ -46,13 +63,18 @@ import json
 import sys
 import threading
 import time
+import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
 
 import numpy as np
 
 from dryad_tpu.obs.registry import default_registry
 from dryad_tpu.resilience.faults import InjectedReject
 from dryad_tpu.serve.batcher import ServeOverloaded, ServeTimeout
+
+TRACE_HEADER = "X-Dryad-Trace"
+PRIORITY_HEADER = "X-Dryad-Priority"
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -73,13 +95,19 @@ class _Handler(BaseHTTPRequestHandler):
             n = self.server.fault_counts.get(site, 0) + 1
             self.server.fault_counts[site] = n
         hook(site, n)
-    def _send(self, code: int, payload: dict) -> None:
-        self._send_raw(code, json.dumps(payload).encode(), "application/json")
+    def _send(self, code: int, payload: dict,
+              extra_headers: Optional[dict] = None) -> None:
+        self._send_raw(code, json.dumps(payload).encode(),
+                       "application/json", extra_headers)
 
-    def _send_raw(self, code: int, body: bytes, ctype: str) -> None:
+    def _send_raw(self, code: int, body: bytes, ctype: str,
+                  extra_headers: Optional[dict] = None) -> None:
         self.send_response(code)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(body)))
+        if extra_headers:
+            for k, v in extra_headers.items():
+                self.send_header(k, v)
         self.end_headers()
         self.wfile.write(body)
         self._log_request(code)
@@ -145,6 +173,13 @@ class _Handler(BaseHTTPRequestHandler):
             code, body = healthz_payload()
             self._send(code, body)
             return
+        if self.path == "/clock":
+            # auth-exempt like /healthz: the supervisor's clock-offset
+            # handshake runs before any credential plumbing exists, and
+            # the payload is two timestamps
+            self._send(200, {"perf_s": time.perf_counter(),
+                             "wall_s": time.time()})
+            return
         if not self._authorized():
             return
         server = self.server.predict_server
@@ -155,6 +190,26 @@ class _Handler(BaseHTTPRequestHandler):
         elif self.path == "/metrics":
             self._send_raw(200, self.server.obs_registry.exposition().encode(),
                            "text/plain; version=0.0.4; charset=utf-8")
+        elif self.path == "/obs":
+            self._send(200, self.server.obs_registry.snapshot())
+        elif self.path == "/trace":
+            from dryad_tpu.obs import trace_export
+
+            buf = trace_export.active_trace()
+            self._send_raw(200, trace_export.dumps_trace(
+                buf.events() if buf is not None else ()).encode(),
+                "application/json")
+        elif self.path == "/trace/events":
+            from dryad_tpu.obs import trace_export
+
+            buf = trace_export.active_trace()
+            events, dropped = (buf.export() if buf is not None else ([], 0))
+            self._send(200, {
+                "events": [list(e) for e in events],
+                "dropped": dropped,
+                "clock": {"perf_s": time.perf_counter(),
+                          "wall_s": time.time()},
+            })
         elif self.path == "/models":
             self._send(200, {"active": server.registry.active_version,
                              "versions": server.registry.versions(),
@@ -171,6 +226,19 @@ class _Handler(BaseHTTPRequestHandler):
             body = self._read_json()
             if self.path == "/predict":
                 self._fire_fault("request")
+                # propagated trace context: honor a supplied id; mint one
+                # only while tracing is ON (the minting allocation is part
+                # of the traced path, never the disabled one)
+                trace = self.headers.get(TRACE_HEADER)
+                priority = (self.headers.get(PRIORITY_HEADER)
+                            or "interactive").lower()
+                if priority not in ("interactive", "bulk"):
+                    priority = "interactive"
+                if trace is None:
+                    from dryad_tpu.obs.trace_export import tracing_active
+
+                    if tracing_active(self.server.obs_registry):
+                        trace = uuid.uuid4().hex[:16]
                 # resolve the entry up front: pre-binned rows must arrive in
                 # the model's bin dtype (not float), and the response must
                 # name the version that actually served — not whatever is
@@ -189,9 +257,13 @@ class _Handler(BaseHTTPRequestHandler):
                     raw_score=bool(body.get("raw", False)),
                     binned=binned,
                     timeout=body.get("timeout"),
+                    trace=trace,
+                    priority=priority,
                 )
                 self._send(200, {"predictions": np.asarray(preds).tolist(),
-                                 "version": entry.version})
+                                 "version": entry.version},
+                           extra_headers=({TRACE_HEADER: trace}
+                                          if trace else None))
             elif self.path == "/models/load":
                 version = server.load_model(
                     body["path"], activate=bool(body.get("activate", True)),

@@ -1,12 +1,20 @@
-"""Serving metrics: thread-safe counters + a bounded latency reservoir.
+"""Serving metrics: thread-safe counters + mergeable latency histograms.
 
 One ``ServeMetrics`` instance is shared by the server, the micro-batcher,
 the compiled-predict cache, and the model registry; ``snapshot()`` is the
 stats API the CLI and the HTTP ``/stats`` endpoint expose.  Latency
-percentiles come from a fixed-size reservoir of the most recent request
-latencies (a deque, not a histogram) — exact over the window, O(window)
-only at snapshot time, and free of bucket-boundary error at the tails we
-care about (p99).
+percentiles come from the fixed-log-bucket histogram family
+(``obs.registry.LOG_BUCKETS``): O(1) observe, no unbounded sort at
+snapshot time, and — the r17 point — EXACT count-merge across processes,
+so the fleet router can aggregate per-replica percentiles into one
+fleet-wide p99 instead of averaging unmergeable reservoirs.  (The old
+sorted-reservoir path is gone: quantiles are now bucket-resolution,
+~26% worst-case on the 10-per-decade scheme — the right trade for a
+number that must compose across a fleet.)  The reservoir's RECENCY is
+kept: local snapshot percentiles read a two-epoch rotating window of
+roughly the most recent ``latency_window`` requests (``_WindowedHist``),
+so a regression on a long-lived server still shows within one window —
+only the shared-registry mirrors are cumulative.
 
 Multi-model co-serving adds a per-model ledger: every counter that can be
 attributed to a version (requests, rows, latencies, cache warmth,
@@ -19,40 +27,77 @@ Round 9: every recording is ALSO mirrored into the shared telemetry
 registry (``dryad_tpu/obs``) as ``dryad_serve_*`` series, so serving
 shows up on the unified ``/metrics``/``/stats`` pane next to training
 and resilience.  The LOCAL fields stay authoritative for ``snapshot()``
-— its shape and values are unchanged bit for bit (test-pinned): the
-process-wide registry is cumulative across server instances (Prometheus
-counter semantics), while a ``ServeMetrics`` instance is per-server.
-Latency percentiles keep the exact reservoir here; the registry carries
-the bucketed histogram for scrapers."""
+— its shape is unchanged (test-pinned): the process-wide registry is
+cumulative across server instances (Prometheus counter semantics), while
+a ``ServeMetrics`` instance is per-server.  r17 adds the per-(priority,
+stage) request-latency family ``dryad_request_latency_seconds`` — the
+SAME name at router and replica, which is what makes the router's exact
+fleet merge a label-join instead of a schema mapping."""
 
 from __future__ import annotations
 
 import threading
-from collections import deque
 from typing import Optional
 
-from dryad_tpu.obs.registry import Registry, default_registry
+from dryad_tpu.obs.registry import (REQUEST_LATENCY, Registry,
+                                    default_registry, hist_quantile,
+                                    merge_hist_states, new_hist_state,
+                                    observe_log_state)
+
+__all__ = ["ModelStats", "ServeMetrics", "REQUEST_LATENCY"]
 
 
-def _pct(lat: list, p: float) -> float:
-    if not lat:
-        return 0.0
-    # nearest-rank on the reservoir
-    idx = min(len(lat) - 1, max(0, int(round(p * (len(lat) - 1)))))
-    return lat[idx] * 1e3
+class _WindowedHist:
+    """Two-epoch rotating log-bucket histogram: percentiles over the
+    most recent ~``window`` observations (between window/2 and window —
+    the current epoch plus the previous full one), O(1) observe.  This
+    preserves the pre-r17 reservoir's RECENCY contract — a latency
+    regression shows in snapshot percentiles within one window, however
+    long the process has run — without its unbounded sort.  The shared
+    registry mirrors stay cumulative (Prometheus semantics); only the
+    local snapshot reads this.  Guarded by the owning ServeMetrics
+    lock, exactly like the deques it replaces."""
+
+    __slots__ = ("half", "cur", "prev")
+
+    def __init__(self, window: int):
+        self.half = max(1, int(window) // 2)
+        self.cur = new_hist_state()
+        self.prev = None
+
+    def observe(self, value: float) -> None:
+        observe_log_state(self.cur, value)
+        if self.cur[2] >= self.half:
+            self.prev, self.cur = self.cur, new_hist_state()
+
+    def state(self) -> tuple:
+        if self.prev is None:
+            return tuple(self.cur)
+        return merge_hist_states([self.prev, self.cur])
+
+
+def _pcts(state) -> tuple:
+    """(p50_ms, p99_ms, mean_ms) from a log-hist state (mean is exact
+    over the state's observations)."""
+    counts, total, n = state
+    if not n:
+        return 0.0, 0.0, 0.0
+    return (hist_quantile(counts, 0.50) * 1e3,
+            hist_quantile(counts, 0.99) * 1e3,
+            total / n * 1e3)
 
 
 class ModelStats:
     """Per-version slice of the serving counters (guarded by the owning
     ServeMetrics lock; never touched directly by callers)."""
 
-    __slots__ = ("requests", "rows", "latencies", "cache_hits",
+    __slots__ = ("requests", "rows", "lat_hist", "cache_hits",
                  "cache_compiles", "evictions", "restages", "errors")
 
     def __init__(self, latency_window: int = 512):
         self.requests = 0
         self.rows = 0
-        self.latencies = deque(maxlen=int(latency_window))
+        self.lat_hist = _WindowedHist(latency_window)
         self.cache_hits = 0
         self.cache_compiles = 0
         self.evictions = 0
@@ -60,12 +105,12 @@ class ModelStats:
         self.errors = 0
 
     def snapshot(self) -> dict:
-        lat = sorted(self.latencies)
+        p50, p99, _ = _pcts(self.lat_hist.state())
         return {
             "requests": self.requests,
             "rows": self.rows,
-            "p50_ms": _pct(lat, 0.50),
-            "p99_ms": _pct(lat, 0.99),
+            "p50_ms": p50,
+            "p99_ms": p99,
             "cache_hits": self.cache_hits,
             "cache_compiles": self.cache_compiles,
             "evictions": self.evictions,
@@ -85,7 +130,7 @@ class ServeMetrics:
     guarded-by lint recognizes (and checks at its call sites)."""
 
     GUARDED_BY = {
-        "_latencies": "_lock", "_models": "_lock",
+        "_lat_hist": "_lock", "_models": "_lock",
         "requests": "_lock", "rows": "_lock",
         "batches": "_lock", "batch_rows": "_lock",
         "batch_capacity": "_lock",
@@ -97,6 +142,10 @@ class ServeMetrics:
 
     def __init__(self, latency_window: int = 4096,
                  registry: Optional[Registry] = None):
+        # latency_window keeps its pre-r17 meaning: local snapshot
+        # percentiles cover roughly the most recent `latency_window`
+        # requests (the two-epoch rotation above), so regressions show
+        # within one window regardless of process age
         self._lock = threading.Lock()
         # shared-registry mirror: bound series handles so the hot path is
         # one enabled-check per record when obs is disabled
@@ -118,9 +167,17 @@ class ServeMetrics:
         self._obs_errors_v = reg.counter(
             "dryad_serve_errors_by_version_total",
             "Dispatch errors by model version")
-        self._obs_latency = reg.histogram(
+        self._obs_latency = reg.log_histogram(
             "dryad_serve_request_latency_seconds",
             "End-to-end request latency")
+        # per-(priority, stage) request latency — the family the fleet
+        # router merges exactly across replicas (stages: queue_wait /
+        # batch_assembly / predict / total at a replica, router at the
+        # router); bound per-label handles are resolved lazily in
+        # record_stage (label cardinality is tiny and bounded)
+        self._obs_req_latency = reg.log_histogram(
+            REQUEST_LATENCY,
+            "Request latency by priority class and pipeline stage")
         self._obs_batches = reg.counter(
             "dryad_serve_batches_total", "Device dispatches")
         self._obs_batch_rows = reg.counter(
@@ -141,10 +198,10 @@ class ServeMetrics:
             "dryad_serve_restages_total", "Evicted models re-staged")
         self._obs_queue_depth = reg.gauge(
             "dryad_serve_queue_depth", "Last sampled request-queue depth")
-        self._latencies = deque(maxlen=int(latency_window))
-        # per-model reservoirs track the configured window but are capped
+        self._lat_hist = _WindowedHist(latency_window)
+        # per-model windows track the configured window but are capped
         # at 512 each — the model count is unbounded, the global window
-        # is not
+        # is not (the pre-r17 reservoir's own rule)
         self._model_window = min(512, int(latency_window))
         self._models: dict[int, ModelStats] = {}
         self.requests = 0          # completed requests (incl. empty)
@@ -170,25 +227,54 @@ class ServeMetrics:
             ms = self._models[version] = ModelStats(self._model_window)
         return ms
 
+    @property
+    def obs_enabled(self) -> bool:
+        """Whether the shared registry records (the request path's gate
+        for allocating per-request trace context — serve/batcher.py)."""
+        return self._obs.enabled
+
+    @property
+    def obs_registry(self) -> Registry:
+        """The registry this instance mirrors into — RequestTrace.finish
+        emits its stage spans there too, so the tctx-allocation gate,
+        the stage histograms, and the span series all agree on ONE
+        registry (a private test registry included)."""
+        return self._obs
+
     # ---- recording ---------------------------------------------------------
     def record_request(self, n_rows: int, latency_s: float,
-                       version: Optional[int] = None) -> None:
+                       version: Optional[int] = None,
+                       priority: Optional[str] = None) -> None:
         with self._lock:
             self.requests += 1
             self.rows += int(n_rows)
-            self._latencies.append(float(latency_s))
+            self._lat_hist.observe(float(latency_s))
             ms = self._model_locked(version)
             if ms is not None:
                 ms.requests += 1
                 ms.rows += int(n_rows)
-                ms.latencies.append(float(latency_s))
+                ms.lat_hist.observe(float(latency_s))
         if self._obs.enabled:
             self._obs_requests.inc()
             self._obs_rows.inc(int(n_rows))
             self._obs_latency.observe(float(latency_s))
+            self._obs_req_latency.labels(
+                priority=priority or "interactive",
+                stage="total").observe(float(latency_s))
             if version is not None:
                 self._obs_requests_v.labels(version=version).inc()
                 self._obs_rows_v.labels(version=version).inc(int(n_rows))
+
+    def record_stage(self, stage: str, seconds: float,
+                     priority: Optional[str] = None) -> None:
+        """One pipeline-stage latency observation into the mergeable
+        per-(priority, stage) family (registry-only — stages have no
+        local ledger).  First action is the enabled check: the disabled
+        path allocates nothing."""
+        if self._obs.enabled:
+            self._obs_req_latency.labels(
+                priority=priority or "interactive",
+                stage=stage).observe(float(seconds))
 
     def record_batch(self, rows: int, capacity: int) -> None:
         with self._lock:
@@ -260,7 +346,7 @@ class ServeMetrics:
         """One consistent dict of everything — counters plus derived rates.
         Latency keys are milliseconds; ``models`` maps version → its slice."""
         with self._lock:
-            lat = sorted(self._latencies)
+            p50, p99, mean = _pcts(self._lat_hist.state())
             return {
                 "requests": self.requests,
                 "rows": self.rows,
@@ -268,9 +354,9 @@ class ServeMetrics:
                 "batch_rows": self.batch_rows,
                 "batch_fill_ratio": (self.batch_rows / self.batch_capacity
                                      if self.batch_capacity else 0.0),
-                "p50_ms": _pct(lat, 0.50),
-                "p99_ms": _pct(lat, 0.99),
-                "mean_ms": (sum(lat) / len(lat) * 1e3 if lat else 0.0),
+                "p50_ms": p50,
+                "p99_ms": p99,
+                "mean_ms": mean,
                 "cache_hits": self.cache_hits,
                 "cache_compiles": self.cache_compiles,
                 "timeouts": self.timeouts,

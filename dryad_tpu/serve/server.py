@@ -35,7 +35,7 @@ from typing import Optional
 
 import numpy as np
 
-from dryad_tpu.serve.batcher import MicroBatcher, Request
+from dryad_tpu.serve.batcher import MicroBatcher, Request, RequestTrace
 from dryad_tpu.serve.cache import CompiledPredictCache
 from dryad_tpu.serve.metrics import ServeMetrics
 from dryad_tpu.serve.registry import ModelRegistry
@@ -215,12 +215,18 @@ class PredictServer:
     def predict(self, X: np.ndarray, *, version: Optional[int] = None,
                 model: Optional[str] = None, raw_score: bool = False,
                 binned: bool = False,
-                timeout: Optional[float] = None) -> np.ndarray:
+                timeout: Optional[float] = None,
+                trace: Optional[str] = None,
+                priority: Optional[str] = None) -> np.ndarray:
         """Predict through the full serving stack (bin → bucket → batch →
         compiled predict → link transform); bitwise equal to the direct
         ``Booster.predict`` / ``predict_binned`` on the same rows.
         Routing: ``version`` pins an exact version, ``model`` routes by
-        registry name; default is the active version."""
+        registry name; default is the active version.  ``trace`` is the
+        propagated request trace id (``X-Dryad-Trace`` — the HTTP front
+        end passes it through) and ``priority`` the admission class; both
+        feed the per-(priority, stage) latency series and the span ring,
+        and cost nothing when obs is disabled (no context is allocated)."""
         self.start()
         # pin the version at submit time (a name is resolved here too, so
         # a mid-queue re-deploy under the same name can't switch models)
@@ -257,8 +263,14 @@ class PredictServer:
             self.metrics.record_request(0, time.perf_counter() - t0,
                                         entry.version)
             return out
+        # trace context only when obs records — the zero-cost contract:
+        # with the registry disabled the request path allocates nothing
+        # beyond the Request it always built
+        tctx = (RequestTrace(trace, priority or "interactive")
+                if self.metrics.obs_enabled else None)
         req = Request(Xb, version=entry.version, raw_score=raw_score,
-                      binned=binned)
+                      binned=binned, priority=priority or "interactive",
+                      tctx=tctx)
         return self.batcher.submit(req, timeout=timeout)
 
     # ---- dispatch (serial) / prepare + execute (pipeline) ------------------

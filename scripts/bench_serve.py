@@ -23,7 +23,13 @@ Arms:
               subprocess replicas behind the router at N=1/2/4
               (``fleet_rows_per_s_nN`` + spreads + ``fleet_scaling_nN``)
               plus a rolling-swap drill under load (``fleet_swap_*``;
-              zero failed requests is the acceptance bar).  Standalone
+              zero failed requests is the acceptance bar).  r17: every
+              request carries an ``X-Dryad-Trace`` id (non-echoing
+              responses fail the arm) and the report records per-priority
+              latency percentiles from the router's mergeable histograms
+              (``fleet_<priority>_p{50,95,99}_ms_nN`` — the ROADMAP's
+              "p99 budgets per priority class, not just rows/s";
+              obs/trends.py tracks them like bench walls).  Standalone
               mode: the in-process arms are skipped.
 
 Acceptance gate: a forced-CPU run must report
@@ -107,6 +113,13 @@ def run_fleet_arm(args) -> int:
     if failed:
         print(f"ERROR: {failed} failed fleet request(s) — the zero-drop "
               "contract is broken", file=sys.stderr)
+        return 1
+    mismatches = sum(v for k, v in report.items()
+                     if k.startswith("fleet_trace_mismatches_n"))
+    if mismatches:
+        print(f"ERROR: {mismatches} response(s) did not echo their "
+              "X-Dryad-Trace id — trace propagation is broken",
+              file=sys.stderr)
         return 1
     if report.get("fleet_swap_versions_seen", 2) < 2:
         print("ERROR: the swap drill never observed both versions — the "

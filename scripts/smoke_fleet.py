@@ -17,9 +17,24 @@ asserts:
 * the respawned replica serves again and fleet /healthz is 200 with both
   replicas routable.
 
+r17 (request tracing) — the smoke additionally asserts:
+
+* every interactive request's ``X-Dryad-Trace`` id round-trips (the
+  response echoes the id the client sent — zero mismatches),
+* the merged router ``/trace`` contains ONE trace id with BOTH forward
+  attempts (the crash-killed forward to r0 and the retried forward that
+  answered) — the request that survived the replica crash shows its
+  whole story under one id,
+* end-to-end span assembly: some traced request shows the router span
+  AND the owning replica's queue_wait/batch_assembly/predict spans under
+  the same id (the clock-aligned per-replica tracks),
+* the aggregated router ``/metrics`` reports merged per-priority fleet
+  p99 gauges (``dryad_fleet_latency_ms{q="p99",...}``).
+
 Prints one JSON summary line on success, exits 1 with a reason otherwise.
 """
 
+import http.client
 import json
 import os
 import sys
@@ -33,6 +48,7 @@ from dryad_tpu.datasets import higgs_like  # noqa: E402
 from dryad_tpu.fleet import FleetRouter, FleetSupervisor, serve_argv  # noqa: E402
 from dryad_tpu.fleet.bench import _closed_loop  # noqa: E402
 from dryad_tpu.obs.registry import Registry  # noqa: E402
+from dryad_tpu.obs.trace_export import enable_tracing  # noqa: E402
 from dryad_tpu.resilience import faults as F  # noqa: E402
 from dryad_tpu.resilience.journal import RunJournal  # noqa: E402
 from dryad_tpu.resilience.policy import RetryPolicy  # noqa: E402
@@ -57,6 +73,7 @@ def main() -> int:
         booster.save(model_path)
         journal_path = os.path.join(td, "fleet.jsonl")
         reg = Registry()
+        enable_tracing()          # the router-side span ring (/trace)
 
         def make_argv(index: int, port_file: str) -> list:
             return serve_argv([model_path], port_file, backend="cpu",
@@ -81,7 +98,7 @@ def main() -> int:
             payloads = _payloads(num_features, (1, 3), seed=11)
             loop = _closed_loop(router.host, router.port, payloads,
                                 clients=3, duration_s=4.0, seed=2,
-                                priority="interactive")
+                                priority="interactive", trace=True)
             # the respawned replica (a fresh jax import) must come back
             deadline = time.monotonic() + 120.0
             while time.monotonic() < deadline:
@@ -92,7 +109,17 @@ def main() -> int:
                 return fail("replica 0 never respawned to routable "
                             f"(states: {sup.states()})")
             tail = _closed_loop(router.host, router.port, payloads,
-                                clients=2, duration_s=1.0, seed=3)
+                                clients=2, duration_s=1.0, seed=3,
+                                trace=True)
+            # the merged trace + aggregated metrics while the fleet is up
+            conn = http.client.HTTPConnection(router.host, router.port,
+                                              timeout=30.0)
+            conn.request("GET", "/trace?k=0")
+            resp = conn.getresponse()
+            trace_doc = json.loads(resp.read())
+            conn.request("GET", "/metrics")
+            metrics_text = conn.getresponse().read().decode()
+            conn.close()
         finally:
             router.stop()
             sup.stop()
@@ -118,10 +145,49 @@ def main() -> int:
         return fail("replica 0 never reached generation 1 readiness")
     retries = reg.counter("dryad_fleet_retry_total", "").value()
 
+    # ---- r17 tracing assertions -------------------------------------------
+    if loop["trace_mismatches"] or tail["trace_mismatches"]:
+        return fail(f"{loop['trace_mismatches']} + "
+                    f"{tail['trace_mismatches']} response(s) did not echo "
+                    "their X-Dryad-Trace id")
+    spans_by_trace: dict = {}
+    for ev in trace_doc.get("traceEvents", []):
+        if ev.get("ph") != "X":
+            continue
+        tid = ev.get("args", {}).get("trace")
+        if tid:
+            spans_by_trace.setdefault(tid, []).append(
+                (ev["pid"], ev["args"]["path"]))
+    # the crash-surviving request: both forward attempts under ONE id
+    crash_traces = [
+        t for t, evs in spans_by_trace.items()
+        if len({p for _, p in evs if p.startswith("fleet.forward/")}) >= 2]
+    if not crash_traces:
+        return fail("no trace shows two forward attempts — the crashed "
+                    "request's retry is not assembled under one id "
+                    f"({len(spans_by_trace)} traces seen)")
+    # end-to-end assembly: router span + the owning replica's stage spans
+    replica_stages = {"serve.request/queue_wait",
+                      "serve.request/batch_assembly",
+                      "serve.request/predict"}
+    full = [t for t, evs in spans_by_trace.items()
+            if any(p == "fleet.request" for _, p in evs)
+            and replica_stages <= {p for pid, p in evs if pid >= 10}]
+    if not full:
+        return fail("no trace assembles the router span with the "
+                    "replica's queue/batch/predict spans under one id")
+    if 'dryad_fleet_latency_ms{' not in metrics_text \
+            or 'q="p99"' not in metrics_text:
+        return fail("router /metrics lacks the merged per-priority p99 "
+                    "gauges (dryad_fleet_latency_ms)")
+
     print(json.dumps({
         "fleet_smoke": "ok",
         "requests": loop["requests"] + tail["requests"],
         "failed_interactive": 0,
+        "trace_mismatches": 0,
+        "crash_traces": len(crash_traces),
+        "assembled_traces": len(full),
         "crashes": len(crashes),
         "respawns": len(respawns),
         "router_retries": retries,

@@ -8,6 +8,16 @@ subprocess.  The REAL serve replica path is covered by
 ``scripts/smoke_fleet.py`` (ci.sh) and the fleet bench; these tests pin
 the supervisor/router logic, which only ever sees the wire protocol.
 
+r17 additions, still pure stdlib: ``/predict`` echoes ``X-Dryad-Trace``
+back (the round-trip contract) and appends a span-shaped event to an
+in-memory ring served by ``/trace/events``; ``/clock`` answers the
+supervisor's offset handshake; ``/obs`` serves a registry-snapshot-shaped
+JSON whose ``dryad_request_latency_seconds`` counts ride the FIXED
+62-slot log-bucket layout (obs/registry.LOG_BUCKETS has 61 bounds — a
+count array of any other length is SKIPPED by the router's merge, so a
+mismatched stub silently contributes nothing), so router merge tests
+run against the wire shape without a jax import.
+
 Deterministic failure shapes, flag-armed:
 
     --crash-on-path     GET /boom hard-exits with code 23 (injected-crash
@@ -80,6 +90,24 @@ class _Handler(BaseHTTPRequestHandler):
                     'stub_latency_ms{path="/predict"} 1.5\n')
             self._send(200, text.encode(),
                        "text/plain; version=0.0.4; charset=utf-8")
+        elif self.path == "/clock":
+            self._send(200, {"perf_s": time.perf_counter(),
+                             "wall_s": time.time()})
+        elif self.path == "/trace/events":
+            self._send(200, {"events": list(self.server.trace_events),
+                             "dropped": 0,
+                             "clock": {"perf_s": time.perf_counter(),
+                                       "wall_s": time.time()}})
+        elif self.path == "/obs":
+            # 61 bounds + overflow — MUST match obs/registry.LOG_BUCKETS
+            counts = [0] * 62
+            n = self.server.requests
+            counts[25] = n                     # ~31.6 ms bucket
+            lbl = 'priority="interactive",stage="total"'
+            self._send(200, {"histograms": {
+                "dryad_request_latency_seconds": {
+                    lbl: {"counts": counts, "sum": 0.0316 * n,
+                          "count": n, "log": True}}}})
         elif self.path == "/boom" and cfg.crash_on_path:
             os._exit(23)
         else:
@@ -92,8 +120,10 @@ class _Handler(BaseHTTPRequestHandler):
         length = int(self.headers.get("Content-Length") or 0)
         body = self.rfile.read(length) if length else b"{}"
         if self.path == "/predict":
+            t0 = time.perf_counter()
             self.server.requests += 1
             version = self.server.version     # pin at request start
+            trace = self.headers.get("X-Dryad-Trace")
             if cfg.predict_503:
                 self._send(503, {"error": "stub shedding"})
                 return
@@ -103,8 +133,20 @@ class _Handler(BaseHTTPRequestHandler):
                 rows = json.loads(body).get("rows", [])
             except ValueError:
                 rows = []
-            self._send(200, {"predictions": [0.5] * len(rows),
-                             "version": version})
+            # serve-shaped trace behavior: echo the propagated id and
+            # ring one span-shaped event for /trace/events
+            self.server.trace_events.append(
+                ["serve.request/predict", t0,
+                 time.perf_counter() - t0, 1, trace])
+            payload = json.dumps({"predictions": [0.5] * len(rows),
+                                  "version": version}).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(payload)))
+            if trace:
+                self.send_header("X-Dryad-Trace", trace)
+            self.end_headers()
+            self.wfile.write(payload)
         elif self.path == "/models/load":
             if cfg.load_delay > 0:
                 time.sleep(cfg.load_delay)
@@ -137,6 +179,7 @@ def main() -> int:
     httpd.version_lock = threading.Lock()
     httpd.requests = 0
     httpd.health_probes = 0
+    httpd.trace_events = []
     host, port = httpd.server_address[:2]
     tmp = cfg.port_file + ".tmp"
     with open(tmp, "w") as f:

@@ -382,8 +382,13 @@ def test_serve_metrics_snapshot_shape_backward_compatible():
     assert reg.counter("dryad_serve_timeouts_total").value() == 1
     assert reg.counter("dryad_serve_errors_total").value() == 1
     assert reg.gauge("dryad_serve_queue_depth").value() == 3
-    assert reg.histogram(
+    # r17: the latency mirror rides the mergeable log-bucket family
+    assert reg.log_histogram(
         "dryad_serve_request_latency_seconds").value()[2] == 2
+    # ... and the per-(priority, stage) family saw both totals
+    assert reg.log_histogram(
+        "dryad_request_latency_seconds").labels(
+        priority="interactive", stage="total").value()[2] == 2
 
 
 # ---- trainer wiring ---------------------------------------------------------

@@ -62,8 +62,25 @@ def test_sink_disabled_registry_records_nothing(sink):
 
 def test_record_feeds_the_sink(sink):
     spans.record("loop_body", 0.004, registry=Registry())
-    ((path, t0, dur, _tid),) = sink.events()
+    ((path, t0, dur, _tid, trace),) = sink.events()
     assert path == "loop_body" and abs(dur - 0.004) < 1e-9
+    assert trace is None                     # record() is untagged
+
+
+def test_record_at_tags_the_trace(sink):
+    reg = Registry()
+    spans.record_at("serve.request/predict", 10.0, 0.25,
+                    trace="abc123", registry=reg)
+    ((path, t0, dur, _tid, trace),) = sink.events()
+    assert (path, t0, dur, trace) == (
+        "serve.request/predict", 10.0, 0.25, "abc123")
+    # the span series got the same completion
+    assert reg.counter(spans.COUNT).labels(
+        span="serve.request/predict").value() == 1
+    # and the rendered trace carries the id in args
+    evs = [e for e in to_trace_events(span_events=sink.events())
+           if e["ph"] == "X"]
+    assert evs[0]["args"]["trace"] == "abc123"
 
 
 def test_trace_events_schema_monotonic_and_nested(sink):
