@@ -120,14 +120,43 @@ def train(
         if backend == "cpu":
             from dryad_tpu.cpu.trainer import train_cpu
 
-            return train_cpu(p, train_set, valid, init_booster=init_booster,
-                             callback=cb, checkpointer=checkpointer,
-                             chunk_hook=chunk_hook)
-        from dryad_tpu.engine.train import train_device
+            booster = train_cpu(p, train_set, valid,
+                                init_booster=init_booster, callback=cb,
+                                checkpointer=checkpointer,
+                                chunk_hook=chunk_hook)
+        else:
+            from dryad_tpu.engine.train import train_device
 
-        return train_device(p, train_set, valid, init_booster=init_booster,
-                            callback=cb, checkpointer=checkpointer, mesh=mesh,
-                            chunk_hook=chunk_hook, chunk_policy=chunk_policy)
+            booster = train_device(p, train_set, valid,
+                                   init_booster=init_booster, callback=cb,
+                                   checkpointer=checkpointer, mesh=mesh,
+                                   chunk_hook=chunk_hook,
+                                   chunk_policy=chunk_policy)
+    _attach_profile(booster, train_set, valid)
+    return booster
+
+
+def _attach_profile(booster, train_set, valid_sets) -> None:
+    """Train-completion hook: embed the drift baseline (data/profile.py)
+    in the returned model.  Host-side and bounded (stride subsample +
+    one CPU predict); ``DRYAD_PROFILE=0`` skips it (the tier-1 suite
+    pins it off in conftest — hundreds of tiny trains need no baseline).
+    Best-effort: a profile failure warns, it never fails a finished
+    training run at the finish line."""
+    import os
+
+    if os.environ.get("DRYAD_PROFILE", "1") == "0":
+        return
+    try:
+        from dryad_tpu.data.profile import build_reference_profile
+
+        booster.profile = build_reference_profile(booster, train_set,
+                                                  valid_sets)
+    except Exception as e:  # noqa: BLE001 — telemetry must not kill a train
+        import warnings
+
+        warnings.warn(f"reference-profile capture failed ({e!r}); "
+                      "the model ships without a drift baseline")
 
 
 def predict(

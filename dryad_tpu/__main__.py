@@ -353,6 +353,8 @@ def cmd_serve(args) -> int:
         sharded={"auto": "auto", "on": True, "off": False}[args.sharded],
         device_budget_bytes=(args.device_budget_mb * (1 << 20)
                              if args.device_budget_mb else None),
+        drift="off" if args.drift_window == 0 else "auto",
+        drift_window=args.drift_window,
     )
     import os.path
 
@@ -448,6 +450,7 @@ def cmd_fleet(args) -> int:
     the health-routed fleet router (dryad_tpu/fleet)."""
     from dryad_tpu.fleet import FleetSupervisor, make_fleet_router, serve_argv
     from dryad_tpu.fleet.router import main_loop
+    from dryad_tpu.obs.drift import parse_psi_budget
     from dryad_tpu.obs.slo import parse_budgets
     from dryad_tpu.resilience.policy import RetryPolicy
 
@@ -470,6 +473,7 @@ def cmd_fleet(args) -> int:
                           max_batch_rows=args.max_batch_rows,
                           max_wait_ms=args.max_wait_ms,
                           queue_size=args.queue_size, warmup=args.warmup,
+                          drift_window=args.drift_window,
                           auth_token=args.auth_token)
 
     policy = (RetryPolicy() if args.retry_budget is None
@@ -504,7 +508,9 @@ def cmd_fleet(args) -> int:
             min_healthy=args.min_healthy,
             auth_token=args.auth_token, verbose=not args.quiet,
             slo_budgets_ms=parse_budgets(args.slo_ms),
-            slo_breach_after=args.slo_breach_after)
+            slo_breach_after=args.slo_breach_after,
+            drift_budget_psi=parse_psi_budget(args.drift_psi),
+            drift_breach_after=args.drift_breach_after)
         host, port = httpd.server_address[:2]
         if not args.quiet:
             urls = {s.name: s.state()["url"]
@@ -639,6 +645,10 @@ def main(argv=None) -> int:
                    help="compile every (version, bucket) predict program "
                         "at startup and arm the recompile tripwire "
                         "(unexpected compiles then degrade /healthz)")
+    s.add_argument("--drift-window", type=int, default=8192,
+                   help="model-drift monitor window (rows of recent "
+                        "traffic compared against the model's embedded "
+                        "reference profile; 0 disables drift telemetry)")
     s.add_argument("--log-requests", action="store_true",
                    help="structured JSON request log on stderr")
     s.add_argument("--auth-token", default=os.environ.get("DRYAD_AUTH_TOKEN"),
@@ -701,6 +711,20 @@ def main(argv=None) -> int:
     fl.add_argument("--slo-breach-after", type=int, default=3,
                     help="consecutive over-budget /healthz evaluations "
                          "before the SLO degrades the router")
+    fl.add_argument("--drift-psi", default="",
+                    help="PSI budget for the model-drift layer (default "
+                         "0.2, the 'significant shift' rule; replicas' "
+                         "window counts merge exactly, GET /drift "
+                         "reports verdicts, a sustained breach journals "
+                         "drift_breach + warns in /healthz payloads — "
+                         "warn-only; 'off' disables drift reporting)")
+    fl.add_argument("--drift-breach-after", type=int, default=2,
+                    help="consecutive over-budget drift windows before "
+                         "the breach is sustained (journal + warning)")
+    fl.add_argument("--drift-window", type=int, default=8192,
+                    help="per-replica drift monitor window in rows "
+                         "(serve --drift-window; 0 disables the "
+                         "replica-side monitors)")
     fl.add_argument("--startup-timeout", type=float, default=120.0,
                     help="per-replica readiness deadline (device replicas "
                          "pay model load + compile here)")

@@ -1103,6 +1103,101 @@ def drill_loghist_scrape_tear(sched: Scheduler):
     return check
 
 
+def drill_drift_window_tear(sched: Scheduler):
+    """r18 drift-window tear: concurrent binned-batch/score observes into
+    two replica DriftMonitors while a third rotates its two-epoch window
+    and a scraper snapshots + EXACT-MERGES the export blocks — the fleet
+    router's /drift shape, under line-granular preemption inside
+    obs/drift.py.
+
+    Invariants: every scraped block is internally consistent (each
+    feature's window counts sum to the block's row count — a row
+    increments exactly one bin per feature, so a torn counts-vs-rows
+    read is the race the preemption exposes; the score state likewise),
+    every merge of consistent blocks is consistent, and the FINAL merge
+    equals one monitor fed the concatenated observations bitwise
+    (integer counts — the merge-counts-never-ratios discipline), with
+    PSI on the merge equal to PSI on the concatenation."""
+    import numpy as np
+
+    from dryad_tpu.obs.drift import (DriftMonitor, drift_report,
+                                     merge_drift_states)
+    from dryad_tpu.obs.registry import Registry
+
+    ref = [[4, 4, 4, 4], [1, 2, 4, 9]]
+    reg = Registry(enabled=False)
+    mons = [DriftMonitor(ref, model="v1", window_rows=10 ** 6, registry=reg)
+            for _ in range(2)]
+    rot = DriftMonitor(ref, model="rot", window_rows=8, registry=reg)
+    batches = [np.asarray([[0, 1], [1, 2], [2, 3]], np.uint8),
+               np.asarray([[3, 0]], np.uint8),
+               np.asarray([[2, 2], [1, 1]], np.uint8)]
+    scores = [np.asarray([0.5, -0.5, 2.0]), np.asarray([0.25]),
+              np.asarray([-2.0, 1.0])]
+    merges: list = []
+
+    def consistent(st: dict) -> None:
+        for counts in st["features"]:
+            assert sum(counts) == st["rows"], (
+                f"torn drift block {st['model']}: rows={st['rows']} "
+                f"counts={st['features']}")
+        if st["score"] is not None:
+            assert st["score"][2] == sum(st["score"][0]), (
+                f"torn score state {st['model']}: {st['score']}")
+
+    def writer(mi: int) -> Callable[[], None]:
+        def run() -> None:
+            for batch, sc in zip(batches, scores):
+                mons[mi].observe_features(batch)
+                mons[mi].observe_scores(sc)
+        return run
+
+    def rotator() -> None:
+        # window 8 -> half 4: these 20 rows rotate the epochs repeatedly
+        # while the scraper reads — a torn prev/cur swap breaks the
+        # counts-vs-rows invariant
+        for _ in range(4):
+            rot.observe_features(batches[0])
+            rot.observe_features(batches[2])
+
+    def scraper() -> None:
+        for _ in range(5):
+            states = [m.export_state() for m in mons]
+            for st in states + [rot.export_state()]:
+                consistent(st)
+            merged = merge_drift_states(states)
+            for counts in merged["features"]:
+                assert sum(counts) == merged["rows"], f"torn merge {merged}"
+            merges.append(merged)
+
+    sched.spawn(writer(0), "replica-a")
+    sched.spawn(writer(1), "replica-b")
+    sched.spawn(rotator, "rotator")
+    sched.spawn(scraper, "scraper")
+
+    def check() -> None:
+        ref_mon = DriftMonitor(ref, model="ref", window_rows=10 ** 6,
+                               registry=reg)
+        for _ in mons:                     # the concatenated observations
+            for batch, sc in zip(batches, scores):
+                ref_mon.observe_features(batch)
+                ref_mon.observe_scores(sc)
+        merged = merge_drift_states([m.export_state() for m in mons])
+        want = ref_mon.export_state()
+        assert merged["features"] == want["features"], \
+            "merged counts != concatenated"
+        assert merged["rows"] == want["rows"]
+        assert merged["score"][0] == want["score"][0], \
+            "merged score counts != concatenated"
+        assert merged["score"][2] == want["score"][2]
+        assert (drift_report(merged)["psi_max"]
+                == drift_report(want)["psi_max"]), \
+            "PSI on the merge != PSI on the concatenation"
+        assert merges, "the scraper never ran"
+
+    return check
+
+
 def drill_injector_concurrent_fire(sched: Scheduler):
     """FaultInjector concurrent fire — the r14 atomic check-and-clear.
 
@@ -1149,6 +1244,8 @@ DRILLS: dict = {
                           ("obs/registry.py",)),
     "loghist-scrape-tear": (drill_loghist_scrape_tear, 20, 0.25,
                             ("obs/registry.py",)),
+    "drift-window-tear": (drill_drift_window_tear, 15, 0.25,
+                          ("obs/drift.py",)),
     "injector-concurrent-fire": (drill_injector_concurrent_fire, 20, 0.3,
                                  ("resilience/faults.py",)),
 }

@@ -35,6 +35,16 @@ from dryad_tpu.data.sketch import BinMapper
 CAT_WORDS = 8  # bitset words per node: supports max_bins <= 256 categorical splits
 
 
+def _profile_from_dict(d):
+    """Optional reference-profile section -> object (None passes through:
+    models saved before r18 carry no profile and must keep loading)."""
+    if not d:
+        return None
+    from dryad_tpu.data.profile import ReferenceProfile
+
+    return ReferenceProfile.from_json_dict(d)
+
+
 class Booster:
     def __init__(
         self,
@@ -54,6 +64,7 @@ class Booster:
         train_state: Optional[dict] = None,
         default_left: Optional[np.ndarray] = None,
         cover: Optional[np.ndarray] = None,
+        profile=None,
     ):
         self.params = params
         self.mapper = mapper
@@ -80,6 +91,11 @@ class Booster:
                              else np.asarray(default_left, bool))
         # loop state a resumed run needs to continue exactly (early stopping)
         self.train_state = dict(train_state or {})
+        # train-time reference profile (data/profile.py) — the drift
+        # baseline the serving layer monitors against; None for models
+        # saved before round 18 (back-compat pinned) and for trainers
+        # invoked below the dryad.train wrapper
+        self.profile = profile
 
     # ---- shape helpers -----------------------------------------------------
     @property
@@ -332,6 +348,10 @@ class Booster:
                         "best_iteration": self.best_iteration,
                         "train_state": self.train_state,
                         "format_version": 1,
+                        # optional (r18): the drift baseline; absent keys
+                        # keep old readers loading new files and vice versa
+                        **({"profile": self.profile.to_json_dict()}
+                           if self.profile is not None else {}),
                     }
                 ).encode(),
                 dtype=np.uint8,
@@ -397,6 +417,11 @@ class Booster:
             "mapper": self.mapper.to_json_dict(),
             "trees": trees,
         }
+        if self.profile is not None:
+            # optional r18 section: integer bin counts + score-histogram
+            # states (data/profile.py) — json round-trips them exactly,
+            # and readers that predate the key simply never look at it
+            doc["profile"] = self.profile.to_json_dict()
         return json.dumps(doc, indent=1)
 
     def save_text(self, path: str) -> None:
@@ -449,6 +474,7 @@ class Booster:
             is_cat, cat_bitset, np.asarray(doc["init_score"], np.float32),
             int(doc["max_depth_seen"]), int(doc.get("best_iteration", -1)),
             gain=gain, cover=cover, default_left=default_left,
+            profile=_profile_from_dict(doc.get("profile")),
         )
 
     @classmethod
@@ -490,6 +516,7 @@ class Booster:
                 cover=z["cover"] if "cover" in z.files else None,
                 train_state=meta.get("train_state"),
                 default_left=z["default_left"] if "default_left" in z.files else None,
+                profile=_profile_from_dict(meta.get("profile")),
             )
 
     # ---- introspection -----------------------------------------------------

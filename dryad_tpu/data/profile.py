@@ -1,0 +1,173 @@
+"""Train-time reference profile: the model's own drift baseline.
+
+At ``dryad.train`` completion a compact per-feature profile of the
+training distribution is computed and embedded in the model artifact
+(``Booster.profile``; text-format section + binary meta — both round-
+trip through ``Booster.load_any``), so every served model carries its
+own baseline and the serve-path drift monitor (obs/drift.py) needs no
+side channel:
+
+* **per-feature binned-count distribution** over the sketch's frozen bin
+  space — the SAME space the serve batcher bins every request into, so
+  serve-side drift accounting is exact set-membership, not re-binning;
+  bin 0 is the missing bin, so missing rates ride along for free;
+* **bin-edge quantiles** — a decile summary of each numerical feature's
+  sketch edges (inspection/debugging; the full edges live in the
+  mapper);
+* **score histograms** of the model's own raw margin scores on train
+  (and the first valid set) on the fixed ``obs.drift.SCORE_BUCKETS``
+  layout — the serve side histograms its predictions into the same
+  layout, so score-shift PSI is an exact count comparison.
+
+The profile is computed on a deterministic row subsample (stride over
+the binned matrix, ``max_rows`` cap) so a 10M-row headline pays one
+bounded CPU predict, not a second epoch; counts are INTEGERS end to end
+(the merge-counts discipline).  ``DRYAD_PROFILE=0`` skips the capture
+entirely (tests/conftest.py pins it off for the tier-1 suite; the
+serve/fleet smokes run with it on).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from dryad_tpu.obs.drift import new_score_state, observe_scores_state
+
+PROFILE_VERSION = 1
+#: profile subsample cap — bounds the completion-time CPU predict
+DEFAULT_MAX_ROWS = 65536
+#: decile grid for the per-feature edge-quantile summary
+_QUANTILE_GRID = tuple(i / 10 for i in range(11))
+
+
+class ReferenceProfile:
+    """The compact baseline embedded in the model artifact."""
+
+    __slots__ = ("version", "n_rows", "feature_counts", "quantiles",
+                 "score_hist")
+
+    def __init__(self, feature_counts: Sequence[Sequence[int]],
+                 quantiles: Sequence[Sequence[float]],
+                 score_hist: dict, n_rows: int,
+                 version: int = PROFILE_VERSION):
+        self.version = int(version)
+        self.n_rows = int(n_rows)
+        self.feature_counts = [list(map(int, c)) for c in feature_counts]
+        self.quantiles = [[float(v) for v in q] for q in quantiles]
+        # split name -> [counts, sum, count] on obs.drift.SCORE_BUCKETS
+        self.score_hist = {
+            str(k): [list(map(int, st[0])), float(st[1]), int(st[2])]
+            for k, st in (score_hist or {}).items()}
+
+    @property
+    def num_features(self) -> int:
+        return len(self.feature_counts)
+
+    def missing_rate(self) -> list[float]:
+        """Per-feature missing rate — bin 0 is the missing bin by the
+        frozen sketch contract."""
+        return [(c[0] / s if (s := sum(c)) else 0.0)
+                for c in self.feature_counts]
+
+    # ---- serialization (json-safe; floats round-trip exactly) --------------
+    def to_json_dict(self) -> dict:
+        return {
+            "profile_version": self.version,
+            "n_rows": self.n_rows,
+            "feature_counts": [list(c) for c in self.feature_counts],
+            "quantiles": [list(q) for q in self.quantiles],
+            "score_hist": {k: [list(st[0]), st[1], st[2]]
+                           for k, st in self.score_hist.items()},
+        }
+
+    @classmethod
+    def from_json_dict(cls, d: dict) -> "ReferenceProfile":
+        return cls(d["feature_counts"], d.get("quantiles") or [],
+                   d.get("score_hist") or {}, d.get("n_rows", 0),
+                   d.get("profile_version", PROFILE_VERSION))
+
+    def __eq__(self, other) -> bool:
+        return (isinstance(other, ReferenceProfile)
+                and self.to_json_dict() == other.to_json_dict())
+
+    def __repr__(self) -> str:
+        return (f"ReferenceProfile({self.num_features} features, "
+                f"{self.n_rows} rows, splits={sorted(self.score_hist)})")
+
+
+def _subsample(Xb: np.ndarray, max_rows: int) -> np.ndarray:
+    """Deterministic stride subsample — chunk/backend invariant (the
+    stride depends only on N and the cap, never on data values)."""
+    n = int(Xb.shape[0])
+    if n <= max_rows:
+        return Xb
+    stride = -(-n // max_rows)          # ceil: at most max_rows rows
+    return Xb[::stride]
+
+
+def _feature_counts(Xb: np.ndarray, n_bins: Sequence[int]) -> list:
+    counts = []
+    for f, nb in enumerate(n_bins):
+        col = np.minimum(Xb[:, f].astype(np.int64, copy=False), int(nb) - 1)
+        counts.append(np.bincount(col, minlength=int(nb)).tolist())
+    return counts
+
+
+def _edge_quantiles(mapper) -> list:
+    """Decile summary of each numerical feature's sketch edges (empty
+    for categorical features and for bundled mappers, whose columns are
+    synthetic stacks without a single edge vector)."""
+    feats = getattr(mapper, "features", None)
+    if feats is None:
+        return []
+    out = []
+    for fb in feats:
+        edges = np.asarray(fb.edges, np.float32)
+        if fb.is_categorical or edges.size == 0:
+            out.append([])
+            continue
+        idx = [min(int(round(q * (edges.size - 1))), edges.size - 1)
+               for q in _QUANTILE_GRID]
+        out.append([float(edges[i]) for i in idx])
+    return out
+
+
+def profile_from_binned(booster, Xb: np.ndarray,
+                        valid_binned: Optional[dict] = None, *,
+                        max_rows: int = DEFAULT_MAX_ROWS) -> ReferenceProfile:
+    """Build a profile from an already-binned matrix (the core both
+    ``build_reference_profile`` and the serve bench use).  Scores come
+    from the canonical CPU predict — bit-identical across backends, so
+    the baseline is backend-invariant by construction."""
+    mapper = booster.mapper
+    sample = _subsample(np.asarray(Xb), int(max_rows))
+    n_bins = [int(b) for b in mapper.n_bins]
+    score_hist: dict = {}
+    for split, mat in dict({"train": sample}, **(valid_binned or {})).items():
+        mat = _subsample(np.asarray(mat), int(max_rows))
+        if mat.shape[0] == 0:
+            continue
+        raw = booster.predict_binned(mat, raw_score=True, backend="cpu")
+        state = new_score_state()
+        observe_scores_state(state, np.asarray(raw, np.float64))
+        score_hist[split] = state
+    return ReferenceProfile(
+        _feature_counts(sample, n_bins), _edge_quantiles(mapper),
+        score_hist, sample.shape[0])
+
+
+def build_reference_profile(booster, train_set, valid_sets=None, *,
+                            max_rows: int = DEFAULT_MAX_ROWS
+                            ) -> ReferenceProfile:
+    """The ``dryad.train`` completion hook: profile the training
+    dataset's binned matrix plus the FIRST valid set's scores (early
+    stopping watches that one, so it is the deployment-relevant holdout
+    distribution)."""
+    valid_binned: dict = {}
+    for item in (valid_sets or [])[:1]:
+        ds = item[1] if isinstance(item, tuple) else item
+        valid_binned["valid"] = ds.X_binned
+    return profile_from_binned(booster, train_set.X_binned, valid_binned,
+                               max_rows=max_rows)

@@ -46,6 +46,7 @@ def serve_argv(model_specs: Sequence[str], port_file: str, *,
                max_wait_ms: Optional[float] = None,
                queue_size: Optional[int] = None,
                warmup: bool = False,
+               drift_window: Optional[int] = None,
                auth_token: Optional[str] = None,
                python: Optional[str] = None) -> list[str]:
     """The production replica command: ``python -m dryad_tpu serve`` on
@@ -64,6 +65,8 @@ def serve_argv(model_specs: Sequence[str], port_file: str, *,
         argv += ["--queue-size", str(int(queue_size))]
     if warmup:
         argv += ["--warmup"]
+    if drift_window is not None:
+        argv += ["--drift-window", str(int(drift_window))]
     if auth_token:
         argv += ["--auth-token", auth_token]
     return argv

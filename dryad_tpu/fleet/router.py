@@ -40,7 +40,15 @@ stage="router" series.  ``/healthz`` (auth-exempt, like every other
 healthz in this repo) answers 200 while at least ``min_healthy``
 replicas are routable AND no per-priority p99 SLO budget is in
 sustained breach (obs/slo.py; verdicts ride the payload).  ``/stats``
-returns the JSON view (slot states + shed/retry counters).  ``/trace``
+returns the JSON view (slot states + shed/retry counters).  r18 model
+drift: each replica's ``/obs`` answer also carries its raw drift-window
+bin counts (serve-side ``DriftMonitor``); the router merges the COUNTS
+per model bitwise (never PSI values or ratios), computes fleet-wide PSI
+once on the merged state, serves ``dryad_fleet_drift_*`` gauges on
+``/metrics`` and a ``GET /drift`` JSON report, and a DriftGate turns a
+SUSTAINED breach into a journaled ``drift_breach`` + a ``drift:<model>``
+warning in /healthz payloads (warn-only: a drifted model still serves —
+the event is the retrain/rollback trigger, not an outage).  ``/trace``
 (r17) assembles the fleet-wide Chrome trace: router spans, every live
 replica's span ring clock-aligned by the registration-time offset
 handshake, and the supervisor journal as an annotation track —
@@ -66,6 +74,8 @@ import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
+from dryad_tpu.obs.drift import (DriftGate, drift_report,
+                                 merge_drift_states)
 from dryad_tpu.obs.exporter import authorized, send_unauthorized
 from dryad_tpu.obs.health import HealthState
 from dryad_tpu.obs.registry import (LOG_BUCKETS, REQUEST_LATENCY, Registry,
@@ -122,7 +132,9 @@ class _RouterState:
                  min_healthy: int, auth_token: Optional[str],
                  slo_budgets_ms: Optional[dict] = None,
                  slo_quantile: float = 0.99, slo_breach_after: int = 3,
-                 tail_window: int = 512, tail_keep: int = 16):
+                 tail_window: int = 512, tail_keep: int = 16,
+                 drift_budget_psi: Optional[float] = None,
+                 drift_breach_after: int = 2, drift_top_k: int = 5):
         self.supervisor = supervisor
         self.registry = (registry if registry is not None
                          else default_registry())
@@ -139,6 +151,15 @@ class _RouterState:
                            breach_after=slo_breach_after,
                            registry=self.registry, health=self.slo_health)
         self._slo_last: dict[str, tuple] = {}
+        # drift verdicts (r18, obs/drift.py): WARN-ONLY by default — a
+        # drifted model keeps serving; a sustained breach journals
+        # ``drift_breach`` through the supervisor (the continual-
+        # boosting retrain/rollback trigger) and rides /healthz PAYLOADS
+        # as a ``drift:<model>`` warning.  None disables the layer.
+        self.drift_top_k = int(drift_top_k)
+        self.drift = (None if drift_budget_psi is None else DriftGate(
+            float(drift_budget_psi), breach_after=drift_breach_after,
+            registry=self.registry, on_breach=self._journal_drift_breach))
         self.max_inflight = int(max_inflight)
         self.bulk_max_inflight = (int(bulk_max_inflight)
                                   if bulk_max_inflight is not None
@@ -210,6 +231,72 @@ class _RouterState:
                 "dryad_fleet_inflight",
                 "Requests currently inside the fleet").set(
                 self.inflight_total)
+
+    # ---- drift (r18) -------------------------------------------------------
+    def _journal_drift_breach(self, model: str, verdict: dict) -> None:
+        """DriftGate's on_breach: one journal line per NEW sustained
+        breach, in the supervisor's flight recorder next to crashes and
+        swaps (stub supervisors without a journal are skipped)."""
+        jr = getattr(self.supervisor, "journal", None)
+        if jr is not None:
+            jr("drift_breach", model=model,
+               psi_max=verdict.get("psi_max"),
+               score_psi=verdict.get("score_psi"),
+               features_over=verdict.get("features_over"),
+               features=[t["feature"] for t in verdict.get("top", [])],
+               streak=verdict.get("streak"))
+        self.count("dryad_fleet_drift_breach_total",
+                   "Sustained fleet drift breaches journaled", model=model)
+
+    def update_drift(self, blocks: list) -> dict:
+        """Fold per-replica drift blocks (each ``{model: export_state}``)
+        into fleet verdicts: counts are merged EXACTLY per model (the
+        r17 histogram discipline — merge counts, never PSI values), PSI
+        runs once on the merged state, ``dryad_fleet_drift_*`` gauges
+        mirror it, and the gate advances its sustained-breach streaks.
+        Runs on the scrape cadence (/metrics and /drift), never inside
+        /healthz — the health path stays scrape-free and reads the
+        LATCHED verdicts."""
+        if self.drift is None:
+            return {}
+        per_model: dict[str, list] = {}
+        for block in blocks:
+            if not isinstance(block, dict):
+                continue
+            for model, st in block.items():
+                per_model.setdefault(str(model), []).append(st)
+        reports: dict = {}
+        for model, sts in sorted(per_model.items()):
+            try:
+                merged = merge_drift_states(sts)
+            except ValueError:
+                # a malformed or mixed-version replica block must not
+                # kill the whole fleet scrape — skip it, on the record
+                self.count("dryad_fleet_drift_merge_error_total",
+                           "Replica drift blocks that failed the exact "
+                           "merge", model=model)
+                continue
+            reports[model] = drift_report(
+                merged, budget_psi=self.drift.budget_psi,
+                top_k=self.drift_top_k)
+        if self.registry.enabled:
+            fam = self.registry.gauge(
+                "dryad_fleet_drift_psi",
+                "Fleet-merged per-feature PSI, top offenders")
+            for model, r in reports.items():
+                for name, key in (("dryad_fleet_drift_psi_max", "psi_max"),
+                                  ("dryad_fleet_drift_score_psi",
+                                   "score_psi"),
+                                  ("dryad_fleet_drift_rows", "rows"),
+                                  ("dryad_fleet_drift_features_over",
+                                   "features_over")):
+                    self.registry.gauge(
+                        name, "Fleet-merged drift telemetry").labels(
+                        model=model).set(float(r.get(key, 0)))
+                for item in r["top"]:
+                    fam.labels(model=model,
+                               feature=item["feature"]).set(item["psi"])
+        return self.drift.evaluate(reports)
 
     def evaluate_slo(self) -> dict:
         """One SLO evaluation pass from the router's OWN per-priority
@@ -289,9 +376,17 @@ class _Handler(BaseHTTPRequestHandler):
             # replica would — latency budgets are part of "healthy"
             slo = state.evaluate_slo()
             ok = fleet_ok and state.slo_health.ok
-            self._send(200 if ok else 503,
-                       {"ok": ok, "replicas": states, "slo": slo,
-                        "degraded": sorted(state.slo_health.reasons())})
+            payload = {"ok": ok, "replicas": states, "slo": slo,
+                       "degraded": sorted(state.slo_health.reasons())}
+            if state.drift is not None:
+                # drift verdicts are WARN-ONLY: the payload surfaces
+                # ``drift:<model>`` (latched on the scrape cadence — no
+                # replica scrape ever runs in the health path) but the
+                # status code stays governed by replicas + SLO
+                payload["drift"] = {
+                    "warnings": state.drift.warnings(),
+                    "models": state.drift.verdicts()}
+            self._send(200 if ok else 503, payload)
             return
         if not self._authorized():
             return
@@ -310,6 +405,8 @@ class _Handler(BaseHTTPRequestHandler):
         elif self.path == "/trace" or self.path.startswith("/trace?"):
             self._send_raw(200, self._merged_trace().encode(),
                            "application/json")
+        elif self.path == "/drift" or self.path.startswith("/drift?"):
+            self._send(200, self._drift_report())
         else:
             self._send(404, {"error": f"unknown path {self.path}"})
 
@@ -325,6 +422,7 @@ class _Handler(BaseHTTPRequestHandler):
                 and s.proc.host is not None]
         results: dict[str, str] = {}
         obs_blocks: dict[str, dict] = {}
+        drift_blocks: dict[str, dict] = {}
 
         def scrape(slot) -> None:
             # ONE ~4 s budget covers BOTH requests to this slot, so the
@@ -357,6 +455,10 @@ class _Handler(BaseHTTPRequestHandler):
                     block = doc.get("histograms", {}).get(REQUEST_LATENCY)
                     if block:
                         obs_blocks[slot.name] = block
+                    # the drift counts ride the same /obs answer (r18)
+                    dblock = doc.get("drift")
+                    if isinstance(dblock, dict) and dblock:
+                        drift_blocks[slot.name] = dblock
             except (OSError, ValueError):
                 pass
 
@@ -369,6 +471,7 @@ class _Handler(BaseHTTPRequestHandler):
         for t in threads:
             t.join(timeout=4.5)
         self._merged_latency_gauges(state, list(obs_blocks.values()))
+        state.update_drift(list(drift_blocks.values()))
         parts = [state.registry.exposition()]
         parts += [results[s.name] for s in live if s.name in results]
         return "".join(parts)
@@ -485,6 +588,51 @@ class _Handler(BaseHTTPRequestHandler):
             except (OSError, ValueError):
                 journal_events = []
         return dumps_fleet_trace(tracks, journal_events, keep)
+
+    def _drift_report(self) -> dict:
+        """``GET /drift``: a fresh concurrent ``/obs`` scrape of the
+        live replicas, the per-model EXACT count-merge, PSI on the
+        merged state, and the gate's sustained-breach verdicts — the
+        operator's one-call answer to "does serving traffic still look
+        like the training data"."""
+        state: _RouterState = self.server.state
+        if state.drift is None:
+            return {"enabled": False}
+        headers = ({"Authorization": f"Bearer {state.auth_token}"}
+                   if state.auth_token else {})
+        live = [s for s in state.supervisor.slots
+                if s.proc is not None and s.proc.alive
+                and s.proc.host is not None]
+        blocks: dict[str, dict] = {}
+
+        def scrape(slot) -> None:
+            try:
+                status, body = slot.proc.request("GET", "/obs",
+                                                 headers=headers,
+                                                 timeout_s=3.0)
+                if status != 200:
+                    return
+                dblock = json.loads(body).get("drift")
+                if isinstance(dblock, dict) and dblock:
+                    blocks[slot.name] = dblock
+            except (OSError, ValueError):
+                pass
+
+        threads = [threading.Thread(target=scrape, args=(s,), daemon=True)
+                   for s in live]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=3.5)
+        verdicts = state.update_drift(list(blocks.values()))
+        return {
+            "enabled": True,
+            "budget_psi": state.drift.budget_psi,
+            "breach_after": state.drift.breach_after,
+            "replicas": sorted(blocks),
+            "models": verdicts,
+            "warnings": state.drift.warnings(),
+        }
 
     # ---- POST --------------------------------------------------------------
     def do_POST(self):  # noqa: N802 — stdlib handler API
@@ -672,13 +820,20 @@ def make_fleet_router(supervisor, host: str = "127.0.0.1", port: int = 0, *,
                       slo_quantile: float = 0.99,
                       slo_breach_after: int = 3,
                       tail_window: int = 512,
-                      tail_keep: int = 16) -> ThreadingHTTPServer:
+                      tail_keep: int = 16,
+                      drift_budget_psi: Optional[float] = None,
+                      drift_breach_after: int = 2,
+                      drift_top_k: int = 5) -> ThreadingHTTPServer:
     """Bind the fleet router (port 0 picks a free one; read it back from
     ``httpd.server_address``); the caller runs ``serve_forever()`` /
     ``shutdown()``, exactly like ``serve.http.make_http_server``.
     ``slo_budgets_ms`` declares per-priority p-quantile budgets
     (obs/slo.py defaults when None); ``tail_window``/``tail_keep`` shape
-    the merged ``/trace`` endpoint's tail sampling."""
+    the merged ``/trace`` endpoint's tail sampling.  ``drift_budget_psi``
+    arms the model-drift layer (r18): replica drift counts are merged
+    exactly on the scrape cadence, ``GET /drift`` reports per-model PSI
+    verdicts, and a sustained breach journals ``drift_breach`` + warns in
+    /healthz payloads (None = drift reporting off)."""
     httpd = ThreadingHTTPServer((host, port), _Handler)
     httpd.daemon_threads = True
     httpd.verbose = verbose
@@ -688,7 +843,9 @@ def make_fleet_router(supervisor, host: str = "127.0.0.1", port: int = 0, *,
         request_timeout_s=request_timeout_s, min_healthy=min_healthy,
         auth_token=auth_token, slo_budgets_ms=slo_budgets_ms,
         slo_quantile=slo_quantile, slo_breach_after=slo_breach_after,
-        tail_window=tail_window, tail_keep=tail_keep)
+        tail_window=tail_window, tail_keep=tail_keep,
+        drift_budget_psi=drift_budget_psi,
+        drift_breach_after=drift_breach_after, drift_top_k=drift_top_k)
     return httpd
 
 

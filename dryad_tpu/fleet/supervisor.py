@@ -197,6 +197,13 @@ class FleetSupervisor:
             if self._journal is not None:
                 self._journal.event(kind, **fields)
 
+    def journal(self, kind: str, /, **fields) -> None:
+        """Public journal passthrough for fleet-level observers that own
+        no journal of their own — the router's drift gate records its
+        ``drift_breach`` verdicts here (r18), so a model-quality incident
+        reads in the same flight recorder as a crash or a swap."""
+        self._event(kind, **fields)
+
     def _gauge_healthy(self, slot: ReplicaSlot) -> None:
         reg = self._reg()
         if reg.enabled:

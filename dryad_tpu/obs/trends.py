@@ -78,6 +78,9 @@ LOWER_BETTER = frozenset({
     # r16 wide-shape histogram-reduction arms (bench.py hist_reduce_probe)
     "hist_reduce_ms_fused", "hist_reduce_ms_feature",
     "supervisor_overhead_ms", "obs_overhead_ms", "obs_overhead_pct",
+    # r18 drift-monitor overhead (scripts/bench_serve.py --drift:
+    # instrumented-vs-disabled serve arms, gate <= 2% like obs_overhead)
+    "drift_overhead_ms", "drift_overhead_pct",
     "p50_ms", "p99_ms",
 })
 
@@ -97,6 +100,8 @@ _SPREAD_FIELDS = {
     "supervisor_overhead_ms": ("supervisor_overhead_spread",),
     "obs_overhead_ms": ("obs_overhead_spread",),
     "obs_overhead_pct": ("obs_overhead_spread",),
+    "drift_overhead_ms": ("drift_overhead_spread",),
+    "drift_overhead_pct": ("drift_overhead_spread",),
     "rows_per_s": ("spread_rows_per_s",),
     "fleet_rows_per_s_n1": ("fleet_spread_n1",),
     "fleet_rows_per_s_n2": ("fleet_spread_n2",),

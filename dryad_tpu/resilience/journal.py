@@ -10,6 +10,13 @@ and the ci.sh supervisor smoke both read it back.
 Event vocabulary (the ``event`` field; producers in supervisor.py):
 ``run_start``, ``segment_start``, ``chunk_dispatch``, ``chunk_fetch``,
 ``fault``, ``backoff_chunks``, ``resume``, ``fail_closed``, ``complete``.
+The fleet supervisor (fleet/supervisor.py) adds the replica lifecycle
+family (``fleet_start``, ``replica_spawn``/``ready``/``crash``/
+``respawn``/``unhealthy``/``hang``/``fail_closed``/``backoff``, the
+``push_*``/``replica_drain``/``replica_swapped`` rolling-push records),
+and r18 adds ``drift_breach`` — the router's drift gate journals a
+SUSTAINED model-drift verdict here (model, psi_max, score_psi, offending
+features), which is the continual-boosting retrain/rollback trigger.
 """
 
 from __future__ import annotations

@@ -46,6 +46,7 @@ def run_bench(model, *, backend: str = "cpu", clients: int = 4,
               pipeline_depth: int = 2, sharded="auto",
               sharded_threshold: Optional[int] = None, arms: int = 1,
               feature_pool: Optional[np.ndarray] = None,
+              drift="auto", drift_window: int = 4096,
               verbose: bool = False) -> dict:
     """Run the closed loop; returns the stats snapshot plus bench fields
     (throughput, per-arm spread, recompiles_after_warmup).  ``model`` is a
@@ -55,7 +56,8 @@ def run_bench(model, *, backend: str = "cpu", clients: int = 4,
                            max_wait_ms=max_wait_ms, queue_size=queue_size,
                            min_bucket=min_bucket,
                            pipeline_depth=pipeline_depth, sharded=sharded,
-                           sharded_threshold=sharded_threshold)
+                           sharded_threshold=sharded_threshold,
+                           drift=drift, drift_window=drift_window)
     server.registry.add(booster)
     rng = np.random.default_rng(seed)
     if feature_pool is None:
@@ -154,6 +156,47 @@ def summary_line(report: dict, label: str = "serve") -> dict:
         "suspect_capture": report["suspect_capture"],
         "pipeline_depth": report["pipeline_depth"],
         "mesh_shards": report["mesh_shards"],
+    }
+
+
+def run_bench_drift(model, *, arms: int = 2, **kw) -> dict:
+    """Drift-monitor overhead A/B (the obs_overhead_ms shape, r18): the
+    SAME closed loop on two otherwise identical servers — drift
+    monitoring on (model carrying a reference profile) vs off — reports
+    ``drift_overhead_ms`` (per request), ``drift_overhead_pct`` (rows/s
+    cost) and ``drift_overhead_spread`` (the max of both arms' per-arm
+    spreads: a noisy capture vetoes the number, never fakes a verdict).
+    The acceptance gate is <= 2% — the monitor is one vectorized
+    bincount per batch, and a model-quality layer that taxes serving
+    more than that would be disabled in anger."""
+    booster = model if isinstance(model, Booster) else Booster.load_any(model)
+    if getattr(booster, "profile", None) is None:
+        # the arm must measure a LIVE monitor: synthesize a baseline over
+        # a pool binned through the model's own mapper
+        from dryad_tpu.data.profile import profile_from_binned
+
+        rng = np.random.default_rng(kw.get("seed", 0))
+        pool = rng.standard_normal(
+            (2048, booster.mapper.num_features)).astype(np.float32)
+        booster.profile = profile_from_binned(
+            booster, booster.mapper.transform(pool))
+    on = run_bench(booster, drift="auto", arms=arms, **kw)
+    off = run_bench(booster, drift=False, arms=arms, **kw)
+    if not on.get("drift"):
+        raise RuntimeError("the instrumented arm never built a drift "
+                           "monitor — the overhead A/B measured nothing")
+    pct = (off["rows_per_s"] / on["rows_per_s"] - 1
+           if on["rows_per_s"] > 0 else 0.0)
+    ms = ((1.0 / on["requests_per_s"] - 1.0 / off["requests_per_s"]) * 1e3
+          if on["requests_per_s"] > 0 and off["requests_per_s"] > 0 else 0.0)
+    return {
+        "drift_overhead_ms": round(ms, 4),
+        "drift_overhead_pct": round(pct, 4),
+        "drift_overhead_spread": round(max(on["spread_rows_per_s"],
+                                           off["spread_rows_per_s"]), 3),
+        "drift_rows_per_s_on": round(on["rows_per_s"], 1),
+        "drift_rows_per_s_off": round(off["rows_per_s"], 1),
+        "drift_windows": {m: d for m, d in on["drift"].items()},
     }
 
 

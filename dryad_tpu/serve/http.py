@@ -17,7 +17,10 @@ Endpoints (JSON in/out):
                                telemetry registry (dryad_tpu/obs)
     GET  /obs                → registry.snapshot() JSON (histogram counts
                                with bounds — the shape the fleet router
-                               merges exactly across replicas, r17)
+                               merges exactly across replicas, r17) plus
+                               a "drift" block of raw window bin counts
+                               per profiled model (r18; same exact-merge
+                               discipline — counts, never ratios)
     GET  /trace              → Chrome trace_event JSON of the local span
                                ring (requires enable_tracing())
     GET  /trace/events       → raw ring events + a clock sample (the
@@ -191,7 +194,15 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_raw(200, self.server.obs_registry.exposition().encode(),
                            "text/plain; version=0.0.4; charset=utf-8")
         elif self.path == "/obs":
-            self._send(200, self.server.obs_registry.snapshot())
+            doc = self.server.obs_registry.snapshot()
+            # r18: the raw drift-window counts ride the same snapshot so
+            # the fleet router's exact count-merge covers model-quality
+            # telemetry too (absent when no model carries a profile or
+            # drift is off — older routers simply never read the key)
+            drift = server.drift_state()
+            if drift:
+                doc["drift"] = drift
+            self._send(200, doc)
         elif self.path == "/trace":
             from dryad_tpu.obs import trace_export
 

@@ -41,6 +41,9 @@ from dryad_tpu.serve.metrics import ServeMetrics
 from dryad_tpu.serve.registry import ModelRegistry
 
 
+_DRIFT_UNSET = object()      # "not probed yet" marker in the monitor table
+
+
 def _resolve_backend(backend: str) -> str:
     """'auto'|'tpu'|'cpu' → 'jax' (device predict) or 'cpu' (numpy).
 
@@ -66,19 +69,23 @@ def _resolve_backend(backend: str) -> str:
 
 
 class _PreparedGroup:
-    """One model-version group of a prepared batch (see _prepare)."""
+    """One model-version group of a prepared batch (see _prepare).
+    ``drift`` is the version's DriftMonitor (or None): _prepare observed
+    the binned features into it and _execute observes the raw scores —
+    the handoff queue's happens-before makes the plain field safe."""
 
     __slots__ = ("idxs", "entry", "prepared", "row_counts", "raw_flags",
-                 "error")
+                 "error", "drift")
 
     def __init__(self, idxs, entry=None, prepared=None, row_counts=None,
-                 raw_flags=None, error=None):
+                 raw_flags=None, error=None, drift=None):
         self.idxs = idxs
         self.entry = entry
         self.prepared = prepared
         self.row_counts = row_counts
         self.raw_flags = raw_flags
         self.error = error
+        self.drift = drift
 
 
 class PredictServer:
@@ -88,9 +95,21 @@ class PredictServer:
                  min_bucket: int = 8, latency_window: int = 4096,
                  pipeline_depth: int = 2, sharded="auto",
                  sharded_threshold: Optional[int] = None,
-                 device_budget_bytes: Optional[int] = None):
+                 device_budget_bytes: Optional[int] = None,
+                 drift="auto", drift_window: int = 8192):
         self.backend = _resolve_backend(backend)
         self.metrics = ServeMetrics(latency_window=latency_window)
+        # drift monitors (obs/drift.py) are per model version, created
+        # lazily at first dispatch for versions whose artifact carries a
+        # reference profile.  The zero-cost contract: with the obs
+        # registry disabled at construction (DRYAD_OBS=0) — or with
+        # drift off — the table stays None and the request path never
+        # allocates drift state (one attr check per batch, pinned by
+        # tracemalloc in tests/test_drift.py).
+        self.drift_window = int(drift_window)
+        drift_on = (drift not in (False, 0, "off", "none")
+                    and self.drift_window > 0 and self.metrics.obs_enabled)
+        self._drift_monitors: Optional[dict] = {} if drift_on else None
         if registry is not None:
             self.registry = registry
             # a caller-supplied registry still honors this server's budget
@@ -296,10 +315,19 @@ class PredictServer:
                     X = np.concatenate([batch[i].rows for i in idxs], axis=0)
                 if not binned:
                     X = entry.booster.mapper.transform(X)
+                # drift accounting on the already-binned batch: the
+                # monitor counts the SAME bin ids the compiled predict is
+                # about to consume, so covariate drift is measured in the
+                # model's own split space (zero extra binning work)
+                mon = None
+                if self._drift_monitors is not None:
+                    mon = self._drift_monitor(entry)
+                    if mon is not None:
+                        mon.observe_features(X)
                 out.append(_PreparedGroup(
                     idxs, entry, self.cache.prepare_raw(entry, X),
                     [batch[i].rows.shape[0] for i in idxs],
-                    [batch[i].raw_score for i in idxs]))
+                    [batch[i].raw_score for i in idxs], drift=mon))
             except Exception as e:  # noqa: BLE001 — fail only this group
                 out.append(_PreparedGroup(idxs, error=e))
         return out
@@ -317,6 +345,12 @@ class PredictServer:
                 continue
             try:
                 raw = self.cache.execute_raw(g.prepared)
+                if g.drift is not None:
+                    # score-shift accounting on the raw margins the one
+                    # real host fetch just delivered (pre-link: the raw
+                    # score space is objective-invariant and matches the
+                    # profile's train/valid histograms)
+                    g.drift.observe_scores(raw)
                 offset = 0
                 for i, rows, raw_flag in zip(g.idxs, g.row_counts,
                                              g.raw_flags):
@@ -332,6 +366,61 @@ class PredictServer:
         """Serial-mode dispatch: the pipeline stages composed in-line."""
         return self._execute(self._prepare(batch))
 
+    # ---- drift monitors (obs/drift.py) -------------------------------------
+    def _drift_monitor(self, entry):
+        """The version's monitor, created on first dispatch when the
+        model carries a reference profile (None cached otherwise, so a
+        profile-less model costs one dict probe per batch).  Runs on the
+        collector thread only; _execute reads the group's stashed handle
+        after the handoff (happens-before via the pipeline queue)."""
+        table = self._drift_monitors
+        mon = table.get(entry.version, _DRIFT_UNSET)
+        if mon is _DRIFT_UNSET:
+            profile = getattr(entry.booster, "profile", None)
+            if profile is None:
+                mon = None
+            else:
+                from dryad_tpu.obs.drift import DriftMonitor
+
+                # the model label prefers the registry alias (operators
+                # name models, not versions); the version pins it apart
+                # from a re-push under the same name
+                names = [n for n, v in self.registry.aliases().items()
+                         if v == entry.version]
+                label = names[0] if names else f"v{entry.version}"
+                mon = DriftMonitor(
+                    profile.feature_counts,
+                    ref_score_state=profile.score_hist.get("train"),
+                    model=label, window_rows=self.drift_window,
+                    registry=self.metrics.obs_registry)
+            table[entry.version] = mon
+        return mon
+
+    def drift_state(self) -> dict:
+        """Raw drift blocks by model label — the replica's ``/obs``
+        section the fleet router count-merges exactly."""
+        if not self._drift_monitors:
+            return {}
+        out = {}
+        # snapshot the table in one C-level copy: the collector thread
+        # inserts new versions' monitors concurrently, and iterating the
+        # live view would raise "dict changed size during iteration"
+        # under a mid-deploy scrape
+        for mon in list(self._drift_monitors.values()):
+            if mon is not None:
+                block = mon.export_state()
+                out[block["model"]] = block
+        return out
+
+    def drift_report(self, budget_psi: Optional[float] = None) -> dict:
+        """Local PSI verdicts by model label (also refreshes the
+        ``dryad_drift_*`` gauges)."""
+        if not self._drift_monitors:
+            return {}
+        return {mon.model: mon.snapshot(budget_psi)
+                for mon in list(self._drift_monitors.values())
+                if mon is not None}
+
     # ---- observability -----------------------------------------------------
     def stats(self) -> dict:
         snap = self.metrics.snapshot()
@@ -345,4 +434,10 @@ class PredictServer:
         snap["mesh_shards"] = self.cache.n_shards
         snap["sharded_threshold"] = self.cache.sharded_threshold
         snap["memory"] = self.registry.memory()
+        drift = self.drift_report()
+        if drift:
+            snap["drift"] = {
+                model: {"rows": r["rows"], "psi_max": r["psi_max"],
+                        "score_psi": r["score_psi"]}
+                for model, r in drift.items()}
         return snap

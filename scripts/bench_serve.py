@@ -19,6 +19,11 @@ Arms:
   --smoke     short CI mode: tiny model, short loops, exit 1 unless BOTH
               the bucketed and sharded arms report zero recompiles after
               warmup (scripts/ci.sh runs this)
+  --drift     drift-monitor overhead A/B (r18): the same closed loop with
+              the model-drift monitor on vs off —
+              ``drift_overhead_ms/_pct/_spread`` (obs/trends.py tracks
+              them); exit 1 when the cost exceeds 2% and the spread does
+              not veto the capture
   --fleet     closed-loop fleet arm (r14, dryad_tpu/fleet/bench.py): REAL
               subprocess replicas behind the router at N=1/2/4
               (``fleet_rows_per_s_nN`` + spreads + ``fleet_scaling_nN``)
@@ -151,6 +156,10 @@ def main(argv=None) -> int:
     ap.add_argument("--smoke", action="store_true",
                     help="short CI mode: bucketed + sharded arms, exit 1 "
                          "on any recompile after warmup")
+    ap.add_argument("--drift", action="store_true",
+                    help="drift-monitor overhead A/B (instrumented vs "
+                         "disabled; drift_overhead_ms/_pct/_spread, exit 1 "
+                         "over the 2% budget unless the spread vetoes)")
     ap.add_argument("--fleet", action="store_true",
                     help="closed-loop fleet arm: real subprocess replicas "
                          "at N=1/2/4 + a rolling-swap drill (standalone; "
@@ -208,6 +217,19 @@ def main(argv=None) -> int:
                            pipeline_depth=args.pipeline_depth, **kw)
         summary = summary_line(report, "serve")
 
+    if args.drift:
+        # r18 drift-monitor overhead A/B (instrumented vs disabled, the
+        # obs_overhead_ms shape); obs/trends.py tracks the fields with
+        # the spread veto, and the <= 2% gate fails the run below
+        from dryad_tpu.serve.bench import run_bench_drift
+
+        drift = run_bench_drift(model, backend=args.backend,
+                                pipeline_depth=args.pipeline_depth, **kw)
+        drift.pop("drift_windows", None)
+        report["drift_overhead"] = drift
+        summary.update({k: v for k, v in drift.items()
+                        if k.startswith("drift_overhead")})
+
     if args.sharded:
         # forced-sharded arm: every bucket takes the shard_map family
         sharded_report = run_bench(model, backend="tpu", sharded=True,
@@ -257,6 +279,17 @@ def main(argv=None) -> int:
     if recompiles != 0:
         print("WARNING: cache recompiled after warmup", file=sys.stderr)
         return 1
+    # drift-overhead gate (<= 2%), with the standard spread veto: a
+    # noisy capture is "suspect", never a verdict (CLAUDE.md)
+    pct = summary.get("drift_overhead_pct")
+    if pct is not None and pct > 0.02:
+        if summary.get("drift_overhead_spread", 0.0) > 0.05:
+            print("WARNING: drift overhead gate skipped — per-arm spread "
+                  "> 5% (suspect capture)", file=sys.stderr)
+        else:
+            print(f"ERROR: drift monitoring costs {pct:.1%} rows/s — over "
+                  "the 2% budget", file=sys.stderr)
+            return 1
     return 0
 
 

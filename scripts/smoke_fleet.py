@@ -31,6 +31,17 @@ r17 (request tracing) — the smoke additionally asserts:
 * the aggregated router ``/metrics`` reports merged per-priority fleet
   p99 gauges (``dryad_fleet_latency_ms{q="p99",...}``).
 
+r18 (drift telemetry) — the end-to-end model-quality drill:
+
+* the trained model carries its reference profile; baseline traffic
+  drawn from the TRAINING rows keeps every fleet drift verdict green
+  (no false positive),
+* a 3x covariate-shift burst flips the merged ``GET /drift`` verdict
+  within one window; a second evaluation makes it SUSTAINED: the
+  journal records ``drift_breach``, ``/healthz`` stays 200 (warn-only —
+  a drifted model still serves) with ``drift:<model>`` in its payload,
+  and the aggregated /metrics carries ``dryad_fleet_drift_*`` gauges.
+
 Prints one JSON summary line on success, exits 1 with a reason otherwise.
 """
 
@@ -63,9 +74,14 @@ def fail(reason: str) -> int:
 
 
 def main() -> int:
+    # the r18 drift phase needs the model's embedded reference profile
+    # (the production default; ON regardless of the caller's env)
+    os.environ["DRYAD_PROFILE"] = "1"
     X, y = higgs_like(1200, seed=17)
     ds = dryad.Dataset(X, y, max_bins=32)
     booster = dryad.train(PARAMS, ds, backend="cpu")
+    if booster.profile is None:
+        return fail("dryad.train attached no reference profile")
     num_features = X.shape[1]
 
     with tempfile.TemporaryDirectory(prefix="dryad-fleet-smoke-") as td:
@@ -77,7 +93,8 @@ def main() -> int:
 
         def make_argv(index: int, port_file: str) -> list:
             return serve_argv([model_path], port_file, backend="cpu",
-                              max_batch_rows=64, max_wait_ms=0.5)
+                              max_batch_rows=64, max_wait_ms=0.5,
+                              drift_window=1024)
 
         crash_spec = F.encode_points(
             [F.FaultPoint(site="request", iteration=2,
@@ -89,7 +106,9 @@ def main() -> int:
             probe_interval_s=0.1, startup_timeout_s=180.0,
             fault_env={0: crash_spec})
         sup.start()
-        router = FleetRouter(sup, registry=reg, max_inflight=16).start()
+        router = FleetRouter(sup, registry=reg, max_inflight=16,
+                             drift_budget_psi=0.25,
+                             drift_breach_after=2).start()
         try:
             # closed interactive loop through the router while the crash
             # drill fires on replica 0's second /predict
@@ -119,6 +138,40 @@ def main() -> int:
             trace_doc = json.loads(resp.read())
             conn.request("GET", "/metrics")
             metrics_text = conn.getresponse().read().decode()
+
+            # ---- r18 drift phase -------------------------------------------
+            # Baseline traffic drawn from the TRAINING rows (the traffic
+            # the profile describes): the fleet verdict must stay green
+            # — the no-false-positive half of the acceptance drill.
+            def slice_payloads(scale: float) -> dict:
+                out = {}
+                for n, start in ((37, 0), (83, 100), (129, 300), (211, 500)):
+                    rows = (X[start:start + n] * scale).tolist()
+                    out[n] = json.dumps({"rows": rows}).encode()
+                return out
+
+            _closed_loop(router.host, router.port, slice_payloads(1.0),
+                         clients=3, duration_s=2.5, seed=5)
+            conn.request("GET", "/drift")
+            drift_clean = json.loads(conn.getresponse().read())
+            # Covariate-shift burst: the same rows scaled 3x bin into
+            # the tails of every feature's sketch — within one window
+            # the merged fleet verdict must flip, and a second
+            # evaluation makes the breach SUSTAINED (breach_after=2:
+            # journal + /healthz warning).
+            shifted = _closed_loop(router.host, router.port,
+                                   slice_payloads(3.0), clients=3,
+                                   duration_s=2.5, seed=6)
+            conn.request("GET", "/drift")
+            json.loads(conn.getresponse().read())     # evaluation 1
+            conn.request("GET", "/drift")
+            drift_doc = json.loads(conn.getresponse().read())
+            conn.request("GET", "/healthz")
+            health_resp = conn.getresponse()
+            health_code = health_resp.status
+            health_doc = json.loads(health_resp.read())
+            conn.request("GET", "/metrics")
+            drift_metrics = conn.getresponse().read().decode()
             conn.close()
         finally:
             router.stop()
@@ -181,6 +234,45 @@ def main() -> int:
         return fail("router /metrics lacks the merged per-priority p99 "
                     "gauges (dryad_fleet_latency_ms)")
 
+    # ---- r18 drift assertions ---------------------------------------------
+    if shifted["failures"]:
+        return fail(f"{shifted['failures']} failed request(s) during the "
+                    "covariate-shift burst — a drifted model must still "
+                    "serve")
+    clean_models = drift_clean.get("models") or {}
+    if not (drift_clean.get("enabled") and clean_models):
+        return fail(f"GET /drift reported no models under baseline "
+                    f"traffic: {drift_clean}")
+    false_pos = {m: v for m, v in clean_models.items() if v.get("breached")}
+    if false_pos:
+        return fail(f"drift verdict breached on training-distribution "
+                    f"traffic (false positive): {false_pos}")
+    drifted = {m: v for m, v in (drift_doc.get("models") or {}).items()
+               if v.get("sustained")}
+    if not drifted:
+        return fail(f"the 3x covariate-shift burst never flipped the "
+                    f"fleet verdict to sustained: {drift_doc}")
+    model, verdict = next(iter(drifted.items()))
+    if not verdict.get("top"):
+        return fail(f"breached verdict names no offending features: "
+                    f"{verdict}")
+    if f"drift:{model}" not in (drift_doc.get("warnings") or []):
+        return fail(f"/drift warnings lack drift:{model}: {drift_doc}")
+    if health_code != 200:
+        return fail(f"/healthz went {health_code} on a drift breach — "
+                    "drift is warn-only, a drifted model still serves")
+    if f"drift:{model}" not in (health_doc.get("drift", {})
+                                .get("warnings") or []):
+        return fail(f"/healthz payload lacks the drift:{model} warning: "
+                    f"{health_doc.get('drift')}")
+    if "dryad_fleet_drift_psi_max{" not in drift_metrics:
+        return fail("router /metrics lacks the merged "
+                    "dryad_fleet_drift_* gauges")
+    breaches = [e for e in events if e["event"] == "drift_breach"]
+    if not (breaches and breaches[0].get("model") == model):
+        return fail(f"no drift_breach journal event for {model}: "
+                    f"{breaches}")
+
     print(json.dumps({
         "fleet_smoke": "ok",
         "requests": loop["requests"] + tail["requests"],
@@ -192,6 +284,11 @@ def main() -> int:
         "respawns": len(respawns),
         "router_retries": retries,
         "journal_events": len(events),
+        "drift_model": model,
+        "drift_psi_max": verdict.get("psi_max"),
+        "drift_clean_psi_max": max(v.get("psi_max", 0.0)
+                                   for v in clean_models.values()),
+        "drift_breaches_journaled": len(breaches),
     }))
     return 0
 

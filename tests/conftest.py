@@ -19,6 +19,12 @@ if "xla_force_host_platform_device_count" not in _flags:
 # back in per test (monkeypatch.setenv("DRYAD_PROG", "1")).  Production
 # default stays ON (bench/smokes/CLI), where captures amortize over runs.
 os.environ.setdefault("DRYAD_PROG", "0")
+# The r18 train-completion reference-profile capture (data/profile.py) is
+# likewise pinned OFF for the suite: hundreds of tiny trains would each
+# pay a subsample + CPU predict for a baseline no test reads.  Drift/
+# profile tests opt back in per test (monkeypatch.setenv) or call
+# build_reference_profile directly; production default stays ON.
+os.environ.setdefault("DRYAD_PROFILE", "0")
 
 import jax  # noqa: E402
 

@@ -16,7 +16,10 @@ JSON whose ``dryad_request_latency_seconds`` counts ride the FIXED
 62-slot log-bucket layout (obs/registry.LOG_BUCKETS has 61 bounds — a
 count array of any other length is SKIPPED by the router's merge, so a
 mismatched stub silently contributes nothing), so router merge tests
-run against the wire shape without a jax import.
+run against the wire shape without a jax import.  r18: ``/obs`` also
+carries a drift block (DriftMonitor.export_state shape) — balanced
+counts by default, skewed under ``--drift-shift`` — for the router's
+exact drift merge + ``/drift`` verdict tests.
 
 Deterministic failure shapes, flag-armed:
 
@@ -104,10 +107,23 @@ class _Handler(BaseHTTPRequestHandler):
             n = self.server.requests
             counts[25] = n                     # ~31.6 ms bucket
             lbl = 'priority="interactive",stage="total"'
-            self._send(200, {"histograms": {
+            doc = {"histograms": {
                 "dryad_request_latency_seconds": {
                     lbl: {"counts": counts, "sum": 0.0316 * n,
-                          "count": n, "log": True}}}})
+                          "count": n, "log": True}}}}
+            # r18 drift block (the serve DriftMonitor.export_state
+            # shape): balanced window counts by default — PSI ~0 — or a
+            # skewed window under --drift-shift, so router merge/verdict
+            # tests run against the wire shape without a jax import
+            window = ([[0, 0, 16, 16], [0, 0, 16, 16]]
+                      if cfg.drift_shift else
+                      [[8, 8, 8, 8], [8, 8, 8, 8]])
+            doc["drift"] = {"stub": {
+                "model": "stub", "rows": 32, "window_rows": 64,
+                "bins": [4, 4], "features": window,
+                "ref_features": [[8, 8, 8, 8], [8, 8, 8, 8]],
+                "score": None, "ref_score": None}}
+            self._send(200, doc)
         elif self.path == "/boom" and cfg.crash_on_path:
             os._exit(23)
         else:
@@ -169,6 +185,7 @@ def main() -> int:
     ap.add_argument("--health-503-after", type=int, default=-1)
     ap.add_argument("--auth-token", default=None)
     ap.add_argument("--fail-start", action="store_true")
+    ap.add_argument("--drift-shift", action="store_true")
     cfg = ap.parse_args()
     if cfg.fail_start:
         return 7

@@ -480,6 +480,30 @@ def test_harness_detects_torn_lock_free_snapshot(monkeypatch):
         "a lock-free snapshot reader never produced a torn histogram"
 
 
+def test_harness_detects_torn_drift_export(monkeypatch):
+    """r18: a drift /obs export that reads the rotating window WITHOUT
+    the monitor lock tears against concurrent observes/rotation — the
+    drift-window-tear drill's counts-vs-rows invariant must catch it."""
+    import contextlib
+
+    from dryad_tpu.obs import drift as dmod
+
+    real = dmod.DriftMonitor.export_state
+    null = contextlib.nullcontext()
+
+    def lockfree_export(self):
+        lock, self._lock = self._lock, null
+        try:
+            return real(self)
+        finally:
+            self._lock = lock
+
+    monkeypatch.setattr(dmod.DriftMonitor, "export_state", lockfree_export)
+    seed = _first_failing_seed("drift-window-tear", 60)
+    assert seed is not None, \
+        "a lock-free drift export never produced a torn window block"
+
+
 def test_harness_detects_nonatomic_injector_fire(monkeypatch):
     from dryad_tpu.resilience import faults as fmod
 

@@ -1,0 +1,592 @@
+"""Model-quality drift telemetry (obs/drift.py + data/profile.py, r18).
+
+Pinned here (the ISSUE's satellites):
+
+* reference-profile round-trip: text save -> ``load_any`` -> bitwise-
+  equal profile; binary likewise; profile-less (pre-r18) files still
+  load; ``dryad.train`` attaches a profile unless DRYAD_PROFILE=0;
+* PSI exact-merge property: the fleet verdict on counts merged across
+  1/2/4 monitors equals the verdict on the concatenated observations
+  BITWISE (merge counts, never ratios);
+* the serve path: monitors ride the batcher's binned ``_prepare``
+  output + the executed raw scores, shifted traffic breaches, training-
+  distribution traffic does not, and the two-epoch window forgets;
+* zero-cost disabled: with the obs registry off the request path
+  allocates NO drift state (tracemalloc, the r17 RequestTrace contract);
+* the router: exact merge across stub replicas, ``dryad_fleet_drift_*``
+  gauges, ``GET /drift`` verdicts, warn-only /healthz, journaled
+  ``drift_breach``;
+* DriftGate semantics: sustained breach, empty-window hold, recovery,
+  on_breach fired once per transition.
+
+Everything runs forced-CPU and jax-free below the profile build.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import sys
+import time
+import tracemalloc
+
+import numpy as np
+import pytest
+
+import dryad_tpu as dryad
+from dryad_tpu.data.profile import (ReferenceProfile,
+                                    build_reference_profile,
+                                    profile_from_binned)
+from dryad_tpu.datasets import higgs_like
+from dryad_tpu.obs.drift import (DEFAULT_PSI_BUDGET, SCORE_BUCKETS,
+                                 DriftGate, DriftMonitor, drift_report,
+                                 merge_drift_states, parse_psi_budget, psi,
+                                 score_bucket_index)
+from dryad_tpu.obs.registry import Registry, set_default_registry
+from dryad_tpu.serve import PredictServer
+
+DISABLED = Registry(enabled=False)
+
+
+@pytest.fixture(scope="module")
+def model():
+    X, y = higgs_like(900, seed=7)
+    ds = dryad.Dataset(X, y, max_bins=32)
+    booster = dryad.train(dict(objective="binary", num_trees=8, num_leaves=7,
+                               max_bins=32, seed=3), ds, backend="cpu")
+    booster.profile = build_reference_profile(booster, ds)
+    return booster, X, ds
+
+
+def _shift_binned(Xb: np.ndarray, by: int = 8) -> np.ndarray:
+    top = np.iinfo(Xb.dtype).max if Xb.dtype.kind == "u" else 255
+    return np.minimum(Xb.astype(np.int64) + by,
+                      min(top, 31)).astype(Xb.dtype)
+
+
+# ---------------------------------------------------------------------------
+# reference profile: build + round-trips + back-compat
+
+
+def test_profile_shape_and_missing_rate(model):
+    booster, X, ds = model
+    p = booster.profile
+    assert p.num_features == X.shape[1]
+    assert p.n_rows == X.shape[0]
+    # counts cover every row, per feature
+    for c in p.feature_counts:
+        assert sum(c) == p.n_rows
+    assert p.missing_rate() == [c[0] / p.n_rows for c in p.feature_counts]
+    assert "train" in p.score_hist
+    counts, total, n = p.score_hist["train"]
+    assert n == p.n_rows and sum(counts) == n
+
+
+def test_profile_valid_split_and_subsample(model):
+    booster, X, ds = model
+    Xv, yv = higgs_like(300, seed=8)
+    vds = dryad.Dataset(Xv, yv, mapper=ds.mapper)
+    p = build_reference_profile(booster, ds, [vds])
+    assert sorted(p.score_hist) == ["train", "valid"]
+    assert p.score_hist["valid"][2] == 300
+    # the stride subsample caps the profile deterministically
+    p_small = build_reference_profile(booster, ds, max_rows=100)
+    assert p_small.n_rows <= 100
+    p_small2 = build_reference_profile(booster, ds, max_rows=100)
+    assert p_small == p_small2
+
+
+def test_profile_text_roundtrip_bitwise(model, tmp_path):
+    booster, _X, _ds = model
+    path = str(tmp_path / "m.txt")
+    booster.save_text(path)
+    again = dryad.Booster.load_any(path)
+    assert again.profile is not None
+    assert again.profile == booster.profile
+    # and the re-dump is byte-identical (floats round-trip exactly)
+    assert again.dump_text() == booster.dump_text()
+
+
+def test_profile_binary_roundtrip_bitwise(model, tmp_path):
+    booster, _X, _ds = model
+    path = str(tmp_path / "m.dryad")
+    booster.save(path)
+    again = dryad.Booster.load_any(path)
+    assert again.profile == booster.profile
+
+
+def test_profileless_models_still_load(model, tmp_path):
+    """Back-compat pin: pre-r18 artifacts carry no profile section and
+    must keep loading (profile None), in BOTH formats."""
+    booster, _X, _ds = model
+    saved = booster.profile
+    try:
+        booster.profile = None
+        bin_path = str(tmp_path / "old.dryad")
+        txt_path = str(tmp_path / "old.txt")
+        booster.save(bin_path)
+        booster.save_text(txt_path)
+    finally:
+        booster.profile = saved
+    assert dryad.Booster.load_any(bin_path).profile is None
+    old = dryad.Booster.load_any(txt_path)
+    assert old.profile is None
+    assert "profile" not in json.loads(old.dump_text())
+    # predictions unaffected by the missing section
+    Xb = _ds_head(model)
+    np.testing.assert_array_equal(
+        old.predict_binned(Xb, raw_score=True),
+        booster.predict_binned(Xb, raw_score=True))
+
+
+def _ds_head(model, n: int = 64) -> np.ndarray:
+    return model[2].X_binned[:n]
+
+
+def test_train_attaches_profile_env_gated(monkeypatch):
+    X, y = higgs_like(200, seed=11)
+    params = dict(objective="binary", num_trees=2, num_leaves=4, max_bins=16)
+    monkeypatch.setenv("DRYAD_PROFILE", "1")
+    b_on = dryad.train(params, dryad.Dataset(X, y, max_bins=16),
+                       backend="cpu")
+    assert isinstance(b_on.profile, ReferenceProfile)
+    assert b_on.profile.n_rows == 200
+    monkeypatch.setenv("DRYAD_PROFILE", "0")
+    b_off = dryad.train(params, dryad.Dataset(X, y, max_bins=16),
+                        backend="cpu")
+    assert b_off.profile is None
+
+
+# ---------------------------------------------------------------------------
+# PSI + score buckets
+
+
+def test_score_bucket_index_le_semantics():
+    for i, b in enumerate(SCORE_BUCKETS):
+        assert score_bucket_index(b) == i                 # on the bound
+    assert score_bucket_index(SCORE_BUCKETS[0] - 1.0) == 0
+    assert score_bucket_index(SCORE_BUCKETS[-1] * 2) == len(SCORE_BUCKETS)
+    assert score_bucket_index(float("nan")) == len(SCORE_BUCKETS)
+    assert score_bucket_index(0.0) == len(SCORE_BUCKETS) // 2
+
+
+def test_psi_properties():
+    assert psi([10, 10, 10], [10, 10, 10]) == 0.0
+    assert psi([10, 10, 10], [1, 1, 28]) > 0.5
+    assert psi([0, 0, 0], [1, 2, 3]) == 0.0               # no evidence
+    # symmetric-ish in magnitude, always finite with empty bins
+    assert np.isfinite(psi([30, 0, 0], [0, 0, 30]))
+    with pytest.raises(ValueError):
+        psi([1, 2], [1, 2, 3])
+    assert parse_psi_budget("") == DEFAULT_PSI_BUDGET
+    assert parse_psi_budget("off") is None
+    assert parse_psi_budget("0.35") == 0.35
+
+
+def test_exact_merge_property_1_2_4_replicas(model):
+    """The fleet invariant: counts merged across k monitors equal one
+    monitor fed the concatenation — bitwise, for k in {1, 2, 4} — and
+    PSI on the merge equals PSI on the concatenation exactly."""
+    booster, _X, ds = model
+    p = booster.profile
+    Xb = ds.X_binned
+    batches = [Xb[i * 60:(i + 1) * 60] for i in range(8)]
+    scores = [booster.predict_binned(b, raw_score=True) for b in batches]
+
+    def fed(k: int):
+        mons = [DriftMonitor(p.feature_counts,
+                             ref_score_state=p.score_hist["train"],
+                             model="m", window_rows=10 ** 6,
+                             registry=DISABLED) for _ in range(k)]
+        for i, (b, s) in enumerate(zip(batches, scores)):
+            mons[i % k].observe_features(b)
+            mons[i % k].observe_scores(s)
+        return merge_drift_states([m.export_state() for m in mons])
+
+    want = fed(1)
+    for k in (2, 4):
+        got = fed(k)
+        assert got["features"] == want["features"]
+        assert got["rows"] == want["rows"]
+        assert got["score"][0] == want["score"][0]
+        assert got["score"][2] == want["score"][2]
+        ra = drift_report(got, budget_psi=0.2)
+        rb = drift_report(want, budget_psi=0.2)
+        assert ra["psi_max"] == rb["psi_max"]          # bitwise floats
+        assert ra["score_psi"] == rb["score_psi"]
+        assert ra["top"] == rb["top"]
+    with pytest.raises(ValueError):
+        merge_drift_states([want, {"model": "m", "rows": 1, "bins": [2],
+                                   "features": [[1, 0]]}])
+
+
+def test_monitor_breach_and_no_false_positive(model):
+    booster, _X, ds = model
+    p = booster.profile
+    Xb = ds.X_binned
+
+    def mon():
+        return DriftMonitor(p.feature_counts,
+                            ref_score_state=p.score_hist["train"],
+                            model="m", window_rows=1024, registry=DISABLED)
+
+    clean = mon()
+    clean.observe_features(Xb[:500])
+    clean.observe_scores(booster.predict_binned(Xb[:500], raw_score=True))
+    r = clean.snapshot(DEFAULT_PSI_BUDGET)
+    assert r["rows"] == 500 and not r["breached"]
+
+    shifted = mon()
+    sb = _shift_binned(Xb[:500])
+    shifted.observe_features(sb)
+    shifted.observe_scores(booster.predict_binned(sb, raw_score=True))
+    r2 = shifted.snapshot(DEFAULT_PSI_BUDGET)
+    assert r2["breached"] and r2["psi_max"] > DEFAULT_PSI_BUDGET
+    assert r2["top"] and r2["features_over"] >= 1
+
+
+def test_window_rotation_forgets_old_traffic(model):
+    """The two-epoch recency contract: a shift burst followed by >= one
+    full window of clean traffic drops back under budget."""
+    booster, _X, ds = model
+    p = booster.profile
+    Xb = ds.X_binned
+    m = DriftMonitor(p.feature_counts, model="m", window_rows=800,
+                     registry=DISABLED)
+    m.observe_features(_shift_binned(Xb[:400]))
+    assert drift_report(m.export_state(),
+                        budget_psi=DEFAULT_PSI_BUDGET)["breached"]
+    for start in range(0, 800, 400):        # two full epochs of clean rows
+        m.observe_features(Xb[start:start + 400])
+    r = drift_report(m.export_state(), budget_psi=DEFAULT_PSI_BUDGET)
+    assert not r["breached"], r
+
+
+def test_monitor_ignores_malformed_batches(model):
+    booster, _X, ds = model
+    p = booster.profile
+    m = DriftMonitor(p.feature_counts, model="m", registry=DISABLED)
+    m.observe_features(np.zeros((0, p.num_features), np.uint8))
+    m.observe_features(np.zeros((4, p.num_features + 3), np.uint8))
+    m.observe_scores(np.zeros((0,), np.float32))
+    assert m.export_state()["rows"] == 0
+    # out-of-range bin ids clip into the last bin instead of corrupting
+    # the flat layout
+    wild = np.full((3, p.num_features), 255, np.uint8)
+    m.observe_features(wild)
+    st = m.export_state()
+    assert st["rows"] == 3
+    for f, c in enumerate(st["features"]):
+        assert c[-1] == 3 and sum(c) == 3
+    # ...and NEGATIVE ids (the signed direct API) floor into bin 0
+    # instead of bleeding into the previous feature's flat range
+    m.observe_features(np.full((2, p.num_features), -1, np.int32))
+    st = m.export_state()
+    assert st["rows"] == 5
+    for c in st["features"]:
+        assert c[0] == 2 and sum(c) == 5
+
+
+def test_monitor_gauges(model):
+    booster, _X, ds = model
+    p = booster.profile
+    reg = Registry()
+    m = DriftMonitor(p.feature_counts, model="m1", window_rows=256,
+                     registry=reg)
+    m.observe_features(_shift_binned(ds.X_binned[:100]))
+    r = m.snapshot(DEFAULT_PSI_BUDGET)
+    snap = reg.snapshot()["gauges"]
+    assert snap["dryad_drift_psi_max"]['model="m1"'] == r["psi_max"]
+    assert snap["dryad_drift_rows"]['model="m1"'] == 100
+    assert any(k.startswith('feature=')
+               for k in snap["dryad_drift_psi"])
+
+
+# ---------------------------------------------------------------------------
+# DriftGate verdicts
+
+
+def test_gate_sustained_breach_hold_and_recovery():
+    breaches: list = []
+    gate = DriftGate(0.2, breach_after=2, registry=DISABLED,
+                     on_breach=lambda m, v: breaches.append((m, v)))
+    bad = {"m": {"rows": 100, "psi_max": 1.5, "score_psi": 0.0, "top": []}}
+    good = {"m": {"rows": 100, "psi_max": 0.01, "score_psi": 0.0, "top": []}}
+    empty = {"m": {"rows": 0, "psi_max": 0.0, "score_psi": 0.0, "top": []}}
+    v1 = gate.evaluate(bad)
+    assert v1["m"]["breached"] and not v1["m"]["sustained"]
+    assert gate.ok and not breaches and gate.warnings() == []
+    v2 = gate.evaluate(bad)
+    assert v2["m"]["sustained"] and not gate.ok
+    assert breaches == [("m", v2["m"])]            # fired exactly once
+    assert gate.warnings() == ["drift:m"]
+    # an empty window is no evidence: warning holds, no re-fire
+    v3 = gate.evaluate(empty)
+    assert v3["m"]["sustained"] and gate.warnings() == ["drift:m"]
+    assert len(breaches) == 1
+    # recovery needs a non-empty in-budget window
+    v4 = gate.evaluate(good)
+    assert not v4["m"]["sustained"] and gate.ok and gate.warnings() == []
+    # a NEW sustained breach fires on_breach again (a fresh incident)
+    gate.evaluate(bad)
+    gate.evaluate(bad)
+    assert len(breaches) == 2
+    assert gate.verdicts()["m"]["sustained"]
+
+
+def test_gate_score_psi_alone_breaches():
+    gate = DriftGate(0.2, breach_after=1, registry=DISABLED)
+    v = gate.evaluate({"m": {"rows": 10, "psi_max": 0.0, "score_psi": 0.9,
+                             "top": []}})
+    assert v["m"]["sustained"]
+
+
+# ---------------------------------------------------------------------------
+# the serve path
+
+
+def test_serve_path_monitors_and_report(model):
+    booster, X, ds = model
+    reg = Registry()
+    old = set_default_registry(reg)
+    try:
+        server = PredictServer(backend="cpu", max_batch_rows=512,
+                               max_wait_ms=0.5, drift_window=2048)
+        server.registry.add(booster)
+        with server:
+            server.predict(X[:300])                       # raw path (binned
+            server.predict(ds.X_binned[:200], binned=True)  # + binned path)
+            report = server.drift_report(DEFAULT_PSI_BUDGET)
+        assert list(report) == ["v1"]
+        r = report["v1"]
+        assert r["rows"] == 500 and not r["breached"]
+        # scores were observed from the executed raw margins
+        state = server.drift_state()["v1"]
+        assert state["score"][2] == 500
+        assert state["ref_score"] is not None
+        # the stats surface
+        snap = server.stats()
+        assert snap["drift"]["v1"]["rows"] == 500
+    finally:
+        set_default_registry(old)
+
+
+def test_serve_shifted_traffic_breaches(model):
+    booster, X, ds = model
+    reg = Registry()
+    old = set_default_registry(reg)
+    try:
+        server = PredictServer(backend="cpu", max_batch_rows=64,
+                               max_wait_ms=0.5, drift_window=256)
+        server.registry.add(booster)
+        with server:
+            server.predict(_shift_binned(ds.X_binned[:300]), binned=True)
+            report = server.drift_report(DEFAULT_PSI_BUDGET)
+        assert report["v1"]["breached"]
+    finally:
+        set_default_registry(old)
+
+
+def test_serve_profileless_model_costs_one_probe(model):
+    booster, X, ds = model
+    saved = booster.profile
+    reg = Registry()
+    old = set_default_registry(reg)
+    try:
+        booster.profile = None
+        server = PredictServer(backend="cpu", max_batch_rows=64,
+                               max_wait_ms=0.5)
+        server.registry.add(booster)
+        with server:
+            server.predict(X[:8])
+            assert server._drift_monitors == {1: None}   # cached verdict
+            assert server.drift_report() == {}
+            assert server.drift_state() == {}
+        assert "drift" not in server.stats()
+    finally:
+        booster.profile = saved
+        set_default_registry(old)
+
+
+def test_serve_drift_disabled_allocates_nothing(model):
+    """The zero-cost pin (the r17 RequestTrace contract): with the obs
+    registry disabled the request path allocates NO drift state — the
+    monitor table stays None and no frame of obs/drift.py or
+    data/profile.py allocates."""
+    booster, X, _ds = model
+    reg = Registry(enabled=False)
+    old = set_default_registry(reg)
+    try:
+        server = PredictServer(backend="cpu", max_batch_rows=64,
+                               max_wait_ms=0.2)
+        assert server._drift_monitors is None
+        server.registry.add(booster)
+        with server:
+            rows = X[:2]
+            for _ in range(16):                  # warm every code path
+                server.predict(rows)
+
+            def leaked() -> list:
+                tracemalloc.start()
+                for _ in range(100):
+                    server.predict(rows)
+                snap_mem = tracemalloc.take_snapshot()
+                tracemalloc.stop()
+                return [st for st in snap_mem.statistics("filename")
+                        if st.traceback[0].filename.endswith(
+                            ("obs/drift.py", "data/profile.py"))]
+
+            for _ in range(3):
+                bad = leaked()
+                if not bad:
+                    break
+            assert not bad, f"disabled drift path allocated: {bad}"
+        assert server.drift_report() == {}
+    finally:
+        set_default_registry(old)
+
+
+def test_serve_drift_off_flag(model):
+    booster, X, _ds = model
+    server = PredictServer(backend="cpu", drift="off")
+    assert server._drift_monitors is None
+    server2 = PredictServer(backend="cpu", drift_window=0)
+    assert server2._drift_monitors is None
+
+
+# ---------------------------------------------------------------------------
+# the router (stub replicas — the real-replica path is smoke_fleet.py)
+
+STUB = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                    "fleet_stub_server.py")
+
+
+def _stub_argv(*extra: str):
+    def make(index: int, port_file: str) -> list:
+        return [sys.executable, STUB, "--port-file", port_file, *extra]
+    return make
+
+
+@contextlib.contextmanager
+def _stub_fleet(tmp_path, n=2, stub_flags=(), router_kw=None):
+    from dryad_tpu.fleet import FleetRouter, FleetSupervisor
+    from dryad_tpu.resilience.policy import RetryPolicy
+
+    reg = Registry()
+    journal = str(tmp_path / "fleet.jsonl")
+    sup = FleetSupervisor(_stub_argv(*stub_flags), n,
+                          policy=RetryPolicy(backoff_base_s=0.0),
+                          journal=journal, registry=reg,
+                          probe_interval_s=0.05, startup_timeout_s=20.0)
+    sup.start()
+    router = FleetRouter(sup, registry=reg, **(router_kw or {})).start()
+    try:
+        yield sup, router, reg, journal
+    finally:
+        router.stop()
+        sup.stop()
+
+
+def _get(router, path):
+    import http.client
+
+    conn = http.client.HTTPConnection(router.host, router.port, timeout=15.0)
+    try:
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        return resp.status, resp.read()
+    finally:
+        conn.close()
+
+
+def test_router_drift_disabled_by_default(tmp_path):
+    with _stub_fleet(tmp_path) as (_sup, router, _reg, _journal):
+        status, body = _get(router, "/drift")
+        assert status == 200 and json.loads(body) == {"enabled": False}
+
+
+def test_router_merges_and_verdicts_shifted_stubs(tmp_path):
+    """Two shifted stub replicas: the router merges their drift counts
+    exactly (2x one stub's counts), flips the verdict, journals ONE
+    drift_breach, serves the fleet gauges, and /healthz stays 200 with
+    the warning in its payload (warn-only)."""
+    from dryad_tpu.resilience.journal import RunJournal
+
+    kw = {"drift_budget_psi": 0.2, "drift_breach_after": 2}
+    with _stub_fleet(tmp_path, stub_flags=("--drift-shift",),
+                     router_kw=kw) as (_sup, router, reg, journal):
+        status, body = _get(router, "/drift")
+        doc1 = json.loads(body)
+        assert status == 200 and doc1["enabled"]
+        v1 = doc1["models"]["stub"]
+        # exact merge: 2 replicas x 32 rows, counts doubled not averaged
+        assert v1["rows"] == 64
+        assert v1["breached"] and not v1["sustained"]
+        status, body = _get(router, "/drift")
+        doc2 = json.loads(body)
+        v2 = doc2["models"]["stub"]
+        assert v2["sustained"] and doc2["warnings"] == ["drift:stub"]
+        assert v2["top"]                        # offending features named
+        # warn-only: health stays 200, payload carries the warning
+        status, body = _get(router, "/healthz")
+        health = json.loads(body)
+        assert status == 200 and health["ok"]
+        assert health["drift"]["warnings"] == ["drift:stub"]
+        # merged gauges ride the aggregated scrape
+        status, body = _get(router, "/metrics")
+        text = body.decode()
+        assert 'dryad_fleet_drift_psi_max{model="stub"}' in text
+        assert 'dryad_fleet_drift_rows{model="stub"} 64' in text
+        events = RunJournal.read(journal)
+    breaches = [e for e in events if e["event"] == "drift_breach"]
+    assert len(breaches) == 1 and breaches[0]["model"] == "stub"
+    assert breaches[0]["features"]
+
+
+def test_router_clean_stubs_stay_green(tmp_path):
+    kw = {"drift_budget_psi": 0.2, "drift_breach_after": 1}
+    with _stub_fleet(tmp_path, router_kw=kw) as (_s, router, _r, journal):
+        for _ in range(2):
+            _status, body = _get(router, "/drift")
+        doc = json.loads(body)
+        v = doc["models"]["stub"]
+        assert not v["breached"] and doc["warnings"] == []
+        status, body = _get(router, "/healthz")
+        assert json.loads(body)["drift"]["warnings"] == []
+
+
+# ---------------------------------------------------------------------------
+# bench + trends plumbing
+
+
+def test_trends_track_drift_overhead():
+    from dryad_tpu.obs.trends import _direction, _spread_fields_of
+
+    assert _direction("drift_overhead_ms") == "lower_better"
+    assert _direction("drift_overhead_pct") == "lower_better"
+    assert _spread_fields_of("drift_overhead_ms") == (
+        "drift_overhead_spread",)
+    assert _direction("drift_overhead_spread") is None   # context field
+
+
+def test_bench_drift_arm_smoke(model):
+    """run_bench_drift measures a LIVE monitor (raises otherwise) and
+    reports the overhead fields (values are noise at this duration; the
+    shape and the live-monitor proof are the pins)."""
+    from dryad_tpu.serve.bench import run_bench_drift
+
+    booster, _X, _ds = model
+    out = run_bench_drift(booster, backend="cpu", clients=2,
+                          duration_s=0.2, sizes=(1, 3), arms=1,
+                          max_batch_rows=64)
+    for key in ("drift_overhead_ms", "drift_overhead_pct",
+                "drift_overhead_spread"):
+        assert key in out
+    assert out["drift_windows"]                  # the monitor really ran
+
+
+def test_profile_from_binned_synthesizes_baseline(model):
+    booster, _X, ds = model
+    p = profile_from_binned(booster, ds.X_binned[:128])
+    assert p.n_rows == 128 and "train" in p.score_hist
