@@ -34,6 +34,7 @@ def train(
     valid_names: Optional[list[str]] = None,
     backend: str = "auto",
     init_booster: Optional[Booster] = None,
+    init_model: Optional[Booster] = None,
     callback=None,
     callbacks=None,
     checkpoint_dir: Optional[str] = None,
@@ -61,10 +62,40 @@ def train(
     passing these directly).  ``mesh`` forwards an explicit device mesh to
     the device trainer (rows sharded, histograms psum'd; see
     ``distributed.train_distributed`` for the usual front door).
+
+    ``init_model`` (r19, continual boosting) is the warm-start APPEND
+    surface: resume boosting from a LOADED served model's carried scores
+    on fresh rows — ``num_trees`` counts the NEW trees to append (0 is a
+    valid no-op that returns a predict-identical copy), and the fresh
+    rows must be binned in the model's frozen bin space
+    (``Dataset(X, y, mapper=model.mapper)``).  It rides the checkpoint-
+    resume machinery (carried scores rebuilt bitwise by tree replay), so
+    a same-shape append reuses the already-compiled programs — the
+    num_trees total is erased from the jit key.  ``init_booster`` remains
+    the low-level TOTAL-count resume surface the checkpoint path uses;
+    pass one or the other.  Apply ``Booster.refit``/leaf renewal BEFORE
+    the append when the old trees' leaf values should be re-weighted
+    toward the fresh rows.
     """
     p = make_params(params, **kw)
     if train_set is None:
         raise ValueError("train_set is required")
+    if init_model is not None:
+        if init_booster is not None:
+            raise ValueError("pass init_model (append semantics) or "
+                             "init_booster (total-count resume), not both")
+        if resume:
+            raise ValueError(
+                "init_model with resume=True is ambiguous (the checkpoint "
+                "would be shadowed by the warm start) — warm-started runs "
+                "that need crash recovery go through "
+                "resilience.supervise_train, which owns that hand-off")
+        _check_append_compatible(p, train_set, init_model)
+        p = p.replace(num_trees=p.num_trees + init_model.num_iterations)
+        init_booster = init_model
+    elif p.num_trees == 0:
+        raise ValueError("num_trees=0 is only meaningful with init_model "
+                         "(a 0-tree warm-start append)")
     if (any(p.monotone_constraints)
             and getattr(train_set.mapper, "bundled_mask", None) is not None):
         # EFB reorders/stacks columns, so positional per-feature constraints
@@ -134,6 +165,33 @@ def train(
                                    chunk_policy=chunk_policy)
     _attach_profile(booster, train_set, valid)
     return booster
+
+
+def _check_append_compatible(p: Params, train_set: Dataset,
+                             model: Booster) -> None:
+    """A warm-start append is only well-defined when the fresh rows live
+    in the model's frozen bin space and the tree geometry matches — the
+    carried-score replay walks the OLD trees over the NEW binned matrix,
+    so a re-sketched mapper would silently misroute every row."""
+    m_new, m_old = train_set.mapper, model.mapper
+    same = m_new is m_old
+    if not same:
+        try:
+            same = m_new.to_json_dict() == m_old.to_json_dict()
+        except AttributeError:
+            same = False
+    if not same:
+        raise ValueError(
+            "init_model append: the training set was binned with a "
+            "different mapper than the model's frozen bin space — build "
+            "it as Dataset(X, y, mapper=model.mapper) so the carried-"
+            "score replay and the new trees share one bin vocabulary")
+    if p.max_nodes != model.params.max_nodes:
+        raise ValueError(
+            f"init_model append: params imply max_nodes={p.max_nodes} but "
+            f"the model was grown with {model.params.max_nodes} — derive "
+            "the append params from model.params (e.g. "
+            "model.params.replace(num_trees=K)) so tree arrays stack")
 
 
 def _attach_profile(booster, train_set, valid_sets) -> None:

@@ -19,7 +19,13 @@
     python -m dryad_tpu fleet   --model m.dryad --replicas N [--port P] \
         [--journal fleet.jsonl --retry-budget N] [--warmup] \
         [--max-inflight N --bulk-max-inflight N] [--model-cap NAME=N] \
-        [--auth-token T]   # supervised replica pool + health-routed router
+        [--auth-token T]   # supervised replica pool + health-routed router \
+        [--continual-data fresh.npz [--retrain-trees K --probation-polls N]]
+                           # r19: drift_breach -> warm-start retrain ->
+                           # probationed rolling publish (+ auto-rollback)
+    python -m dryad_tpu retrain --model m.dryad --data fresh.npz --out g1.dryad \
+        [--trees K --refit-decay D --supervise] [--job-index J]
+                           # the scheduler's warm-start append worker
 
 Data formats: ``.npy`` (dense float matrix), ``.npz`` with keys
 ``indptr/indices/values/num_features`` (CSR sparse), or ``.csv``
@@ -444,6 +450,84 @@ def cmd_serve(args) -> int:
     return 0
 
 
+def cmd_retrain(args) -> int:
+    """Continual-boosting retrain worker (r19): the ONLY jax-importing
+    piece of the drift→retrain→publish loop — the scheduler launches one
+    of these as a subprocess per job, so a wedged device dies here, not
+    in the fleet control plane.
+
+    Loads the served artifact, warm-start APPENDS ``--trees`` new trees
+    on the fresh rows (``--data``: an npz with ``X``/``y``, binned in
+    the model's frozen bin space), optionally after a ``Booster.refit``
+    re-weighting pass, and saves the new generation with a fresh
+    reference profile.  ``DRYAD_CONTINUAL_FAULTS`` (e.g.
+    ``retrain:1:bad_generation``) is the deterministic drill knob: a
+    fired ``bad_generation`` point trains against a covariate-scaled
+    copy of the rows — a structurally valid model whose embedded profile
+    breaches against live traffic, exactly what a poisoned retrain data
+    pipeline would ship (the probation window must catch it)."""
+    # every generation ships a drift baseline unless explicitly disabled
+    os.environ.setdefault("DRYAD_PROFILE", "1")
+
+    import dryad_tpu as dryad
+    from dryad_tpu.resilience.faults import (BAD_GENERATION,
+                                             CONTINUAL_FAULTS_ENV,
+                                             injector_from_env)
+
+    model = dryad.Booster.load_any(args.model)
+    z = np.load(args.data)
+    if "X" not in z.files or "y" not in z.files:
+        raise SystemExit(f"--data {args.data!r} must be an npz with X and y")
+    X = np.asarray(z["X"], np.float32)
+    y = np.asarray(z["y"])
+
+    injector = injector_from_env(env_var=CONTINUAL_FAULTS_ENV)
+    fault_fired = None
+    if injector is not None:
+        pt = injector.take("retrain", args.job_index)
+        if pt is not None and pt.kind == BAD_GENERATION:
+            # the poisoned-pipeline twin: scale the covariates so the
+            # generation's fresh profile is built on rows live traffic
+            # never resembles
+            X = X * np.float32(0.25)
+            fault_fired = pt.kind
+
+    if args.refit_decay:
+        # re-weight the OLD trees' leaves toward the fresh rows first,
+        # then append — structure is kept, so the frozen bin space and
+        # tree geometry still match for the warm start
+        model = model.refit(X, y, decay_rate=args.refit_decay)
+
+    ds = dryad.Dataset(X, y, mapper=model.mapper)
+    p = model.params.replace(num_trees=args.trees)
+
+    if args.supervise:
+        from dryad_tpu.resilience import RetryPolicy, supervise_train
+
+        ckdir = args.checkpoint_dir or (args.out + ".ckpt")
+        booster = supervise_train(p, ds, backend=args.backend,
+                                  policy=RetryPolicy(),
+                                  checkpoint_dir=ckdir,
+                                  journal=args.journal,
+                                  init_model=model)
+    else:
+        booster = dryad.train(p, ds, backend=args.backend, init_model=model)
+
+    if args.text:
+        booster.save_text(args.out)
+    else:
+        booster.save(args.out)
+    print(json.dumps({
+        "retrain": args.model, "out": args.out,
+        "trees_before": model.num_iterations,
+        "trees_after": booster.num_iterations,
+        "job_index": args.job_index,
+        "fault": fault_fired,
+        "profile": getattr(booster, "profile", None) is not None,
+    }))
+    return 0
+
+
 def cmd_fleet(args) -> int:
     """Replicated serving: N serve subprocesses under lifecycle
     supervision (crash/hang detection, budgeted respawn, journal) behind
@@ -453,6 +537,26 @@ def cmd_fleet(args) -> int:
     from dryad_tpu.obs.drift import parse_psi_budget
     from dryad_tpu.obs.slo import parse_budgets
     from dryad_tpu.resilience.policy import RetryPolicy
+
+    # pure-argument guards FIRST (the cmd_train idiom): continual boosting
+    # needs the journal (the scheduler tails drift_breach from it) and
+    # STABLE model names — drift verdicts are keyed by registry alias, so
+    # a bare-path spec would change label (v1 -> v2) on the first push
+    # and orphan its own probation window
+    continual_models = {}
+    if args.continual_data:
+        if not args.journal:
+            raise SystemExit("--continual-data requires --journal (the "
+                             "retrain scheduler tails drift_breach events "
+                             "from the fleet journal)")
+        for spec in args.model:
+            name, _, path = spec.partition("=")
+            if not path or "/" in name or "\\" in name:
+                raise SystemExit(
+                    f"--continual-data requires NAME=path model specs "
+                    f"(got {spec!r}) — generation pushes keep the alias, "
+                    "so the drift verdict survives the swap")
+            continual_models[name] = path
 
     # router-side tracing: the merged /trace endpoint needs the router's
     # own span ring (replicas enable theirs in cmd_serve)
@@ -497,6 +601,7 @@ def cmd_fleet(args) -> int:
     # so a TERM/Ctrl-C during startup must still reach supervisor.stop()
     # (which terminates whatever was already spawned), or the half-built
     # pool leaks serve processes
+    scheduler = None
     try:
         supervisor.start()
         httpd = make_fleet_router(
@@ -518,8 +623,42 @@ def cmd_fleet(args) -> int:
             print(f"dryad fleet on http://{host}:{port}  "
                   f"({args.replicas} replicas: {urls}; POST /predict, "
                   "POST /models/push, GET /metrics aggregates the pool)")
+        if continual_models:
+            from dryad_tpu.continual import (JournalTailer,
+                                             ProbationPublisher,
+                                             RetrainScheduler,
+                                             make_http_verdicts,
+                                             make_subprocess_launcher,
+                                             make_supervisor_push)
+
+            out_dir = args.continual_out or os.path.join(
+                os.path.dirname(os.path.abspath(args.journal)), "continual")
+            launch = make_subprocess_launcher(
+                args.continual_data, out_dir,
+                trees=args.retrain_trees, backend=args.retrain_backend,
+                timeout_s=args.retrain_timeout,
+                refit_decay=args.retrain_refit_decay,
+                supervise=args.retrain_supervise)
+            publisher = ProbationPublisher(
+                make_supervisor_push(supervisor, auth_token=args.auth_token),
+                make_http_verdicts(host, port, auth_token=args.auth_token),
+                journal=supervisor.journal,
+                probation_polls=args.probation_polls,
+                poll_interval_s=args.probation_interval)
+            scheduler = RetrainScheduler(
+                continual_models, launch,
+                journal=supervisor.journal, publisher=publisher,
+                policy=policy, cooldown_s=args.retrain_cooldown,
+                max_concurrent=args.retrain_max_concurrent,
+                source=JournalTailer(args.journal)).start()
+            if not args.quiet:
+                print(f"continual boosting armed: {sorted(continual_models)} "
+                      f"-> {out_dir} (drift_breach triggers a warm-start "
+                      "retrain; probationed rolling publish + rollback)")
         main_loop(httpd, quiet=args.quiet)
     finally:
+        if scheduler is not None:
+            scheduler.stop(timeout_s=5.0)
         supervisor.stop()
     return 0
 
@@ -665,6 +804,41 @@ def main(argv=None) -> int:
     s.add_argument("--quiet", action="store_true")
     s.set_defaults(fn=cmd_serve)
 
+    rt = sub.add_parser("retrain",
+                        help="continual-boosting retrain worker: warm-start "
+                             "append on fresh rows (the scheduler's "
+                             "subprocess; dryad_tpu/continual)")
+    rt.add_argument("--model", required=True,
+                    help="served artifact to warm-start from (binary or "
+                         "text format)")
+    rt.add_argument("--data", required=True,
+                    help="fresh rows: an .npz with X and y (binned through "
+                         "the model's frozen mapper)")
+    rt.add_argument("--out", required=True, help="new-generation artifact path")
+    rt.add_argument("--trees", type=int, default=20,
+                    help="NEW trees to append (0 = a no-op generation, "
+                         "predict-identical to --model)")
+    rt.add_argument("--backend", default="cpu",
+                    choices=["auto", "tpu", "cpu"])
+    rt.add_argument("--refit-decay", type=float, default=0.0,
+                    help="re-weight the old trees' leaves toward the fresh "
+                         "rows first (Booster.refit decay_rate; 0 skips)")
+    rt.add_argument("--supervise", action="store_true",
+                    help="run the append under resilience.supervise_train "
+                         "(fault classes degrade and resume bitwise)")
+    rt.add_argument("--checkpoint-dir",
+                    help="supervised-run checkpoint dir (default: "
+                         "<out>.ckpt)")
+    rt.add_argument("--journal",
+                    help="supervised-run journal JSONL (with --supervise)")
+    rt.add_argument("--job-index", type=int, default=0,
+                    help="global retrain-job index — the "
+                         "DRYAD_CONTINUAL_FAULTS iteration the injector "
+                         "matches against")
+    rt.add_argument("--text", action="store_true",
+                    help="save the generation in the text format")
+    rt.set_defaults(fn=cmd_retrain)
+
     fl = sub.add_parser("fleet",
                         help="replicated serving: supervised replica pool "
                              "behind a health-routed router (dryad_tpu/fleet)")
@@ -738,6 +912,40 @@ def main(argv=None) -> int:
                     default=os.environ.get("DRYAD_AUTH_TOKEN"),
                     help="bearer token for router AND replicas "
                          "(/healthz stays open)")
+    fl.add_argument("--continual-data", default=None,
+                    help="arm continual boosting: fresh rows (.npz with "
+                         "X/y) each drift-triggered retrain appends on; "
+                         "requires --journal and NAME=path model specs "
+                         "(dryad_tpu/continual)")
+    fl.add_argument("--continual-out", default=None,
+                    help="generation artifact dir (default: "
+                         "<journal dir>/continual)")
+    fl.add_argument("--retrain-trees", type=int, default=20,
+                    help="NEW trees each generation appends")
+    fl.add_argument("--retrain-backend", default="cpu",
+                    choices=["auto", "tpu", "cpu"],
+                    help="retrain worker backend (cpu keeps retrains off "
+                         "the serving devices)")
+    fl.add_argument("--retrain-cooldown", type=float, default=300.0,
+                    help="per-model seconds between finished retrains — "
+                         "the breach debounce")
+    fl.add_argument("--retrain-max-concurrent", type=int, default=1,
+                    help="fleet-wide in-flight retrain budget")
+    fl.add_argument("--retrain-timeout", type=float, default=1800.0,
+                    help="retrain subprocess wall deadline (a wedged "
+                         "worker is killed, never waited on)")
+    fl.add_argument("--retrain-refit-decay", type=float, default=0.0,
+                    help="Booster.refit re-weighting before each append "
+                         "(0 skips)")
+    fl.add_argument("--retrain-supervise", action="store_true",
+                    help="run each retrain under "
+                         "resilience.supervise_train")
+    fl.add_argument("--probation-polls", type=int, default=5,
+                    help="drift-verdict polls a pushed generation must "
+                         "survive before promotion")
+    fl.add_argument("--probation-interval", type=float, default=2.0,
+                    help="seconds between probation polls (each poll is a "
+                         "fresh replica scrape + gate evaluation)")
     fl.add_argument("--quiet", action="store_true")
     fl.set_defaults(fn=cmd_fleet)
 

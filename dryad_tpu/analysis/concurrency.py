@@ -64,7 +64,8 @@ from dryad_tpu.analysis.lint import Rule, Violation, register
 from dryad_tpu.analysis.rules import dotted
 
 #: the threaded host plane — the four packages the schedule harness drills
-TARGETS = ("dryad_tpu/fleet/**", "dryad_tpu/serve/**",
+TARGETS = ("dryad_tpu/continual/**", "dryad_tpu/fleet/**",
+           "dryad_tpu/serve/**",
            "dryad_tpu/obs/**", "dryad_tpu/resilience/**")
 
 LOCK_ORDER_GOLDENS = "dryad_tpu/analysis/goldens/lock_order.json"

@@ -151,11 +151,11 @@ def _check_block_until_ready(path, src, tree):
 
 register(Rule(
     name="no-block-until-ready",
-    doc="serve/resilience/obs/fleet/bench must never sync on "
+    doc="serve/resilience/obs/fleet/continual/bench must never sync on "
         "block_until_ready",
     targets=("dryad_tpu/serve/**", "dryad_tpu/resilience/**",
              "dryad_tpu/obs/**", "dryad_tpu/fleet/**",
-             "bench.py", "scripts/*.py"),
+             "dryad_tpu/continual/**", "bench.py", "scripts/*.py"),
     check=_check_block_until_ready,
 ))
 
@@ -307,6 +307,58 @@ register(Rule(
     targets=("dryad_tpu/fleet/**",),
     check=_check_fleet_direct,
     tree_check=_tree_check_fleet,
+))
+
+
+# ---------------------------------------------------------------------------
+# continual-jax-free (r19) — the retrain scheduler and probation publisher
+# live in the fleet control plane: they must tail the journal, debounce,
+# launch, push, and roll back while a replica's (or the retrain worker's)
+# device is wedged.  The retrain itself is a SUBPROCESS
+# (`python -m dryad_tpu retrain`) — that is the only jax-importing piece
+# of the continual loop, and it is outside this package by construction.
+
+def _check_continual_direct(path, src, tree):
+    out = []
+    for line, mod in _imports_of(tree, ("jax", "jaxlib")):
+        out.append(Violation(
+            "continual-jax-free", path, line,
+            f"import {mod} in dryad_tpu/continual — the scheduler/publisher "
+            "are control-plane machinery and jax-free by lint (r19); the "
+            "retrain worker subprocess owns the devices"))
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Attribute) and node.attr in (
+                "device_get", "addressable_data", "asnumpy"):
+            out.append(Violation(
+                "continual-jax-free", path, node.lineno,
+                f".{node.attr} in dryad_tpu/continual — the control plane "
+                "must never touch device buffers; artifacts cross the "
+                "filesystem, verdicts cross HTTP"))
+    return out
+
+
+def _tree_check_continual(sources, tree):
+    out = []
+    chains = find_banned_chains(sorted(sources), tree,
+                                banned_roots=("jax", "jaxlib"))
+    for chain, banned in chains:
+        entry = chain[0]
+        out.append(Violation(
+            "continual-jax-free", _module_rel(entry, tree), 1,
+            "transitive jax import: " + " -> ".join(chain)
+            + " — importing dryad_tpu.continual must not pull in jax "
+            "(r19; the booster/mapper stay out — model_has_profile sniffs "
+            "artifacts with numpy+json, the retrain worker subprocess does "
+            "the loading)"))
+    return out
+
+
+register(Rule(
+    name="continual-jax-free",
+    doc="dryad_tpu/continual is jax-free, directly and transitively",
+    targets=("dryad_tpu/continual/**",),
+    check=_check_continual_direct,
+    tree_check=_tree_check_continual,
 ))
 
 

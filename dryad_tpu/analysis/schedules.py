@@ -1232,6 +1232,106 @@ def drill_injector_concurrent_fire(sched: Scheduler):
     return check
 
 
+def drill_scheduler_breach_vs_push(sched: Scheduler):
+    """RetrainScheduler concurrent breach-vs-retrain-vs-push-vs-death (r19).
+
+    The REAL RetrainScheduler and ProbationPublisher run against the live
+    FleetSupervisor: two breach tasks each deliver 2 drift-breach events
+    for the same model while the first admitted retrain (fake launcher on
+    the virtual clock) is in flight, a concurrent rolling push swaps an
+    unrelated model, and a replica dies mid-everything.  Invariants: the
+    debounce admits EXACTLY ONE retrain for the burst (every other
+    delivery journals ``retrain_skipped``), the completed generation
+    settles to exactly one publish outcome, no in-flight state leaks, and
+    the runtime lock order stays acyclic.  Mechanically splitting
+    ``_admit``'s checks from its in-flight mark (the unlocked-streak
+    mutation, seeded by the pytest mutation test) double-launches and
+    fails the drill."""
+    from dryad_tpu.continual.publish import ProbationPublisher
+    from dryad_tpu.continual.scheduler import RetrainScheduler
+    from dryad_tpu.obs.registry import Registry
+    from dryad_tpu.resilience.policy import RetryPolicy
+
+    fs, journal, procs = _make_fleet(sched, {}, n=2)
+    launched: list = []
+
+    def launch(model: str, gen: int, job: int, artifact: str):
+        launched.append((model, gen, job))
+        _time_mod.sleep(0.05)            # the retrain's virtual wall
+        return True, f"{artifact}-g{gen}", ""
+
+    def verdicts() -> dict:
+        # clean traffic with rows flowing — probation must promote (the
+        # rollback arm is the smoke's territory; here the race is the
+        # debounce, not the verdict)
+        return {"m": {"rows": 64, "breached": False, "sustained": False,
+                      "psi_max": 0.01, "score_psi": 0.0, "streak": 0}}
+
+    def push(path: str, model: str):
+        res = fs.rolling_push(path, name=model, drain_timeout_s=5.0)
+        errs = list(res.get("errors") or [])
+        return (not errs), "; ".join(str(e) for e in errs)
+
+    pub = ProbationPublisher(push, verdicts, journal=journal.event,
+                             probation_polls=2, poll_interval_s=0.01,
+                             clear_after=1, registry=Registry(enabled=False))
+    rs = RetrainScheduler(
+        {"m": "art-g0"}, launch, journal=journal.event, publisher=pub,
+        policy=RetryPolicy(retry_budget=3, backoff_base_s=0.01),
+        cooldown_s=1000.0, max_concurrent=1,
+        has_profile=lambda p: True, registry=Registry(enabled=False))
+
+    def breacher() -> None:
+        for _ in range(2):
+            rs.trigger("m", origin="drill")
+
+    def pusher() -> None:
+        _wait_until(lambda: fs._monitor is not None, "fleet started")
+        fs.rolling_push("other-model", name="other", drain_timeout_s=5.0)
+
+    def killer() -> None:
+        _wait_until(lambda: launched, "retrain admitted")
+        procs[1].exit_code = 23
+
+    def controller() -> None:
+        fs.start()
+        tasks = [sched.spawn(breacher, "breach-a"),
+                 sched.spawn(breacher, "breach-b"),
+                 sched.spawn(pusher, "pusher"),
+                 sched.spawn(killer, "killer")]
+        _wait_until(lambda: all(x.state == _DONE for x in tasks),
+                    "drill tasks done")
+        _wait_until(lambda: not rs.state()["inflight"], "retrain drained")
+        fs.stop()
+
+    sched.spawn(controller, "controller")
+
+    def check() -> None:
+        kinds = journal.kinds()
+        assert len(launched) == 1, (
+            f"debounce double-launched: {launched} — _admit's check and "
+            "in-flight mark are not one critical section")
+        assert kinds.count("retrain_triggered") == 1, kinds
+        assert kinds.count("retrain_complete") == 1, kinds
+        assert kinds.count("retrain_skipped") == 3, kinds
+        assert kinds.count("generation_rolled_back") == 0, kinds
+        st = rs.state()
+        assert not st["inflight"], f"in-flight state leaked: {st}"
+        promoted = kinds.count("generation_promoted")
+        failed = kinds.count("push_failed")
+        # the killed replica may or may not be respawned by the time the
+        # probation push lands — per seed exactly one outcome settles
+        assert promoted + failed == 1, kinds
+        if promoted:
+            assert st["generation"]["m"] == 1, st
+            assert st["artifacts"]["m"] == "art-g0-g1", st
+        else:
+            assert st["generation"]["m"] == 0, st
+            assert st["artifacts"]["m"] == "art-g0", st
+
+    return check
+
+
 #: name -> (drill, schedules to run in CI, preempt_p, trace file suffixes)
 DRILLS: dict = {
     "batcher-stop-start": (drill_batcher_stop_start, 20, 0.1,
@@ -1248,6 +1348,8 @@ DRILLS: dict = {
                           ("obs/drift.py",)),
     "injector-concurrent-fire": (drill_injector_concurrent_fire, 20, 0.3,
                                  ("resilience/faults.py",)),
+    "scheduler-breach-vs-push": (drill_scheduler_breach_vs_push, 10, 0.1,
+                                 ("continual/scheduler.py",)),
 }
 
 

@@ -282,8 +282,11 @@ class Params:
                 raise ValueError("goss replaces bagging; set subsample=1.0")
         if self.num_leaves < 2:
             raise ValueError("num_leaves must be >= 2")
-        if self.num_trees < 1:
-            raise ValueError("num_trees must be >= 1")
+        if self.num_trees < 0:
+            # 0 is the warm-start no-op append (train(init_model=m,
+            # num_trees=0) returns a predict-identical copy); dryad.train
+            # rejects it for a FRESH run, where an empty model is a typo
+            raise ValueError("num_trees must be >= 0")
         if not (0.0 < self.learning_rate):
             raise ValueError("learning_rate must be > 0")
         if not (0.0 < self.subsample <= 1.0) or not (0.0 < self.colsample <= 1.0):

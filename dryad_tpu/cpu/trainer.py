@@ -414,6 +414,13 @@ def train_cpu(
 
     out = empty_tree_arrays(T, p.max_nodes)
     init = np.asarray(obj.init_score(y, data.weight), np.float32).reshape(-1)
+    if init_booster is not None:
+        # the carried base score is part of the model: a continuation (and
+        # especially an r19 warm-start append on FRESH rows) must not
+        # re-derive it from the current label distribution, or a 0-tree
+        # append would shift every prediction.  Checkpoint resume is
+        # unchanged bitwise — same labels produced the same init.
+        init = np.asarray(init_booster.init_score, np.float32).reshape(-1)
     score = np.broadcast_to(init, (N, K)).astype(np.float32).copy()
     qoff = data.query_offsets
     bundled_np = getattr(data.mapper, "bundled_mask", None)

@@ -737,6 +737,15 @@ def train_device(
     qoff = data.query_offsets
 
     init = np.asarray(obj.init_score(data.y, data.weight), np.float32).reshape(-1)
+    if init_booster is not None:
+        # the carried base score is part of the model: a continuation (and
+        # especially an r19 warm-start append on FRESH rows) must not
+        # re-derive it from the current label distribution, or a 0-tree
+        # append would shift every prediction.  Checkpoint resume is
+        # unchanged bitwise — same labels produced the same init; this
+        # runs BEFORE the rf constant-gradient capture below for the same
+        # reason.
+        init = np.asarray(init_booster.init_score, np.float32).reshape(-1)
     score = jnp.broadcast_to(jnp.asarray(init), (NP, K)).astype(jnp.float32)
     if mesh is not None:
         score = shard_rows(mesh, score)[0]

@@ -77,6 +77,16 @@ REPLICA_CRASH = "replica_crash"
 SLOW_HEALTH = "slow_health"
 REJECT_503 = "reject_503"
 REPLICA_KINDS = (REPLICA_CRASH, SLOW_HEALTH, REJECT_503)
+
+#: continual-boosting injection KIND (r19): the retrain worker consults
+#: the injector via ``take()`` at its ``("retrain", job_index)`` point and,
+#: when armed, trains the generation against the WRONG data distribution —
+#: the deterministic twin of a poisoned retrain data pipeline, used to
+#: drill the probation auto-rollback (continual/publish.py).  Action-at-
+#: caller: ``take()`` RETURNS the fired point instead of raising, because
+#: the drill needs a structurally valid (merely drift-breaching) model.
+BAD_GENERATION = "bad_generation"
+CONTINUAL_KINDS = (BAD_GENERATION,)
 #: the exit code an injected replica_crash dies with — fleet tests and the
 #: ci smoke identify the injected death by it (any OTHER nonzero exit in a
 #: drill is a real bug, not the drill)
@@ -89,6 +99,8 @@ RETRYABLE = (FETCH_DEATH, DEVICE_UNAVAILABLE, OOM, PREEMPTION)
 SITES = ("dispatch", "fetch")
 #: the site vocabulary of the serve front end's replica fault hook
 REPLICA_SITES = ("request", "health")
+#: the site vocabulary of the continual retrain worker's fault hook (r19)
+CONTINUAL_SITES = ("retrain",)
 
 
 class InjectedReject(RuntimeError):
@@ -198,10 +210,11 @@ class FaultPoint:
     sticky: bool = False
 
     def __post_init__(self):
-        if self.site not in SITES + REPLICA_SITES:
-            raise ValueError(f"site must be one of {SITES + REPLICA_SITES}, "
+        all_sites = SITES + REPLICA_SITES + CONTINUAL_SITES
+        if self.site not in all_sites:
+            raise ValueError(f"site must be one of {all_sites}, "
                              f"got {self.site!r}")
-        if (self.kind not in (STALL,) + REPLICA_KINDS
+        if (self.kind not in (STALL,) + REPLICA_KINDS + CONTINUAL_KINDS
                 and self.kind not in _CANONICAL_MSG):
             raise ValueError(f"unknown fault kind {self.kind!r}")
         # kinds and sites partition strictly: a replica kind at a trainer
@@ -212,11 +225,21 @@ class FaultPoint:
             raise ValueError(
                 f"replica fault kind {self.kind!r} fires only at replica "
                 f"sites {REPLICA_SITES}, got site {self.site!r}")
-        if self.kind not in REPLICA_KINDS and self.site in REPLICA_SITES:
+        if self.kind in CONTINUAL_KINDS and self.site not in CONTINUAL_SITES:
+            raise ValueError(
+                f"continual fault kind {self.kind!r} fires only at "
+                f"continual sites {CONTINUAL_SITES}, got site {self.site!r}")
+        if (self.kind not in REPLICA_KINDS
+                and self.site in REPLICA_SITES):
             raise ValueError(
                 f"fault kind {self.kind!r} is a trainer class and never "
                 f"fires at replica site {self.site!r}; use one of "
                 f"{REPLICA_KINDS}")
+        if (self.kind not in CONTINUAL_KINDS
+                and self.site in CONTINUAL_SITES):
+            raise ValueError(
+                f"fault kind {self.kind!r} never fires at continual site "
+                f"{self.site!r}; use one of {CONTINUAL_KINDS}")
         if self.kind in (STALL, SLOW_HEALTH) and self.stall_s <= 0:
             raise ValueError(f"a {self.kind} point needs stall_s > 0")
 
@@ -276,6 +299,13 @@ class FaultInjector:
         # actions run OUTSIDE the lock: a SLOW_HEALTH sleep must stall
         # only its own probe, never serialize concurrent injections
         for pt in to_fire:
+            if pt.kind in CONTINUAL_KINDS:
+                # action-at-caller kinds are consumed via take(); firing
+                # one through the raising hook is a drill wiring bug —
+                # doing nothing here would silently disarm it
+                raise ValueError(
+                    f"{pt.kind} is an action-at-caller kind: consume it "
+                    "with FaultInjector.take(), not the raising hook")
             if pt.kind in (STALL, SLOW_HEALTH):
                 # a hang, not a death: hold the hook (inside the
                 # trainer's watch_fetch bracket / the replica's probe
@@ -297,6 +327,25 @@ class FaultInjector:
                     f"injected 503 rejection at {site} #{iteration}")
             raise make_fault(pt.kind)
 
+    def take(self, site: str, iteration: int) -> "FaultPoint | None":
+        """Atomic check-and-clear for ACTION-AT-CALLER kinds (r19
+        ``bad_generation``): returns the first matching armed point
+        (recorded in ``fired``) instead of raising/exiting — the caller
+        owns the fault's effect.  Same one-shot/sticky discipline as
+        ``__call__``; the two share ``_armed``, so a point consumed here
+        can never also fire there."""
+        with self._lock:
+            for i, pt in enumerate(self.points):
+                if (self._armed[i] and site == pt.site
+                        and iteration >= pt.iteration):
+                    if not pt.sticky:
+                        self._armed[i] = False
+                    self.fired.append({"point": i, "site": site,
+                                       "iteration": int(iteration),
+                                       "kind": pt.kind})
+                    return pt
+        return None
+
     @property
     def pending(self) -> int:
         with self._lock:
@@ -314,6 +363,10 @@ class FaultInjector:
 # HTTP front end's fault hook; an absent/empty var costs nothing.
 
 REPLICA_FAULTS_ENV = "DRYAD_REPLICA_FAULTS"
+#: same wire format, consumed by the continual retrain worker (r19) — the
+#: scheduler passes it through the subprocess env so a forced-bad-
+#: generation drill survives the exec boundary like the replica drills do
+CONTINUAL_FAULTS_ENV = "DRYAD_CONTINUAL_FAULTS"
 
 
 def encode_points(points) -> str:
@@ -362,12 +415,15 @@ def decode_points(value: str) -> list[FaultPoint]:
     return points
 
 
-def injector_from_env(environ=None) -> "FaultInjector | None":
-    """Build the replica's injector from ``DRYAD_REPLICA_FAULTS`` (None
-    when unset/empty — the production path)."""
+def injector_from_env(environ=None,
+                      env_var: str = REPLICA_FAULTS_ENV
+                      ) -> "FaultInjector | None":
+    """Build an injector from the named env var (default: the replica
+    drills' ``DRYAD_REPLICA_FAULTS``; the continual retrain worker passes
+    ``CONTINUAL_FAULTS_ENV``).  None when unset/empty — the production
+    path."""
     import os
 
-    value = (environ if environ is not None else os.environ).get(
-        REPLICA_FAULTS_ENV, "")
+    value = (environ if environ is not None else os.environ).get(env_var, "")
     points = decode_points(value)
     return FaultInjector(points) if points else None

@@ -17,6 +17,14 @@ family (``fleet_start``, ``replica_spawn``/``ready``/``crash``/
 and r18 adds ``drift_breach`` — the router's drift gate journals a
 SUSTAINED model-drift verdict here (model, psi_max, score_psi, offending
 features), which is the continual-boosting retrain/rollback trigger.
+r19 closes that loop: the continual package (continual/scheduler.py,
+continual/publish.py) journals ``retrain_triggered``/``retrain_skipped``
+(reason: in_flight/budget/cooldown/retry_budget_exhausted/no_profile/
+unknown_model/artifact_unreadable)/``retrain_complete``/
+``retrain_failed``/``publish_error`` and the probation family
+``push_probation``/``push_failed``/``generation_promoted`` (verdict:
+clear/expired)/``generation_rolled_back`` (the rollback RE-PUSHES the
+prior artifact — the registry is never mutated in place).
 """
 
 from __future__ import annotations

@@ -98,6 +98,22 @@ def supervise_train(
     # "resume only when init_booster is None" guard skip the checkpoint —
     # every retry would silently redo the whole faulted segment
     init_booster = kw.pop("init_booster", None)
+    # init_model (r19): the public APPEND surface — num_trees counts NEW
+    # trees.  Normalize it ONCE into the total-count init_booster form so
+    # every resumed segment sees one consistent target; the append count
+    # must live in ``params`` here (not a loose kwarg), because the
+    # conversion happens before dryad.train's params merge.
+    init_model = kw.pop("init_model", None)
+    if init_model is not None:
+        if init_booster is not None:
+            raise ValueError("pass init_model (append semantics) or "
+                             "init_booster (total-count resume), not both")
+        from dryad_tpu.config import make_params
+        p0 = make_params(params)
+        dryad._check_append_compatible(p0, train_set, init_model)
+        params = p0.replace(num_trees=p0.num_trees
+                            + init_model.num_iterations)
+        init_booster = init_model
     # the supervisor OWNS resume semantics (every segment passes
     # resume=True); a caller's resume= kwarg would otherwise collide in
     # dryad.train with an opaque TypeError.  An explicit resume=False is

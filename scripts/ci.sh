@@ -105,6 +105,22 @@ if ! env JAX_PLATFORMS=cpu \
 fi
 tail -1 /tmp/_fleet_smoke.log
 
+# Continual smoke (r19): the multi-generation drill on REAL replicas —
+# a sustained covariate shift journals drift_breach, the RetrainScheduler
+# append-trains gen-1 (warm-start init_model subprocess), the rolling
+# push clears the breach in probation (generation_promoted), and a
+# forced bad_generation retrain (DRYAD_CONTINUAL_FAULTS drill wire)
+# auto-rolls back by re-pushing the gen-1 artifact — zero failed
+# interactive requests, zero unexpected recompiles across the swaps.
+if ! env JAX_PLATFORMS=cpu \
+    PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}" \
+    python scripts/smoke_continual.py > /tmp/_continual_smoke.log 2>&1; then
+  echo "CONTINUAL SMOKE FAIL: scripts/smoke_continual.py (see /tmp/_continual_smoke.log)" >&2
+  tail -5 /tmp/_continual_smoke.log >&2
+  exit 1
+fi
+tail -1 /tmp/_continual_smoke.log
+
 # Serving bench smoke (r7): zero recompiles after warmup across BOTH the
 # bucketed (forced-CPU) and sharded (8 fake devices) compiled-entry
 # families — warm traffic must be structurally recompile-free.

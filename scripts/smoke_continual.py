@@ -1,0 +1,351 @@
+"""Continual-boosting smoke for scripts/ci.sh (runs under JAX_PLATFORMS=cpu).
+
+The r19 multi-generation drill, end to end on REAL subprocess replicas —
+the full train -> serve -> drift -> retrain -> publish loop the continual
+package closes:
+
+* gen-0 trains with its reference profile and serves on a 2-replica
+  fleet; baseline traffic keeps ``GET /drift`` green (no false positive),
+* a sustained 3x covariate-shift burst journals ``drift_breach``; the
+  REAL ``RetrainScheduler`` tails the journal, debounces, and append-
+  trains gen-1 (``dryad_tpu retrain`` subprocess: warm-start
+  ``init_model`` on the SHIFTED rows, fresh embedded profile),
+* gen-1 goes out through the zero-drop rolling push into probation;
+  because its profile matches the live traffic the verdict clears and
+  the journal records ``generation_promoted`` — the breach is gone,
+* a FORCED retrain (manual trigger) arms the ``bad_generation`` fault
+  through ``DRYAD_CONTINUAL_FAULTS`` (the production drill wire): gen-2
+  trains on covariate-scaled rows, so its fresh profile breaches against
+  the live traffic during probation while gen-1's pre-push verdict was
+  clean — the publisher auto-rolls back by RE-PUSHING the gen-1
+  artifact (never an in-place registry mutation) and journals
+  ``generation_rolled_back``,
+* throughout: ZERO failed interactive requests, zero trace-id
+  mismatches, and ``dryad_recompile_unexpected_total`` == 0 on every
+  replica (generation swaps ride the deploy-window disarm).
+
+Prints one JSON summary line on success, exits 1 with a reason otherwise.
+"""
+
+import http.client
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+import dryad_tpu as dryad  # noqa: E402
+from dryad_tpu.continual import (  # noqa: E402
+    JournalTailer, ProbationPublisher, RetrainScheduler,
+    make_http_verdicts, make_subprocess_launcher, make_supervisor_push)
+from dryad_tpu.datasets import higgs_like  # noqa: E402
+from dryad_tpu.fleet import FleetRouter, FleetSupervisor, serve_argv  # noqa: E402
+from dryad_tpu.fleet.bench import _closed_loop  # noqa: E402
+from dryad_tpu.obs.registry import Registry  # noqa: E402
+from dryad_tpu.resilience import faults as F  # noqa: E402
+from dryad_tpu.resilience.journal import RunJournal  # noqa: E402
+from dryad_tpu.resilience.policy import RetryPolicy  # noqa: E402
+
+PARAMS = dict(objective="binary", num_trees=10, num_leaves=7, max_bins=32,
+              seed=5)
+RETRAIN_TREES = 6
+SHIFT = 3.0          # the covariate scale that flips the drift verdict
+
+
+def fail(reason: str) -> int:
+    print(f"CONTINUAL SMOKE FAIL: {reason}", flush=True)
+    return 1
+
+
+class TrafficPump(threading.Thread):
+    """Closed interactive loops in 2 s chunks until stopped — the drift
+    windows (gen-0's breach, gen-1's clear, gen-2's probation breach)
+    only fill while requests flow, so traffic must span the whole drill,
+    not just the burst."""
+
+    def __init__(self, host, port, payloads):
+        super().__init__(daemon=True)
+        self.host, self.port, self.payloads = host, port, payloads
+        self.stop_ev = threading.Event()
+        self.failures = 0
+        self.requests = 0
+        self.trace_mismatches = 0
+
+    def run(self):
+        seed = 100
+        while not self.stop_ev.is_set():
+            seed += 1
+            r = _closed_loop(self.host, self.port, self.payloads,
+                             clients=1, duration_s=2.0, seed=seed,
+                             priority="interactive", trace=True)
+            self.failures += r["failures"]
+            self.requests += r["requests"]
+            self.trace_mismatches += r["trace_mismatches"]
+
+    def halt(self):
+        self.stop_ev.set()
+        self.join(timeout=30.0)
+
+
+def wait_for(predicate, timeout_s, what):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        v = predicate()
+        if v:
+            return v
+        time.sleep(0.5)
+    raise TimeoutError(what)
+
+
+def main() -> int:
+    os.environ["DRYAD_PROFILE"] = "1"
+    X, y = higgs_like(1200, seed=17)
+    ds = dryad.Dataset(X, y, max_bins=32)
+    booster = dryad.train(PARAMS, ds, backend="cpu")
+    if booster.profile is None:
+        return fail("dryad.train attached no reference profile")
+
+    with tempfile.TemporaryDirectory(prefix="dryad-continual-smoke-") as td:
+        gen0_path = os.path.join(td, "m-gen0.dryad")
+        booster.save(gen0_path)
+        # retrain corpus = the SHIFTED distribution: gen-1's embedded
+        # profile must describe the live traffic for the breach to clear
+        fresh_npz = os.path.join(td, "fresh.npz")
+        np.savez(fresh_npz, X=(X * SHIFT).astype(np.float32), y=y)
+        journal_path = os.path.join(td, "fleet.jsonl")
+        out_dir = os.path.join(td, "continual")
+        reg = Registry()
+
+        def make_argv(index: int, port_file: str) -> list:
+            # NAME=path alias: drift verdicts key on the registry alias,
+            # so the label survives generation pushes (a bare path's
+            # label would change v1 -> v2 on the first push)
+            return serve_argv([f"m={gen0_path}"], port_file, backend="cpu",
+                              max_batch_rows=64, max_wait_ms=0.5,
+                              drift_window=512)
+
+        sup = FleetSupervisor(
+            make_argv, 2,
+            policy=RetryPolicy(backoff_base_s=0.1, retry_budget=3),
+            journal=journal_path, registry=reg,
+            probe_interval_s=0.1, startup_timeout_s=180.0)
+        sup.start()
+        router = FleetRouter(sup, registry=reg, max_inflight=16,
+                             drift_budget_psi=0.25,
+                             drift_breach_after=2).start()
+        # job 0 (the drift-triggered gen-1) is clean; job 1 (the forced
+        # gen-2) trains bad — the production fault wire, env-armed
+        bad_spec = F.encode_points(
+            [F.FaultPoint(site="retrain", iteration=1,
+                          kind=F.BAD_GENERATION)])
+        launch = make_subprocess_launcher(
+            fresh_npz, out_dir, trees=RETRAIN_TREES, backend="cpu",
+            timeout_s=600.0, log_dir=out_dir,
+            extra_env={F.CONTINUAL_FAULTS_ENV: bad_spec})
+        publisher = ProbationPublisher(
+            make_supervisor_push(sup),
+            make_http_verdicts(router.host, router.port),
+            journal=sup.journal, probation_polls=12, poll_interval_s=1.0,
+            clear_after=2, registry=reg)
+        rs = RetrainScheduler(
+            {"m": gen0_path}, launch, journal=sup.journal,
+            publisher=publisher,
+            policy=RetryPolicy(backoff_base_s=0.5, retry_budget=3),
+            cooldown_s=3.0, max_concurrent=1, poll_interval_s=0.5,
+            source=JournalTailer(journal_path), registry=reg).start()
+
+        def events():
+            return RunJournal.read(journal_path)
+
+        def has(kind, **match):
+            return [e for e in events() if e["event"] == kind
+                    and all(e.get(k) == v for k, v in match.items())]
+
+        def drift_poll(conn):
+            conn.request("GET", "/drift")
+            return json.loads(conn.getresponse().read())
+
+        pump = None
+        try:
+            conn = http.client.HTTPConnection(router.host, router.port,
+                                              timeout=30.0)
+
+            def slice_payloads(scale: float) -> dict:
+                out = {}
+                for n, start in ((37, 0), (83, 100), (129, 300), (211, 500)):
+                    rows = (X[start:start + n] * scale).tolist()
+                    out[n] = json.dumps({"rows": rows}).encode()
+                return out
+
+            # ---- phase 1: baseline green --------------------------------
+            base = _closed_loop(router.host, router.port,
+                                slice_payloads(1.0), clients=2,
+                                duration_s=2.5, seed=5, trace=True)
+            clean = drift_poll(conn)
+            false_pos = {m: v for m, v in (clean.get("models") or {}).items()
+                         if v.get("breached")}
+            if false_pos:
+                return fail("drift breached on training-distribution "
+                            f"traffic (false positive): {false_pos}")
+
+            # ---- phase 2: sustained shift -> breach -> gen-1 ------------
+            pump = TrafficPump(router.host, router.port,
+                               slice_payloads(SHIFT))
+            pump.start()
+
+            def breached():
+                drift_poll(conn)
+                return has("drift_breach", model="m")
+
+            wait_for(breached, 90.0, "no drift_breach journaled for the "
+                     "sustained covariate shift")
+            wait_for(lambda: has("retrain_triggered", model="m",
+                                 generation=1),
+                     30.0, "the scheduler never picked the breach up from "
+                     "the journal tail")
+            wait_for(lambda: has("retrain_complete", model="m",
+                                 generation=1),
+                     300.0, "the gen-1 append retrain never completed")
+            wait_for(lambda: has("generation_promoted", model="m",
+                                 generation=1),
+                     90.0, "gen-1 never promoted — the matching profile "
+                     "should have cleared the breach in probation")
+            # the fleet verdict must actually be green again (live proof,
+            # not just the journal record)
+            def green():
+                doc = drift_poll(conn)
+                v = (doc.get("models") or {}).get("m") or {}
+                return bool(v.get("rows")) and not v.get("breached")
+            wait_for(green, 60.0, "the fleet /drift verdict never went "
+                     "green after the gen-1 push")
+
+            # ---- phase 3: forced bad generation -> rollback -------------
+            def forced():
+                rs.trigger("m", origin="forced")
+                return has("retrain_triggered", model="m", generation=2)
+            wait_for(forced, 30.0, "the forced trigger never admitted "
+                     "(cooldown never expired?)")
+            wait_for(lambda: has("generation_rolled_back", model="m",
+                                 generation=2),
+                     300.0, "the bad generation was never rolled back")
+            wait_for(green, 60.0, "the fleet /drift verdict never "
+                     "recovered after the rollback re-push")
+            tail = _closed_loop(router.host, router.port,
+                                slice_payloads(SHIFT), clients=2,
+                                duration_s=1.5, seed=7, trace=True)
+            conn.close()
+        except TimeoutError as e:
+            return fail(f"{e} — journal tail: {events()[-12:]}")
+        finally:
+            if pump is not None:
+                pump.halt()
+            rs.stop(timeout_s=30.0)
+            state = rs.state()
+            # replica metrics BEFORE teardown: an absent counter is zero
+            # (the tripwire only mints the line on first fire), but the
+            # scrape itself must succeed or the check never ran
+            recompiles = {}
+            for slot in sup.slots:
+                if slot.proc is None or slot.proc.host is None:
+                    continue
+                try:
+                    c = http.client.HTTPConnection(
+                        slot.proc.host, slot.proc.port, timeout=10.0)
+                    c.request("GET", "/metrics")
+                    text = c.getresponse().read().decode()
+                    c.close()
+                except OSError:
+                    continue
+                recompiles[slot.name] = 0.0
+                for line in text.splitlines():
+                    if line.startswith("dryad_recompile_unexpected_total"):
+                        recompiles[slot.name] = float(line.split()[-1])
+            router.stop()
+            sup.stop()
+        evs = RunJournal.read(journal_path)
+        # load the promoted artifact while the tempdir still exists
+        promoted = [e for e in evs if e["event"] == "generation_promoted"
+                    and e.get("generation") == 1]
+        gen1 = (dryad.Booster.load_any(promoted[0]["path"]) if promoted
+                else None)
+
+    # ---- assertions --------------------------------------------------------
+    failures = base["failures"] + pump.failures + tail["failures"]
+    if failures:
+        return fail(f"{failures} failed interactive request(s) across the "
+                    "generation swaps — the rolling push must be zero-drop")
+    mism = (base["trace_mismatches"] + pump.trace_mismatches
+            + tail["trace_mismatches"])
+    if mism:
+        return fail(f"{mism} response(s) did not echo their trace id")
+    if pump.requests < 20:
+        return fail(f"only {pump.requests} pumped requests — the drill "
+                    "never exercised the fleet")
+
+    def evts(kind, **match):
+        return [e for e in evs if e["event"] == kind
+                and all(e.get(k) == v for k, v in match.items())]
+
+    for kind, gen in (("retrain_triggered", 1), ("retrain_complete", 1),
+                      ("push_probation", 1), ("generation_promoted", 1),
+                      ("retrain_triggered", 2), ("retrain_complete", 2),
+                      ("push_probation", 2), ("generation_rolled_back", 2)):
+        found = evts(kind, model="m", generation=gen)
+        if len(found) != 1:
+            return fail(f"expected exactly one {kind} for generation {gen}, "
+                        f"got {len(found)}")
+    rb = evts("generation_rolled_back", model="m", generation=2)[0]
+    if not rb.get("prior", "").endswith("m-gen1.dryad"):
+        return fail(f"rollback re-pushed {rb.get('prior')!r}, not the gen-1 "
+                    "artifact")
+    if not rb.get("restore_ok"):
+        return fail(f"the rollback re-push itself failed: {rb}")
+    if evts("generation_promoted", model="m", generation=2):
+        return fail("the bad generation was ALSO promoted")
+    if state["generation"].get("m") != 1:
+        return fail(f"scheduler generation is {state['generation']} — the "
+                    "rolled-back gen-2 must not supersede gen-1")
+    if not state["artifacts"].get("m", "").endswith("m-gen1.dryad"):
+        return fail(f"scheduler artifact is {state['artifacts']} — want the "
+                    "promoted gen-1 path")
+    if state["inflight"]:
+        return fail(f"retrains still in flight at teardown: {state}")
+    # the generations themselves: warm-start appends, fresh profiles
+    if gen1 is None:
+        return fail("no promoted gen-1 artifact to inspect")
+    if gen1.num_iterations != PARAMS["num_trees"] + RETRAIN_TREES:
+        return fail(f"gen-1 has {gen1.num_iterations} trees — the append "
+                    f"should carry {PARAMS['num_trees']} + {RETRAIN_TREES}")
+    if gen1.profile is None:
+        return fail("gen-1 shipped without a fresh reference profile")
+    if not recompiles:
+        return fail("no replica /metrics scrape succeeded — the recompile "
+                    "tripwire check never ran")
+    if any(v != 0 for v in recompiles.values()):
+        return fail(f"unexpected serve recompiles across the swaps: "
+                    f"{recompiles}")
+    if evts("replica_crash"):
+        return fail("a replica crashed during the drill")
+
+    print(json.dumps({
+        "continual_smoke": "ok",
+        "requests": base["requests"] + pump.requests + tail["requests"],
+        "failed_interactive": 0,
+        "trace_mismatches": 0,
+        "drift_breaches": len(evts("drift_breach", model="m")),
+        "gen1_trees": gen1.num_iterations,
+        "promoted": 1,
+        "rolled_back": 1,
+        "recompiles_unexpected": recompiles,
+        "journal_events": len(evs),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

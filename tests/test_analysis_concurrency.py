@@ -526,6 +526,38 @@ def test_harness_detects_nonatomic_injector_fire(monkeypatch):
         "the non-atomic check-and-clear never double-fired"
 
 
+def test_harness_detects_unlocked_scheduler_admit(monkeypatch):
+    """r19: the retrain debounce's checks and its in-flight mark must be
+    ONE critical section — the mechanically reverted unlocked version
+    lets two concurrent breach deliveries both pass the checks before
+    either marks, double-launching the retrain; the
+    scheduler-breach-vs-push drill's exactly-once invariant catches it."""
+    from dryad_tpu.continual import scheduler as cmod
+
+    def racy_admit(self, model):
+        # the unlocked-streak shape: check, then mark, no critical section
+        now = cmod.time.monotonic()
+        if model in self._inflight:
+            return False, "in_flight", 0, 0
+        if len(self._inflight) >= self.max_concurrent:
+            return False, "budget", 0, 0
+        if now < self._cooldown_until.get(model, 0.0):
+            return False, "cooldown", 0, 0
+        if self._fails.get(model, 0) > self.policy.retry_budget:
+            return False, "retry_budget_exhausted", 0, 0
+        self._inflight.add(model)
+        gen = self._generation.get(model, 0) + 1
+        job = self._jobs
+        self._jobs += 1
+        return True, "", gen, job
+
+    monkeypatch.setattr(cmod.RetrainScheduler, "_admit", racy_admit)
+    seed = _first_failing_seed("scheduler-breach-vs-push", 100,
+                               extra_trace=("test_analysis_concurrency.py",))
+    assert seed is not None, \
+        "the unlocked debounce never double-launched a retrain"
+
+
 def test_harness_detects_recovery_blocking_the_monitor(monkeypatch):
     from dryad_tpu.fleet import supervisor as smod
 

@@ -1,0 +1,392 @@
+"""Continual boosting (r19): warm-start append training
+(``dryad.train(init_model=...)``), the retrain scheduler's debounce and
+profile gate, the probation publisher's promote/rollback state machine,
+and the generation artifact round-trips.
+
+The appended-model pins are the subsystem's bitwise anchor: a retrain is
+only trustworthy if the same corpus always yields the same generation —
+including through a mid-append fault and supervisor resume."""
+
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+import dryad_tpu as dryad
+from dryad_tpu.continual import (
+    JournalTailer,
+    ProbationPublisher,
+    RetrainScheduler,
+    model_has_profile,
+)
+from dryad_tpu.datasets import higgs_like
+from dryad_tpu.resilience import FaultInjector, RetryPolicy, RunJournal
+from dryad_tpu.resilience import faults as F
+from dryad_tpu.resilience import supervise_train
+
+PARAMS = dict(objective="binary", num_trees=6, num_leaves=7, max_bins=32,
+              seed=3, min_data_in_leaf=5)
+APPEND_TREES = 4
+
+
+@pytest.fixture(scope="module")
+def corpus():
+    X, y = higgs_like(1500, seed=21)
+    return X, y
+
+
+@pytest.fixture(scope="module")
+def base_model(corpus):
+    X, y = corpus
+    ds = dryad.Dataset(X, y, max_bins=32)
+    return dryad.train(PARAMS, ds, backend="cpu"), ds
+
+
+@pytest.fixture(scope="module")
+def fresh(corpus, base_model):
+    """Fresh rows binned into the BASE model's frozen bin space — the
+    only well-defined append corpus."""
+    X, y = higgs_like(1100, seed=77)
+    model, _ = base_model
+    return dryad.Dataset(X, y, mapper=model.mapper)
+
+
+# ---- warm-start append: the bitwise pins ------------------------------------
+
+def test_append_bitwise_reproducible(base_model, fresh):
+    model, _ = base_model
+    p = dict(PARAMS, num_trees=APPEND_TREES)
+    a = dryad.train(p, fresh, backend="cpu", init_model=model)
+    b = dryad.train(p, fresh, backend="cpu", init_model=model)
+    assert a.num_iterations == PARAMS["num_trees"] + APPEND_TREES
+    np.testing.assert_array_equal(a.feature, b.feature)
+    np.testing.assert_array_equal(a.threshold, b.threshold)
+    np.testing.assert_array_equal(a.value, b.value)
+    # the base model's trees are a strict prefix: an append never rewrites
+    # what is already serving
+    n0 = model.feature.shape[0]
+    np.testing.assert_array_equal(a.feature[:n0], model.feature)
+    np.testing.assert_array_equal(a.value[:n0], model.value)
+
+
+def test_append_zero_trees_is_identity(base_model, fresh, corpus):
+    """trees=0 is a pure re-wrap: predictions bitwise-identical to the
+    input model — in particular the carried base score must come from the
+    MODEL, not be re-derived from the fresh rows' label distribution."""
+    model, _ = base_model
+    X, _ = corpus
+    out = dryad.train(dict(PARAMS, num_trees=0), fresh, backend="cpu",
+                      init_model=model)
+    assert out.num_iterations == model.num_iterations
+    np.testing.assert_array_equal(model.predict(X), out.predict(X))
+    np.testing.assert_array_equal(
+        np.asarray(model.init_score, np.float32),
+        np.asarray(out.init_score, np.float32))
+
+
+def test_append_zero_trees_without_init_model_rejected(fresh):
+    with pytest.raises(ValueError, match="num_trees=0"):
+        dryad.train(dict(PARAMS, num_trees=0), fresh, backend="cpu")
+
+
+def test_append_kill_and_resume_bitwise(base_model, fresh, tmp_path):
+    """A faulted append resumes from checkpoint and finishes bitwise-equal
+    to the uninterrupted append — the retrain subprocess can die mid-run
+    without changing the generation it eventually ships."""
+    model, _ = base_model
+    p = dict(PARAMS, num_trees=APPEND_TREES)
+    reference = dryad.train(p, fresh, backend="cpu", init_model=model)
+    injector = FaultInjector([
+        (model.num_iterations + 2, F.DEVICE_UNAVAILABLE, "dispatch")])
+    jpath = str(tmp_path / "j.jsonl")
+    resumed = supervise_train(
+        p, fresh, backend="cpu", init_model=model,
+        checkpoint_dir=str(tmp_path / "ck"), checkpoint_every=1,
+        journal=jpath, fault_injector=injector,
+        policy=RetryPolicy(backoff_base_s=0.0))
+    assert injector.pending == 0
+    np.testing.assert_array_equal(reference.feature, resumed.feature)
+    np.testing.assert_array_equal(reference.threshold, resumed.threshold)
+    np.testing.assert_array_equal(reference.value, resumed.value)
+    resumes = [e for e in RunJournal.read(jpath) if e["event"] == "resume"]
+    # the retry continued PAST the warm start — it never redid the base
+    assert resumes and resumes[0]["from_iteration"] > model.num_iterations
+
+
+def test_append_rejects_foreign_bin_space(base_model, corpus):
+    model, _ = base_model
+    X, y = higgs_like(900, seed=91)
+    resketched = dryad.Dataset(X, y, max_bins=32)   # its OWN mapper
+    with pytest.raises(ValueError, match="frozen bin space"):
+        dryad.train(dict(PARAMS, num_trees=2), resketched, backend="cpu",
+                    init_model=model)
+
+
+def test_append_rejects_tree_geometry_change(base_model, fresh):
+    model, _ = base_model
+    with pytest.raises(ValueError, match="max_nodes"):
+        dryad.train(dict(PARAMS, num_trees=2, num_leaves=15), fresh,
+                    backend="cpu", init_model=model)
+
+
+# ---- generation artifacts ---------------------------------------------------
+
+def test_generation_roundtrips_both_formats(fresh, base_model, tmp_path,
+                                            monkeypatch):
+    """A generation ships through either model format with its OWN fresh
+    reference profile (the drift baseline the replicas monitor against)."""
+    monkeypatch.setenv("DRYAD_PROFILE", "1")
+    model, _ = base_model
+    gen = dryad.train(dict(PARAMS, num_trees=APPEND_TREES), fresh,
+                      backend="cpu", init_model=model)
+    assert gen.profile is not None
+    Xp = higgs_like(64, seed=1)[0]
+    native = str(tmp_path / "g.dryad")
+    text = str(tmp_path / "g.txt")
+    gen.save(native)
+    gen.save_text(text)
+    for path in (native, text):
+        back = dryad.Booster.load_any(path)
+        assert back.num_iterations == PARAMS["num_trees"] + APPEND_TREES
+        np.testing.assert_array_equal(gen.predict(Xp), back.predict(Xp))
+        assert back.profile is not None, path
+        assert model_has_profile(path)
+
+
+def test_model_has_profile_sniffs_without_jax(base_model, tmp_path,
+                                              monkeypatch):
+    """The scheduler's gate reads artifact metadata only — profile-less
+    (pre-r18) artifacts answer False in both formats."""
+    monkeypatch.setenv("DRYAD_PROFILE", "0")
+    model, ds = base_model
+    bare = dryad.train(dict(PARAMS, num_trees=2), ds, backend="cpu")
+    assert bare.profile is None
+    native, text = str(tmp_path / "b.dryad"), str(tmp_path / "b.txt")
+    bare.save(native)
+    bare.save_text(text)
+    assert not model_has_profile(native)
+    assert not model_has_profile(text)
+
+
+# ---- the retrain scheduler --------------------------------------------------
+
+class Rec:
+    """Recording journal callable (the FleetSupervisor.journal shape)."""
+
+    def __init__(self):
+        self.events = []
+
+    def __call__(self, kind, **fields):
+        self.events.append(dict(fields, event=kind))
+
+    def of(self, kind, **match):
+        return [e for e in self.events if e["event"] == kind
+                and all(e.get(k) == v for k, v in match.items())]
+
+
+def _sched(models, launch, journal, **kw):
+    kw.setdefault("policy", RetryPolicy(backoff_base_s=0.0, retry_budget=3))
+    kw.setdefault("has_profile", lambda p: True)
+    return RetrainScheduler(models, launch, journal=journal, **kw)
+
+
+def test_scheduler_skips_profileless_model(tmp_path, base_model, monkeypatch):
+    """A pre-r18 artifact (no embedded profile) is SKIPPED with a
+    journaled reason — no launch, no crash: there is no baseline to
+    retrain against, so the breach is for a human."""
+    monkeypatch.setenv("DRYAD_PROFILE", "0")
+    model, ds = base_model
+    path = str(tmp_path / "old.dryad")
+    dryad.train(dict(PARAMS, num_trees=2), ds, backend="cpu").save(path)
+    launched = []
+    rec = Rec()
+    rs = _sched({"legacy": path},
+                lambda m, g, j, a: launched.append(m) or (True, a, ""),
+                rec, has_profile=model_has_profile)
+    assert rs.trigger("legacy") is False
+    assert not launched
+    skips = rec.of("retrain_skipped", model="legacy", reason="no_profile")
+    assert len(skips) == 1
+    assert not rs.state()["inflight"]
+
+
+def test_scheduler_skips_unknown_and_unreadable(tmp_path):
+    rec = Rec()
+    rs = _sched({"m": str(tmp_path / "missing.dryad")},
+                lambda *a: (True, "x", ""), rec,
+                has_profile=model_has_profile)
+    assert rs.trigger("ghost") is False
+    assert rec.of("retrain_skipped", model="ghost", reason="unknown_model")
+    # the artifact does not exist: sniffing raises, the scheduler survives
+    assert rs.trigger("m") is False
+    assert any(e["reason"].startswith("artifact_unreadable")
+               for e in rec.of("retrain_skipped", model="m"))
+
+
+def test_scheduler_debounce_inflight_and_cooldown(tmp_path):
+    """One sustained breach = one retrain: concurrent duplicates fall to
+    in_flight, post-completion duplicates to cooldown."""
+    gate = threading.Event()
+    done = threading.Event()
+    launches = []
+
+    def launch(model, gen, job, artifact):
+        launches.append((model, gen, job))
+        gate.wait(10.0)
+        return True, f"{artifact}-g{gen}", ""
+
+    rec = Rec()
+    rs = _sched({"m": "art"}, launch, rec, cooldown_s=3600.0)
+    orig = rs._retrain_job
+
+    def tracked(*a, **kw):
+        try:
+            orig(*a, **kw)
+        finally:
+            done.set()
+
+    rs._retrain_job = tracked
+    assert rs.trigger("m") is True
+    assert rs.trigger("m") is False          # worker still holds in_flight
+    assert rec.of("retrain_skipped", model="m", reason="in_flight")
+    gate.set()
+    assert done.wait(10.0)
+    assert rs.trigger("m") is False          # now inside the cooldown
+    assert rec.of("retrain_skipped", model="m", reason="cooldown")
+    assert launches == [("m", 1, 0)]
+    assert len(rec.of("retrain_triggered", model="m")) == 1
+    assert len(rec.of("retrain_complete", model="m", generation=1)) == 1
+    rs.stop(timeout_s=5.0)
+
+
+def test_scheduler_failure_backoff_and_budget():
+    """Launch failures journal retrain_failed, arm the per-model backoff,
+    and a spent retry budget stops the scheduler from flapping."""
+    rec = Rec()
+    rs = _sched({"m": "art"}, lambda *a: (False, None, "rc=9"), rec,
+                cooldown_s=0.0,
+                policy=RetryPolicy(backoff_base_s=0.0, backoff_max_s=0.0,
+                                   retry_budget=1))
+    for _ in range(4):
+        rs.trigger("m")
+        deadline = 100                        # wait the worker out
+        while rs.state()["inflight"] and deadline:
+            deadline -= 1
+            threading.Event().wait(0.05)
+    fails = rec.of("retrain_failed", model="m")
+    assert fails and all(e["detail"] == "rc=9" for e in fails)
+    # budget exhausted: later triggers are skipped, not launched
+    assert rec.of("retrain_skipped", model="m",
+                  reason="retry_budget_exhausted")
+    assert rs.state()["generation"].get("m", 0) == 0
+
+
+def test_journal_tailer_incremental_partial_lines(tmp_path):
+    path = str(tmp_path / "j.jsonl")
+    t = JournalTailer(path)
+    assert t() == []                          # nothing yet: not an error
+    with open(path, "a") as f:
+        f.write(json.dumps({"event": "a"}) + "\n")
+        f.write('{"event": "b", "tr')         # torn mid-record
+    got = t()
+    assert [e["event"] for e in got] == ["a"]
+    with open(path, "a") as f:
+        f.write('uncated": 1}\n')
+        f.write("not json at all\n")
+        f.write(json.dumps({"event": "c"}) + "\n")
+    got = t()                                 # the torn line heals whole
+    assert [e["event"] for e in got] == ["b", "c"]
+    assert t() == []
+
+
+# ---- the probation publisher ------------------------------------------------
+
+def _verdict(rows=128, breached=False, sustained=False, psi=0.05):
+    return {"rows": rows, "breached": breached, "sustained": sustained,
+            "psi_max": psi, "score_psi": 0.0, "streak": 0, "top": []}
+
+
+def _publisher(push, feed, rec, **kw):
+    it = iter(feed)
+
+    def verdicts():
+        return {"m": next(it)}
+
+    kw.setdefault("probation_polls", len(feed))
+    kw.setdefault("poll_interval_s", 0.0)
+    return ProbationPublisher(push, verdicts, journal=rec, **kw)
+
+
+def test_publisher_promotes_on_clear(tmp_path):
+    rec = Rec()
+    pushes = []
+    pub = _publisher(lambda p, m: pushes.append(p) or (True, ""),
+                     [_verdict(rows=0), _verdict(), _verdict()], rec,
+                     clear_after=2)
+    out = pub.publish("gen1", model="m", prior_path="gen0", generation=1)
+    assert out == "promoted"
+    assert pushes == ["gen1"]                 # promote never re-pushes
+    assert rec.of("push_probation", model="m", generation=1)
+    promo = rec.of("generation_promoted", model="m", generation=1)
+    assert len(promo) == 1 and promo[0]["path"] == "gen1"
+
+
+def test_publisher_rolls_back_bad_generation():
+    """Prior clean + pushed generation sustains a breach => the PRIOR
+    ARTIFACT is re-pushed through the same rolling machinery — the
+    registry is never mutated in place."""
+    rec = Rec()
+    pushes = []
+    feed = [_verdict(),                                    # prior: clean
+            _verdict(breached=True, psi=0.9),
+            _verdict(breached=True, sustained=True, psi=0.9)]
+    pub = _publisher(lambda p, m: pushes.append(p) or (True, ""), feed, rec)
+    out = pub.publish("gen2", model="m", prior_path="gen1", generation=2)
+    assert out == "rolled_back"
+    assert pushes == ["gen2", "gen1"]         # the rollback IS a re-push
+    rb = rec.of("generation_rolled_back", model="m", generation=2)
+    assert len(rb) == 1
+    assert rb[0]["prior"] == "gen1" and rb[0]["restore_ok"] is True
+    assert not rec.of("generation_promoted", model="m", generation=2)
+
+
+def test_publisher_no_rollback_when_prior_was_dirty():
+    """If the PREDECESSOR was already breaching at push time, a breach in
+    probation proves nothing against the new generation — rolling back
+    to a known-bad model would flap forever."""
+    rec = Rec()
+    pushes = []
+    feed = ([_verdict(breached=True, sustained=True)]      # prior: dirty
+            + [_verdict(breached=True, sustained=True)] * 3)
+    pub = _publisher(lambda p, m: pushes.append(p) or (True, ""), feed, rec)
+    out = pub.publish("gen1", model="m", prior_path="gen0", generation=1)
+    assert out == "promoted"                  # window expired, kept
+    assert pushes == ["gen1"]
+    promo = rec.of("generation_promoted", model="m", generation=1)
+    assert len(promo) == 1 and promo[0]["verdict"] == "expired"
+    assert not rec.of("generation_rolled_back")
+
+
+def test_publisher_push_failure_is_terminal():
+    rec = Rec()
+    pub = _publisher(lambda p, m: (False, "drain timeout"), [_verdict()],
+                     rec)
+    out = pub.publish("gen1", model="m", prior_path="gen0", generation=1)
+    assert out == "push_failed"
+    assert rec.of("push_failed", model="m", generation=1)
+    assert not rec.of("push_probation")
+
+
+def test_publisher_empty_windows_do_not_clear():
+    """rows == 0 is no evidence — a generation must not promote off an
+    idle fleet's empty drift windows."""
+    rec = Rec()
+    feed = [_verdict()] + [_verdict(rows=0)] * 3
+    pub = _publisher(lambda p, m: (True, ""), feed, rec, clear_after=1,
+                     probation_polls=3)
+    out = pub.publish("gen1", model="m", prior_path="gen0", generation=1)
+    assert out == "promoted"
+    assert rec.of("generation_promoted", model="m",
+                  generation=1)[0]["verdict"] == "expired"

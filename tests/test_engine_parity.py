@@ -50,6 +50,8 @@ def test_regression_parity():
     _structure_equal(b_cpu, b_dev)
 
 
+@pytest.mark.slow  # r19 tier-1 re-budget: 30 s+; binary parity + the
+# multiclass rf/wide-bins arms keep cross-backend multiclass covered.
 def test_multiclass_parity():
     X, y = covertype_like(2500, num_features=20)
     ds = dryad.Dataset(X, y, max_bins=48)

@@ -36,7 +36,12 @@ from dryad_tpu.engine.split import (
     pack_local_split,
 )
 
-pytestmark = pytest.mark.distributed
+# r19: slow — interpret-mode sharded compute on the 8-fake-device
+# mesh pays the virtual-collective overhead in Python; on the 2-core
+# CI container this module helped push tier-1 past its 870 s budget.
+# ci.sh tier-1 runs `-m 'not slow'`; run this module explicitly (or
+# the full unfiltered suite) on a wider host when touching it.
+pytestmark = [pytest.mark.distributed, pytest.mark.slow]
 
 
 # ---------------------------------------------------------------------------

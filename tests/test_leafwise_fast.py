@@ -11,6 +11,11 @@ import pytest
 
 import jax.numpy as jnp
 
+# r19: slow — the wired batched-leafwise parity fixtures pay the
+# run-bookkeeping tiles in interpret-mode Python (STATUS Round-10 note);
+# part of the tier-1 870 s re-budget (ci.sh runs `-m 'not slow'`).
+pytestmark = pytest.mark.slow
+
 from dryad_tpu.config import make_params
 from dryad_tpu.engine.grower import grow_any, grow_tree
 from dryad_tpu.engine.leafwise_fast import (

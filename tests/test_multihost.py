@@ -2,9 +2,15 @@
 the same shard_map/psum code paths run in CI with no TPU)."""
 
 import numpy as np
+import pytest
 
 import dryad_tpu as dryad
 from dryad_tpu.datasets import higgs_like
+# r19: slow — the mocked multi-host drills replay the sharded interpret
+# paths across 8 fake devices; part of the tier-1 870 s re-budget
+# (ci.sh runs `-m 'not slow'`; run explicitly when touching distributed/).
+pytestmark = pytest.mark.slow
+
 from dryad_tpu.distributed import (
     global_mesh,
     host_row_range,

@@ -174,6 +174,7 @@ def test_rf_early_stopping_allowed(data):
         assert not np.array_equal(raw_best, raw_all)
 
 
+@pytest.mark.slow  # r19 tier-1 re-budget: K-class rf trains 30 s+ on CI
 def test_rf_multiclass(data):
     from dryad_tpu.datasets import covertype_like
 
