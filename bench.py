@@ -564,6 +564,29 @@ def main() -> None:
         if probe:
             out.update(probe)
 
+    # ---- out-of-core streamed-training arm (r20) ----------------------------
+    # Resident-vs-streamed CPU walls + bitwise check via the standalone
+    # probe (pure host work — run as a subprocess so its RSS accounting
+    # and numpy temporaries never contaminate the TPU walls above).  The
+    # 1e7-row RSS proof is heavy; opt in with BENCH_STREAM_RSS=1 or run
+    # scripts/stream_rss_probe.py directly.  BENCH_STREAM=0 skips.
+    if os.environ.get("BENCH_STREAM", "1") != "0":
+        import subprocess as _sp
+        import sys as _sys
+
+        argv = [_sys.executable,
+                os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "scripts", "stream_rss_probe.py")]
+        if os.environ.get("BENCH_STREAM_RSS", "0") != "1":
+            argv.append("--skip-rss")
+        r = _sp.run(argv, capture_output=True, text=True)
+        if r.returncode == 0 and r.stdout.strip():
+            probe = json.loads(r.stdout.strip().splitlines()[-1])
+            out.update({k: v for k, v in probe.items()
+                        if k.startswith(("stream_", "resident_"))})
+        else:
+            out["stream_probe_error"] = (r.stderr or "").strip()[-400:]
+
     print(json.dumps(out))
 
 

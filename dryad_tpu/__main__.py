@@ -475,30 +475,69 @@ def cmd_retrain(args) -> int:
                                              injector_from_env)
 
     model = dryad.Booster.load_any(args.model)
-    z = np.load(args.data)
-    if "X" not in z.files or "y" not in z.files:
-        raise SystemExit(f"--data {args.data!r} must be an npz with X and y")
-    X = np.asarray(z["X"], np.float32)
-    y = np.asarray(z["y"])
 
     injector = injector_from_env(env_var=CONTINUAL_FAULTS_ENV)
     fault_fired = None
+    scale = None
     if injector is not None:
         pt = injector.take("retrain", args.job_index)
         if pt is not None and pt.kind == BAD_GENERATION:
             # the poisoned-pipeline twin: scale the covariates so the
             # generation's fresh profile is built on rows live traffic
             # never resembles
-            X = X * np.float32(0.25)
+            scale = np.float32(0.25)
             fault_fired = pt.kind
 
-    if args.refit_decay:
-        # re-weight the OLD trees' leaves toward the fresh rows first,
-        # then append — structure is kept, so the frozen bin space and
-        # tree geometry still match for the warm start
-        model = model.refit(X, y, decay_rate=args.refit_decay)
+    if os.path.isdir(args.data):
+        # chunked corpus: a directory of npz shards (each with X/y, bound
+        # by sorted filename) streamed through the model's frozen mapper
+        # into an on-disk spill — drift-triggered retrains work on
+        # corpora that never fit in RAM as a single npz (Issue 17)
+        from dryad_tpu.data.streaming import dataset_from_chunks
 
-    ds = dryad.Dataset(X, y, mapper=model.mapper)
+        if args.refit_decay:
+            raise SystemExit(
+                "--refit-decay needs a resident npz corpus (refit rebinning "
+                "touches every raw row at once); drop it or pass one npz")
+        shards = sorted(
+            os.path.join(args.data, f) for f in os.listdir(args.data)
+            if f.endswith(".npz"))
+        if not shards:
+            raise SystemExit(f"--data {args.data!r} holds no .npz shards")
+        ys = []
+        for s in shards:
+            with np.load(s) as z:
+                if "X" not in z.files or "y" not in z.files:
+                    raise SystemExit(f"shard {s!r} must hold X and y")
+                ys.append(np.asarray(z["y"]))
+        y = np.concatenate(ys)
+
+        def corpus_chunks():
+            for s in shards:
+                with np.load(s) as z:
+                    Xc = np.asarray(z["X"], np.float32)
+                yield Xc if scale is None else Xc * scale
+
+        spill_path = args.out + ".bins"
+        ds = dataset_from_chunks(
+            corpus_chunks, y, int(y.shape[0]), model.mapper.num_features,
+            mapper=model.mapper, spill=spill_path)
+    else:
+        z = np.load(args.data)
+        if "X" not in z.files or "y" not in z.files:
+            raise SystemExit(f"--data {args.data!r} must be an npz with X and y")
+        X = np.asarray(z["X"], np.float32)
+        y = np.asarray(z["y"])
+        if scale is not None:
+            X = X * scale
+
+        if args.refit_decay:
+            # re-weight the OLD trees' leaves toward the fresh rows first,
+            # then append — structure is kept, so the frozen bin space and
+            # tree geometry still match for the warm start
+            model = model.refit(X, y, decay_rate=args.refit_decay)
+
+        ds = dryad.Dataset(X, y, mapper=model.mapper)
     p = model.params.replace(num_trees=args.trees)
 
     if args.supervise:
@@ -512,6 +551,13 @@ def cmd_retrain(args) -> int:
                                   init_model=model)
     else:
         booster = dryad.train(p, ds, backend=args.backend, init_model=model)
+
+    if getattr(ds, "is_streamed", False):
+        # the spill is a training temporary, not part of the generation
+        try:
+            os.unlink(ds.path)
+        except OSError:
+            pass
 
     if args.text:
         booster.save_text(args.out)
@@ -812,8 +858,9 @@ def main(argv=None) -> int:
                     help="served artifact to warm-start from (binary or "
                          "text format)")
     rt.add_argument("--data", required=True,
-                    help="fresh rows: an .npz with X and y (binned through "
-                         "the model's frozen mapper)")
+                    help="fresh rows: an .npz with X and y, or a DIRECTORY "
+                         "of .npz shards streamed out-of-core (both binned "
+                         "through the model's frozen mapper)")
     rt.add_argument("--out", required=True, help="new-generation artifact path")
     rt.add_argument("--trees", type=int, default=20,
                     help="NEW trees to append (0 = a no-op generation, "
@@ -913,10 +960,11 @@ def main(argv=None) -> int:
                     help="bearer token for router AND replicas "
                          "(/healthz stays open)")
     fl.add_argument("--continual-data", default=None,
-                    help="arm continual boosting: fresh rows (.npz with "
-                         "X/y) each drift-triggered retrain appends on; "
-                         "requires --journal and NAME=path model specs "
-                         "(dryad_tpu/continual)")
+                    help="arm continual boosting: fresh rows each drift-"
+                         "triggered retrain appends on — an .npz with X/y "
+                         "or a directory of .npz shards (streamed out-of-"
+                         "core by the retrain worker); requires --journal "
+                         "and NAME=path model specs (dryad_tpu/continual)")
     fl.add_argument("--continual-out", default=None,
                     help="generation artifact dir (default: "
                          "<journal dir>/continual)")

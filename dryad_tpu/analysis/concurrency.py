@@ -63,10 +63,13 @@ from typing import Iterable, Optional
 from dryad_tpu.analysis.lint import Rule, Violation, register
 from dryad_tpu.analysis.rules import dotted
 
-#: the threaded host plane — the four packages the schedule harness drills
+#: the threaded host plane — the packages the schedule harness drills
+#: (r20 adds the data plane's chunk prefetcher: the one threaded class
+#: outside the serve/fleet stack)
 TARGETS = ("dryad_tpu/continual/**", "dryad_tpu/fleet/**",
            "dryad_tpu/serve/**",
-           "dryad_tpu/obs/**", "dryad_tpu/resilience/**")
+           "dryad_tpu/obs/**", "dryad_tpu/resilience/**",
+           "dryad_tpu/data/stream_dataset.py")
 
 LOCK_ORDER_GOLDENS = "dryad_tpu/analysis/goldens/lock_order.json"
 

@@ -1332,6 +1332,71 @@ def drill_scheduler_breach_vs_push(sched: Scheduler):
     return check
 
 
+def drill_stream_prefetch(sched: Scheduler):
+    """r20 Issue-17 data plane: ChunkPrefetcher producer-vs-consumer-vs-
+    cancel.  Three REAL prefetchers run under line preemption: (a) a full
+    sweep that must see every (i, chunk) pair exactly once, in order and
+    untorn; (b) an early-abandon consumer whose mid-stream close() must
+    unwedge a producer racing a depth-1 queue and reap its thread — the
+    drain-outside-the-lock + cancellable-put contract (mechanically
+    reverting the cancellable put wedges this arm on the sentinel put);
+    (c) a reader that raises mid-stream — the error must surface in the
+    consumer, never vanish into the producer thread."""
+    from dryad_tpu.data.stream_dataset import ChunkPrefetcher
+
+    state = {"full": [], "cancelled": False, "error": None}
+
+    def full_sweep() -> None:
+        pf = ChunkPrefetcher(lambda i: [i] * 4, 6, depth=2)
+        try:
+            for i, chunk in pf:
+                assert chunk == [i] * 4, f"torn chunk pairing: {i}, {chunk}"
+                state["full"].append(i)
+        finally:
+            pf.close()
+        assert not pf._thread.is_alive(), "full-sweep producer leaked"
+
+    def cancel_midstream() -> None:
+        # 50 chunks against a depth-1 queue: the producer is essentially
+        # always one put ahead, blocked, when close() lands
+        pf = ChunkPrefetcher(lambda i: [i] * 4, 50, depth=1)
+        it = iter(pf)
+        next(it)
+        pf.close()
+        assert not pf._thread.is_alive(), (
+            "close() left the producer wedged on the full queue")
+        state["cancelled"] = True
+
+    def error_stream() -> None:
+        def read(i: int):
+            if i == 2:
+                raise RuntimeError("disk gone")
+            return [i] * 4
+
+        pf = ChunkPrefetcher(read, 5, depth=2)
+        got = []
+        try:
+            for i, _chunk in pf:
+                got.append(i)
+        except RuntimeError as e:
+            state["error"] = str(e)
+        finally:
+            pf.close()
+        assert got == [0, 1], got
+
+    sched.spawn(full_sweep, "full-sweep")
+    sched.spawn(cancel_midstream, "cancel-midstream")
+    sched.spawn(error_stream, "error-stream")
+
+    def check() -> None:
+        assert state["full"] == list(range(6)), (
+            f"chunks lost/reordered/duplicated: {state['full']}")
+        assert state["cancelled"], "mid-stream close never completed"
+        assert state["error"] == "disk gone", state["error"]
+
+    return check
+
+
 #: name -> (drill, schedules to run in CI, preempt_p, trace file suffixes)
 DRILLS: dict = {
     "batcher-stop-start": (drill_batcher_stop_start, 20, 0.1,
@@ -1350,6 +1415,8 @@ DRILLS: dict = {
                                  ("resilience/faults.py",)),
     "scheduler-breach-vs-push": (drill_scheduler_breach_vs_push, 10, 0.1,
                                  ("continual/scheduler.py",)),
+    "stream-prefetch": (drill_stream_prefetch, 15, 0.25,
+                        ("data/stream_dataset.py",)),
 }
 
 

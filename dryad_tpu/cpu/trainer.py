@@ -40,6 +40,43 @@ from dryad_tpu.obs.spans import record as record_span
 from dryad_tpu.obs.spans import span
 
 
+def _binned_or_view(ds: Dataset):
+    """The trainer's matrix handle: the resident ``X_binned`` array, or a
+    StreamedDataset's bounded-read stand-in (identical access semantics on
+    the patterns this file uses — see data/stream_dataset._StreamedMatrix)."""
+    return ds.binned_view() if getattr(ds, "is_streamed", False) else ds.X_binned
+
+
+def tree_leaves_any(trees, Xb, t: int, depth_bound: int) -> np.ndarray:
+    """``predict_tree_leaves`` over a resident matrix OR a streamed view.
+
+    The traversal is row-elementwise, so invoking it per chunk and
+    concatenating is bitwise the resident result — full-sweep score
+    updates/replays stay exact without ever materializing (N, F)."""
+    it = getattr(Xb, "iter_chunks", None)
+    if it is None:
+        return predict_tree_leaves(trees, Xb, t, depth_bound)
+    leaves = np.empty(Xb.shape[0], np.int64)
+    for lo, hi, buf in it():
+        leaves[lo:hi] = predict_tree_leaves(trees, buf, t, depth_bound)
+    return leaves
+
+
+def tree_leaves_rows(trees, Xb, rows: np.ndarray, t: int,
+                     depth_bound: int) -> np.ndarray:
+    """Leaves for a row SUBSET: positional chunking of ``rows`` keeps a
+    streamed gather bounded (a near-full bag would otherwise materialize
+    (N, F)); resident matrices take the plain fancy-index path."""
+    if getattr(Xb, "iter_chunks", None) is None:
+        return predict_tree_leaves(trees, Xb[rows], t, depth_bound)
+    step = max(1, int(getattr(Xb, "chunk_rows", 1 << 20)))
+    lv = np.empty(rows.size, np.int64)
+    for s in range(0, rows.size, step):
+        lv[s:s + step] = predict_tree_leaves(
+            trees, Xb[rows[s:s + step]], t, depth_bound)
+    return lv
+
+
 def goss_uniform(params: Params, iteration: int, num_rows: int) -> np.ndarray:
     """Per-iteration uniforms for the GOSS Bernoulli pick: a counter-based
     murmur3-finalizer hash of (seed, iteration, row id).
@@ -399,7 +436,7 @@ def train_cpu(
     start, ``"fetch"`` at each checkpoint/final materialization — the
     sites the supervised-run fault classes attach to."""
     p = params.validate()
-    Xb = data.X_binned
+    Xb = _binned_or_view(data)
     y = data.y
     N, F = Xb.shape
     B = data.mapper.total_bins
@@ -453,7 +490,7 @@ def train_cpu(
                 "rf predictions AVERAGE the trees, so a mixed tree table "
                 "has no sound aggregation")
         for t in range(prev.num_total_trees):
-            leaves = predict_tree_leaves(prev.tree_arrays(), Xb, t, prev.max_depth_seen)
+            leaves = tree_leaves_any(prev.tree_arrays(), Xb, t, prev.max_depth_seen)
             score[:, t % K] += prev.value[t, leaves]
         for k_arr in out:
             out[k_arr][: prev.num_total_trees] = prev.tree_arrays()[k_arr]
@@ -463,7 +500,7 @@ def train_cpu(
     # validation / early stopping state (SURVEY.md §5 metrics stream);
     # every set is scored, the FIRST drives early stopping
     valids = normalize_valids(valid)
-    vXbs = [v.X_binned for _, v in valids]
+    vXbs = [_binned_or_view(v) for _, v in valids]
     vscores = [
         np.broadcast_to(init, (vXb.shape[0], K)).astype(np.float32).copy()
         for vXb in vXbs
@@ -477,7 +514,7 @@ def train_cpu(
         # resume continues the eval/early-stop state exactly where it stopped
         for vXb, vscore in zip(vXbs, vscores):
             for t in range(init_booster.num_total_trees):
-                vleaves = predict_tree_leaves(
+                vleaves = tree_leaves_any(
                     init_booster.tree_arrays(), vXb, t, init_booster.max_depth_seen)
                 vscore[:, t % K] += init_booster.value[t, vleaves]
         if p.boosting != "dart":
@@ -551,7 +588,7 @@ def train_cpu(
             for d_it in drop:
                 for c in range(K):
                     td = int(d_it) * K + c
-                    lv = predict_tree_leaves(out, Xb, td, max(max_depth_seen, 1))
+                    lv = tree_leaves_any(out, Xb, td, max(max_depth_seen, 1))
                     dcontrib[:, c] += out["value"][td, lv]
             # gradients see the pruned ensemble; the CARRIED scores are
             # rebuilt below by the exact replay-sum a resumed run computes,
@@ -577,18 +614,18 @@ def train_cpu(
             d = grower.grow(grads[:, k], hess[:, k], rows, feat_mask, out, t)
             max_depth_seen = max(max_depth_seen, d)
             if renew_a is not None:
-                lv = predict_tree_leaves(out, Xb[rows], t,
-                                         max(max_depth_seen, 1))
+                lv = tree_leaves_rows(out, Xb, rows, t,
+                                      max(max_depth_seen, 1))
                 r = (y[rows] - score[rows, k]).astype(np.float32)
                 renew_leaf_values_np(out, t, r, lv, renew_a,
                                      p.effective_learning_rate)
             if value_scale != 1.0:
                 out["value"][t] *= value_scale
             if not drop.size:
-                leaves = predict_tree_leaves(out, Xb, t, max(max_depth_seen, 1))
+                leaves = tree_leaves_any(out, Xb, t, max(max_depth_seen, 1))
                 score[:, k] += out["value"][t, leaves]
                 for vXb, vscore in zip(vXbs, vscores):
-                    vleaves = predict_tree_leaves(out, vXb, t, max(max_depth_seen, 1))
+                    vleaves = tree_leaves_any(out, vXb, t, max(max_depth_seen, 1))
                     vscore[:, k] += out["value"][t, vleaves]
         if _t_grow is not None:
             record_span("train.grow", time.perf_counter() - _t_grow)
@@ -598,12 +635,12 @@ def train_cpu(
             # run would rebuild from the checkpointed value table
             score = np.broadcast_to(init, (N, K)).astype(np.float32).copy()
             for t2 in range((it + 1) * K):
-                lv = predict_tree_leaves(out, Xb, t2, max(max_depth_seen, 1))
+                lv = tree_leaves_any(out, Xb, t2, max(max_depth_seen, 1))
                 score[:, t2 % K] += out["value"][t2, lv]
             for vi, vXb in enumerate(vXbs):
                 vs = np.broadcast_to(init, (vXb.shape[0], K)).astype(np.float32).copy()
                 for t2 in range((it + 1) * K):
-                    vlv = predict_tree_leaves(out, vXb, t2, max(max_depth_seen, 1))
+                    vlv = tree_leaves_any(out, vXb, t2, max(max_depth_seen, 1))
                     vs[:, t2 % K] += out["value"][t2, vlv]
                 vscores[vi] = vs
 

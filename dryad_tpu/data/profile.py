@@ -169,5 +169,14 @@ def build_reference_profile(booster, train_set, valid_sets=None, *,
     for item in (valid_sets or [])[:1]:
         ds = item[1] if isinstance(item, tuple) else item
         valid_binned["valid"] = ds.X_binned
-    return profile_from_binned(booster, train_set.X_binned, valid_binned,
+    if getattr(train_set, "is_streamed", False):
+        # the streamed stride sample is exactly X_binned[::stride] read
+        # chunk-by-chunk, so streamed-trained models embed bitwise the
+        # same reference profile as resident-trained ones
+        n = int(train_set.num_rows)
+        stride = 1 if n <= int(max_rows) else -(-n // int(max_rows))
+        Xb_train = train_set.strided_rows(stride)
+    else:
+        Xb_train = train_set.X_binned
+    return profile_from_binned(booster, Xb_train, valid_binned,
                                max_rows=max_rows)

@@ -1,0 +1,419 @@
+"""Streamed (out-of-core) binned dataset: row-chunked tiles on disk.
+
+The binned matrix lives in ONE raw on-disk file (row-major ``(N, F)``
+``uint8``/``uint16``, no header — offset math is ``row * F * itemsize``)
+and is served in bounded row-range reads.  Everything else (labels,
+weights, groups, the frozen mapper) stays resident — at Criteo scale the
+binned matrix is what doesn't fit, not the 4-byte-per-row label vector.
+
+Exactness contract (the Issue-17 headline): streamed ≡ resident training
+**bitwise**.  The CPU trainer reaches the matrix through
+``binned_view()``, whose gathers return arrays elementwise identical to
+resident slices — so ``cpu/histogram.build_hist``'s own positional
+chunking (and therefore every f64 fold order) is preserved exactly, and
+exactness holds by construction rather than by an associativity
+argument.  The engine arm assembles the device-resident matrix
+chunk-by-chunk through ``device_arrays()`` (prefetcher reads chunk i+1
+from disk while chunk i's async ``device_put`` is in flight) and then
+dispatches the UNCHANGED jitted programs: out-of-HOST-core, with traced
+programs — and their audit goldens — untouched.  Chunking-invariant
+subsampling (sketch/GOSS/bagging keyed on global row id) does the rest.
+
+``ChunkPrefetcher`` is the serve batcher's two-deep pipeline idiom as a
+data-plane producer: one reader thread, a bounded queue, reads outside
+any lock, cancel-safe drain on close.  It is schedule-drill covered
+(``analysis/schedules.py`` ``stream-prefetch``) and in dryadlint's
+concurrency-target set.
+"""
+
+from __future__ import annotations
+
+import mmap
+import os
+import queue
+import threading
+from typing import Callable, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from dryad_tpu.data.sketch import BinMapper
+from dryad_tpu.dataset import Dataset
+
+#: default rows per streamed chunk (~64 MB of u8 bins at F=64)
+DEFAULT_CHUNK_ROWS = 1 << 20
+
+_DONE = object()  # producer sentinel: the stream ended (exhausted or error)
+
+
+class ChunkPrefetcher:
+    """Bounded single-producer chunk pipeline (the two-deep idiom).
+
+    A daemon thread calls ``read(i)`` for ``i in range(n_chunks)`` —
+    always OUTSIDE any lock — and feeds a ``queue.Queue(maxsize=depth)``;
+    iterating the prefetcher yields ``(i, chunk)`` in order, so chunk
+    ``i+1``'s read overlaps the consumer's work on chunk ``i``.
+    ``close()`` is cancel-safe from the consumer side at any point: it
+    flips the stop flag, drains the queue so a producer blocked on a full
+    queue can observe the flag, and joins the thread.  Read errors are
+    captured and re-raised in the consumer.
+    """
+
+    GUARDED_BY = {"_closed": "_lock", "_error": "_lock"}
+
+    def __init__(self, read: Callable[[int], np.ndarray], n_chunks: int,
+                 depth: int = 2):
+        self._read = read
+        self._n = int(n_chunks)
+        self._q: "queue.Queue" = queue.Queue(maxsize=max(1, int(depth)))
+        self._lock = threading.Lock()
+        self._closed = False
+        self._error: Optional[BaseException] = None
+        self._thread = threading.Thread(
+            target=self._produce, name="dryad-chunk-prefetch", daemon=True)
+        self._thread.start()
+
+    def _stopped(self) -> bool:
+        with self._lock:
+            return self._closed
+
+    def _put_cancellable(self, item) -> bool:
+        """Timeout-put loop so a full queue never wedges the producer past
+        a close(); True when the item landed, False on cancellation."""
+        while True:
+            if self._stopped():
+                return False
+            try:
+                self._q.put(item, timeout=0.05)
+                return True
+            except queue.Full:
+                continue
+
+    def _produce(self) -> None:
+        try:
+            for i in range(self._n):
+                if self._stopped():
+                    return
+                chunk = self._read(i)          # disk I/O outside any lock
+                if not self._put_cancellable((i, chunk)):
+                    return
+        except BaseException as e:             # re-raised in the consumer
+            with self._lock:
+                self._error = e
+        finally:
+            self._put_cancellable(_DONE)
+
+    def __iter__(self) -> Iterator[Tuple[int, np.ndarray]]:
+        delivered = 0
+        while delivered < self._n:
+            if self._stopped():
+                break
+            try:
+                item = self._q.get(timeout=0.05)
+            except queue.Empty:
+                continue
+            if item is _DONE:
+                break
+            delivered += 1
+            yield item
+        with self._lock:
+            err = self._error
+        if err is not None:
+            raise err
+
+    def close(self) -> None:
+        with self._lock:
+            already = self._closed
+            self._closed = True
+        if already:
+            return
+        # drain OUTSIDE the lock: a producer blocked on the full queue
+        # needs the space (or the timeout) to observe the stop flag
+        while True:
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                break
+        self._thread.join(timeout=10.0)
+
+
+class _StreamedMatrix:
+    """Read-only stand-in for the resident ``(N, F)`` binned matrix.
+
+    Serves exactly the access patterns the CPU trainer uses — ``Xb[rows]``
+    and ``Xb[rows, col]`` with ASCENDING ``rows`` index arrays, plus
+    ``.shape``/``.dtype`` — via bounded per-chunk range reads: a gather
+    touches only the sub-range ``[rows[i0], rows[i1-1]]`` of each data
+    chunk it spans, so nothing larger than one chunk's rows is ever
+    resident.  Returned arrays are elementwise identical to resident
+    slices, which is what makes every downstream computation (histogram
+    fold order included) bitwise unchanged.
+    """
+
+    def __init__(self, ds: "StreamedDataset"):
+        self._ds = ds
+        self.shape = (ds.num_rows, ds.num_features)
+        self.dtype = ds.bin_dtype
+        self.chunk_rows = ds.chunk_rows
+
+    def __len__(self) -> int:
+        return self.shape[0]
+
+    def iter_chunks(self, prefetch: int = 2):
+        """Delegate to the dataset's chunk stream (full-sweep consumers)."""
+        return self._ds.iter_chunks(prefetch)
+
+    def __getitem__(self, key):
+        col: Optional[int] = None
+        if isinstance(key, tuple):
+            if len(key) != 2:
+                raise TypeError("streamed matrix supports [rows] and [rows, col]")
+            key, col = key
+            col = int(col)
+        rc = np.asarray(key)
+        if rc.ndim != 1 or not np.issubdtype(rc.dtype, np.integer):
+            raise TypeError(
+                "streamed matrix gathers take a 1-D integer row-index array "
+                f"(got {rc.dtype if hasattr(rc, 'dtype') else type(key)})")
+        rc = rc.astype(np.int64, copy=False)
+        ds = self._ds
+        if rc.size == 0:
+            return np.empty((0, ds.num_features) if col is None else 0, self.dtype)
+        if rc[0] < 0 or rc[-1] >= ds.num_rows:
+            raise IndexError("row index out of range")
+        if rc.size > 1 and not bool((np.diff(rc) >= 0).all()):
+            # searchsorted below would silently mis-gather on unsorted rows;
+            # every trainer row set is an ascending subset by construction
+            raise ValueError("streamed matrix gathers require ascending rows")
+        out = np.empty((rc.size, ds.num_features) if col is None else rc.size,
+                       self.dtype)
+        for lo, hi in ds._chunk_bounds():
+            i0 = int(np.searchsorted(rc, lo, side="left"))
+            i1 = int(np.searchsorted(rc, hi, side="left"))
+            if i0 == i1:
+                continue
+            lo2, hi2 = int(rc[i0]), int(rc[i1 - 1]) + 1
+            buf = ds.read_rows(lo2, hi2)
+            idx = rc[i0:i1] - lo2
+            out[i0:i1] = buf[idx] if col is None else buf[idx, col]
+        return out
+
+
+class StreamedDataset(Dataset):
+    """Dataset whose binned matrix is a row-chunked file on disk.
+
+    Built by ``dataset_from_chunks(..., spill=path)`` /
+    ``dataset_from_csr_chunks(..., spill=path)`` (the mapper sketch and
+    two-pass keying are identical to the resident path) or spilled from a
+    resident Dataset via ``from_dataset``.  Labels/weights/groups stay
+    resident; ``X_binned`` is deliberately NOT materializable through the
+    attribute (use ``binned_view()`` / ``read_rows()`` / ``iter_chunks()``
+    / ``materialize()``).
+    """
+
+    is_streamed = True
+
+    def __init__(self, path, mapper: BinMapper, y=None, *,
+                 weight=None, group=None,
+                 categorical_features: Sequence[int] = (),
+                 num_rows: Optional[int] = None,
+                 chunk_rows: int = DEFAULT_CHUNK_ROWS):
+        self.categorical_features = tuple(int(c) for c in categorical_features)
+        self.mapper = mapper
+        self.path = os.fspath(path)
+        self.bin_dtype = np.dtype(mapper.bin_dtype)
+        self.num_features = int(mapper.num_features)
+        row_bytes = self.num_features * self.bin_dtype.itemsize
+        size = os.path.getsize(self.path)
+        if num_rows is None:
+            if row_bytes == 0 or size % row_bytes:
+                raise ValueError(
+                    f"{self.path}: size {size} is not a multiple of the "
+                    f"row stride {row_bytes} (F={self.num_features}, "
+                    f"dtype={self.bin_dtype})")
+            num_rows = size // row_bytes
+        elif int(num_rows) * row_bytes > size:
+            raise ValueError(
+                f"{self.path}: {size} bytes holds fewer than "
+                f"{num_rows} x {row_bytes}-byte rows")
+        self.num_rows = int(num_rows)
+        self.chunk_rows = max(1, int(chunk_rows))
+        self._attach_targets(y, weight, group)
+
+    # the resident attribute is a trap on this class: everything that can
+    # legitimately touch the matrix goes through the bounded accessors
+    @property
+    def X_binned(self):
+        raise TypeError(
+            "StreamedDataset keeps the binned matrix on disk — use "
+            "binned_view()/read_rows()/iter_chunks(), or materialize() "
+            "for a resident copy")
+
+    @property
+    def num_chunks(self) -> int:
+        return -(-self.num_rows // self.chunk_rows)
+
+    def _chunk_bounds(self) -> List[Tuple[int, int]]:
+        return [(lo, min(lo + self.chunk_rows, self.num_rows))
+                for lo in range(0, self.num_rows, self.chunk_rows)]
+
+    def read_rows(self, lo: int, hi: int) -> np.ndarray:
+        """Rows ``[lo, hi)`` as a fresh contiguous array.  ``np.fromfile``
+        at an explicit offset: the pages land in the OS page cache, not in
+        process RSS, so training residency stays bounded by chunk size."""
+        lo, hi = int(lo), int(hi)
+        if not 0 <= lo <= hi <= self.num_rows:
+            raise ValueError(f"row range [{lo}, {hi}) outside [0, {self.num_rows})")
+        count = (hi - lo) * self.num_features
+        if count == 0:
+            return np.empty((0, self.num_features), self.bin_dtype)
+        with open(self.path, "rb") as f:
+            f.seek(lo * self.num_features * self.bin_dtype.itemsize)
+            buf = np.fromfile(f, dtype=self.bin_dtype, count=count)
+        if buf.size != count:
+            raise IOError(
+                f"{self.path}: short read at rows [{lo}, {hi}) "
+                f"({buf.size} of {count} elements)")
+        return buf.reshape(hi - lo, self.num_features)
+
+    def iter_chunks(self, prefetch: int = 2):
+        """Yield ``(lo, hi, rows[lo:hi])`` in order.  With ``prefetch > 0``
+        a bounded reader thread loads chunk i+1 while the caller works on
+        chunk i (the two-deep pipeline); ``prefetch=0`` reads inline."""
+        bounds = self._chunk_bounds()
+        if prefetch <= 0 or len(bounds) <= 1:
+            for lo, hi in bounds:
+                yield lo, hi, self.read_rows(lo, hi)
+            return
+        pf = ChunkPrefetcher(lambda i: self.read_rows(*bounds[i]),
+                             len(bounds), depth=prefetch)
+        try:
+            for i, buf in pf:
+                yield bounds[i][0], bounds[i][1], buf
+        finally:
+            pf.close()
+
+    def binned_view(self) -> _StreamedMatrix:
+        """The CPU trainer's matrix stand-in (see ``_StreamedMatrix``)."""
+        return _StreamedMatrix(self)
+
+    @property
+    def has_missing(self) -> bool:
+        # same reduction as Dataset.has_missing, folded chunk-by-chunk
+        if self._has_missing is None:
+            zero_cols = np.zeros(self.num_features, bool)
+            for _lo, _hi, buf in self.iter_chunks():
+                zero_cols |= (buf == 0).any(axis=0)
+            eligible = ~self.mapper.is_categorical
+            bundled = getattr(self.mapper, "bundled_mask", None)
+            if bundled is not None:
+                eligible &= ~bundled
+            self._has_missing = bool((zero_cols & eligible).any())
+        return self._has_missing
+
+    def strided_rows(self, stride: int) -> np.ndarray:
+        """Exactly ``Xb[::stride]`` (the reference-profile subsample) via
+        chunked reads — keeps train-time profiles bitwise-equal streamed
+        vs resident."""
+        stride = max(1, int(stride))
+        parts: list = []
+        for lo, hi, buf in self.iter_chunks(prefetch=0):
+            first = -(-lo // stride) * stride  # first stride multiple >= lo
+            if first >= hi:
+                continue
+            parts.append(np.ascontiguousarray(buf[first - lo::stride]))
+        if not parts:
+            return np.empty((0, self.num_features), self.bin_dtype)
+        return parts[0] if len(parts) == 1 else np.concatenate(parts, axis=0)
+
+    def device_arrays(self):
+        """Chunk-by-chunk host->device assembly: the prefetcher reads chunk
+        i+1 from disk while chunk i's async ``device_put`` is in flight;
+        the parts concatenate ON DEVICE into the resident matrix the
+        unchanged jitted programs consume.  Out-of-HOST-core — peak host
+        residency is the prefetch window, never ``(N, F)``."""
+        if self._device_cache is None:
+            import jax
+            import jax.numpy as jnp
+
+            parts = [jax.device_put(buf) for _lo, _hi, buf in self.iter_chunks()]
+            Xd = parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=0)
+            self._device_cache = (
+                Xd,
+                None if self.y is None else jnp.asarray(self.y),
+                None if self.weight is None else jnp.asarray(self.weight),
+            )
+        return self._device_cache
+
+    def materialize(self) -> Dataset:
+        """Resident Dataset over the identical binned matrix (debug/tests;
+        reads the whole file — defeats the point at production scale)."""
+        return Dataset.from_binned(
+            self.read_rows(0, self.num_rows), self.mapper, self.y,
+            weight=self.weight, group=self.group,
+            categorical_features=self.categorical_features)
+
+    @classmethod
+    def from_dataset(cls, ds: Dataset, path, *,
+                     chunk_rows: int = DEFAULT_CHUNK_ROWS) -> "StreamedDataset":
+        """Spill a resident Dataset's binned matrix to ``path`` and return
+        the streamed equivalent (the streamed ≡ resident test fixture)."""
+        sink = SpillSink(path, ds.num_rows, ds.num_features,
+                         np.dtype(ds.mapper.bin_dtype))
+        step = max(1, int(chunk_rows))
+        for lo in range(0, ds.num_rows, step):
+            sink.write(ds.X_binned[lo:lo + step])
+        sink.finish()
+        return cls(path, ds.mapper, ds.y, weight=ds.weight, group=ds.group,
+                   categorical_features=ds.categorical_features,
+                   num_rows=ds.num_rows, chunk_rows=chunk_rows)
+
+
+class SpillSink:
+    """Sequential chunk writer into a preallocated raw on-disk matrix.
+
+    Each block is written through a transient ``np.memmap`` window that is
+    flushed and dropped from residency (``madvise(MADV_DONTNEED)``)
+    immediately — the builder's peak RSS stays ~one chunk, never the full
+    pass-2 matrix.  This is the spill target ``dataset_from_chunks`` /
+    ``dataset_from_csr_chunks`` write through.
+    """
+
+    def __init__(self, path, total_rows: int, num_features: int,
+                 dtype: np.dtype):
+        self.path = os.fspath(path)
+        self.total_rows = int(total_rows)
+        self.num_features = int(num_features)
+        self.dtype = np.dtype(dtype)
+        self.row_bytes = self.num_features * self.dtype.itemsize
+        with open(self.path, "wb") as f:
+            f.truncate(self.total_rows * self.row_bytes)
+        self.rows_written = 0
+
+    def write(self, block: np.ndarray) -> None:
+        block = np.asarray(block, self.dtype)
+        n = block.shape[0]
+        if n == 0:
+            return
+        if block.ndim != 2 or block.shape[1] != self.num_features:
+            raise ValueError(
+                f"spill block shape {block.shape} != (*, {self.num_features})")
+        if self.rows_written + n > self.total_rows:
+            raise ValueError(
+                f"stream yielded more than the declared {self.total_rows} rows")
+        mm = np.memmap(self.path, dtype=self.dtype, mode="r+",
+                       offset=self.rows_written * self.row_bytes,
+                       shape=(n, self.num_features))
+        mm[:] = block
+        mm.flush()
+        try:
+            mm._mmap.madvise(mmap.MADV_DONTNEED)
+        except (AttributeError, ValueError, OSError):
+            pass  # platform without madvise: correctness is unaffected
+        del mm
+        self.rows_written += n
+
+    def finish(self) -> None:
+        if self.rows_written != self.total_rows:
+            raise ValueError(
+                f"stream yielded {self.rows_written} rows, "
+                f"expected {self.total_rows}")

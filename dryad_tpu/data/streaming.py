@@ -81,9 +81,19 @@ def dataset_from_chunks(
     mapper: Optional[BinMapper] = None,
     sample_rows: int = 1 << 20,
     seed: int = 0,
+    spill: Optional[str] = None,
+    chunk_rows: Optional[int] = None,
 ):
     """Out-of-core Dataset: ``chunks`` is a restartable factory of row-chunk
-    iterables (called twice: sketch pass, bin pass)."""
+    iterables (called twice: sketch pass, bin pass).
+
+    With ``spill=path`` the pass-2 bins are written straight to disk
+    through a flushed+dropped memmap window (``SpillSink``) and a
+    :class:`~dryad_tpu.data.stream_dataset.StreamedDataset` is returned —
+    the full binned matrix is never resident, and training streams it back
+    in ``chunk_rows``-row tiles (bitwise ≡ the resident path).  The
+    sketch pass and its global-row-id keying are identical either way.
+    """
     from dryad_tpu.dataset import Dataset
 
     if mapper is None:
@@ -91,6 +101,22 @@ def dataset_from_chunks(
             chunks, total_rows, max_bins=max_bins,
             categorical_features=categorical_features,
             sample_rows=sample_rows, seed=seed,
+        )
+    if spill is not None:
+        from dryad_tpu.data.stream_dataset import (DEFAULT_CHUNK_ROWS,
+                                                   SpillSink, StreamedDataset)
+
+        # mapper.num_features, not the raw column count: a BundledMapper's
+        # transform emits the folded (bundled) width
+        sink = SpillSink(spill, total_rows, mapper.num_features,
+                         np.dtype(mapper.bin_dtype))
+        for chunk in chunks():
+            sink.write(mapper.transform(np.asarray(chunk, np.float32)))
+        sink.finish()
+        return StreamedDataset(
+            spill, mapper, y, weight=weight, group=group,
+            categorical_features=categorical_features, num_rows=total_rows,
+            chunk_rows=chunk_rows or DEFAULT_CHUNK_ROWS,
         )
     Xb = np.empty((total_rows, num_features), mapper.bin_dtype)
     offset = 0
@@ -167,6 +193,8 @@ def dataset_from_csr_chunks(
     seed: int = 0,
     bundle: bool = True,
     plan_rows: int = 1 << 20,
+    spill: Optional[str] = None,
+    chunk_rows: Optional[int] = None,
 ):
     """Out-of-core sparse ingest WITH exclusive feature bundling — the
     Criteo-1TB composition (SURVEY.md §7 hard part e; BASELINE.json:11):
@@ -187,6 +215,10 @@ def dataset_from_csr_chunks(
     ``plan_bundles`` runs in memory replays on the accumulated matrix —
     so every emitted bundle is strictly exclusive end to end and the fold
     drops nothing (bit-identical to in-memory ingest of the same rows).
+
+    ``spill=path`` routes the final fold through ``SpillSink`` and returns
+    a ``StreamedDataset`` (see ``dataset_from_chunks``) — out-of-core end
+    to end, plan/verify passes included.
     """
     from dryad_tpu.data.binning import bin_csr, zero_bins
     from dryad_tpu.data.bundling import BundledMapper, plan_bundles
@@ -242,22 +274,37 @@ def dataset_from_csr_chunks(
         plan = verified
 
     if plan:
-        bm = BundledMapper(mapper, plan)
-        Xb = np.empty((total_rows, bm.num_features), bm.bin_dtype)
-        offset = 0
-        for triple in chunks():
-            folded = bm.fold(bin_chunk(*triple))
-            Xb[offset:offset + folded.shape[0]] = folded
-            offset += folded.shape[0]
-        out_mapper = bm
+        out_mapper = BundledMapper(mapper, plan)
+
+        def fold_chunk(triple):
+            return out_mapper.fold(bin_chunk(*triple))
     else:
-        Xb = np.empty((total_rows, num_features), mapper.bin_dtype)
-        offset = 0
-        for triple in chunks():
-            binned = bin_chunk(*triple)
-            Xb[offset:offset + binned.shape[0]] = binned
-            offset += binned.shape[0]
         out_mapper = mapper
+        fold_chunk = lambda triple: bin_chunk(*triple)  # noqa: E731
+
+    if spill is not None:
+        # same fold pass, written through the flushed+dropped memmap
+        # window — the bundled matrix itself is never resident
+        from dryad_tpu.data.stream_dataset import (DEFAULT_CHUNK_ROWS,
+                                                   SpillSink, StreamedDataset)
+
+        sink = SpillSink(spill, total_rows, out_mapper.num_features,
+                         np.dtype(out_mapper.bin_dtype))
+        for triple in chunks():
+            sink.write(fold_chunk(triple))
+        sink.finish()
+        return StreamedDataset(
+            spill, out_mapper, y, weight=weight, group=group,
+            categorical_features=categorical_features, num_rows=total_rows,
+            chunk_rows=chunk_rows or DEFAULT_CHUNK_ROWS,
+        )
+
+    Xb = np.empty((total_rows, out_mapper.num_features), out_mapper.bin_dtype)
+    offset = 0
+    for triple in chunks():
+        block = fold_chunk(triple)
+        Xb[offset:offset + block.shape[0]] = block
+        offset += block.shape[0]
     if offset != total_rows:
         raise ValueError(f"stream yielded {offset} rows, expected {total_rows}")
 

@@ -76,6 +76,9 @@ class Dataset:
         self._attach_targets(y, weight, group)
 
     _has_missing: Optional[bool] = None
+    #: overridden by data.stream_dataset.StreamedDataset — trainers branch
+    #: to bounded-read accessors instead of the resident X_binned
+    is_streamed: bool = False
 
     @property
     def has_missing(self) -> bool:

@@ -701,7 +701,7 @@ def train_device(
     (resume bit-identity is preserved by construction; chunk length is a
     traced scalar of one shared executable)."""
     p = params.validate()
-    N, F = data.X_binned.shape
+    N, F = data.num_rows, data.num_features
     B = data.mapper.total_bins
     # documented max_depth=-1 policy (identical mapping on the CPU backend,
     # so cross-backend parity is untouched)
@@ -712,13 +712,19 @@ def train_device(
     has_cat = bool(is_cat_np.any())
     T = (num_trees if num_trees is not None else p.num_trees) * K
 
-    Xb_np, y_np = data.X_binned, data.y
-    w_np = data.weight
     pad = 0
     shard_rows = None
     if mesh is not None:
+        if getattr(data, "is_streamed", False):
+            raise ValueError(
+                "streamed datasets cannot train with mesh=...: the sharded "
+                "arm pads and shards the resident matrix host-side — "
+                "materialize() the dataset or train unsharded (on-device "
+                "streaming past HBM is the staged follow-up)")
         from dryad_tpu.engine.distributed import padded_rows, shard_rows
 
+        Xb_np, y_np = data.X_binned, data.y
+        w_np = data.weight
         Np = padded_rows(N, mesh.devices.size)
         pad = Np - N
         if pad:
@@ -730,7 +736,11 @@ def train_device(
         weight = shard_rows(mesh, jnp.asarray(w_np))[0] if w_np is not None else None
     else:
         # memoized on the Dataset: repeated train calls (bench arms, warm
-        # restarts, parameter sweeps) skip the X upload entirely
+        # restarts, parameter sweeps) skip the X upload entirely.  On a
+        # StreamedDataset this is the overlapped chunk-by-chunk assembly
+        # (prefetch read i+1 vs async device_put of i) — the jitted
+        # programs downstream are IDENTICAL to the resident path, so the
+        # audit goldens and _comm_stats are untouched by streaming.
         Xb, y, weight = data.device_arrays()
     NP = N + pad
     is_cat_feat = jnp.asarray(is_cat_np)
@@ -885,6 +895,12 @@ def train_device(
     from dryad_tpu.metrics.device import make_evaluator
 
     valids = normalize_valids(valid)
+    for vname, vds in valids:
+        if getattr(vds, "is_streamed", False):
+            raise ValueError(
+                f"valid set {vname!r} is streamed: device eval scores the "
+                "resident matrix — materialize() it (valid sets are small "
+                "relative to the training corpus)")
     evaluators = [make_evaluator(p.objective, p.metric, vds, p.ndcg_at)
                   for _, vds in valids]
     # a checkpointer does NOT force per-eval syncs: deferred evals are

@@ -70,6 +70,9 @@ HIGHER_BETTER = frozenset({
     # through the router at N replicas, and the N-vs-1 scaling ratios
     "fleet_rows_per_s_n1", "fleet_rows_per_s_n2", "fleet_rows_per_s_n4",
     "fleet_scaling_n2", "fleet_scaling_n4",
+    # r20 out-of-core training (scripts/stream_rss_probe.py): streamed
+    # CPU train throughput
+    "stream_train_rows_per_s",
 })
 LOWER_BETTER = frozenset({
     "marginal_s_per_iter_10m", "wall_2tree_10m", "wall_8tree_10m",
@@ -81,6 +84,8 @@ LOWER_BETTER = frozenset({
     # r18 drift-monitor overhead (scripts/bench_serve.py --drift:
     # instrumented-vs-disabled serve arms, gate <= 2% like obs_overhead)
     "drift_overhead_ms", "drift_overhead_pct",
+    # r20 streamed-vs-resident train overhead and the RSS proof peak
+    "stream_overhead_pct", "stream_rss_peak_mb",
     "p50_ms", "p99_ms",
 })
 
@@ -102,6 +107,8 @@ _SPREAD_FIELDS = {
     "obs_overhead_pct": ("obs_overhead_spread",),
     "drift_overhead_ms": ("drift_overhead_spread",),
     "drift_overhead_pct": ("drift_overhead_spread",),
+    "stream_train_rows_per_s": ("stream_overhead_spread",),
+    "stream_overhead_pct": ("stream_overhead_spread",),
     "rows_per_s": ("spread_rows_per_s",),
     "fleet_rows_per_s_n1": ("fleet_spread_n1",),
     "fleet_rows_per_s_n2": ("fleet_spread_n2",),

@@ -1,0 +1,248 @@
+"""Out-of-core streamed-training bench arm (Issue 17 / r20).
+
+Two measurements, one JSON artifact line (bench.py merges it under
+``BENCH_STREAM``; ``obs/trends.py`` tracks the metric fields):
+
+1. **Overhead A/B** — resident vs streamed CPU training on a 200k-row
+   fixture, min-of-reps walls with per-arm spreads.  The arms are
+   bitwise-checked against each other first: a fast-but-wrong capture
+   must fail loudly, never publish.  Fields: ``stream_train_rows_per_s``
+   (streamed throughput), ``stream_overhead_pct`` (streamed vs resident
+   wall), ``stream_overhead_spread`` (max per-arm spread — the >5%
+   suspect-capture veto trends.py applies).
+
+2. **RSS proof at >=1e7 rows** — resident and streamed arms run in
+   SUBPROCESSES (``ru_maxrss`` is a process-lifetime peak, so each arm
+   needs its own lifetime): chunked synthetic ingest (restartable seeded
+   generator, frozen shared mapper) -> ``dataset_from_chunks`` with and
+   without ``spill=`` -> one boosting tree.  The streamed arm's peak RSS
+   must sit demonstrably BELOW the resident binned-matrix requirement
+   (``stream_rss_peak_mb < resident_matrix_mb``) and below the resident
+   arm's measured peak; both workers also report a tree digest and the
+   parent asserts they match — the 1e7-scale bitwise proof rides the
+   same run.  ``--skip-rss`` keeps only the cheap A/B part (bench.py's
+   default unless ``BENCH_STREAM_RSS=1``).
+
+This is pure-CPU numpy work (no device, no timed-fori program — the
+harness rules for device probes don't apply); walls are min-of-reps
+``perf_counter`` with spread fields, per the bench spread contract.
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# ---- RSS-proof worker shape (both arms must agree exactly) ----------------
+RSS_ROWS = 10_000_000
+RSS_FEATURES = 128
+RSS_BINS = 32
+RSS_CHUNK = 250_000
+RSS_SEED = 20_001
+
+
+def _gen_chunk(lo: int, n: int, F: int) -> np.ndarray:
+    """Restartable synthetic rows: a pure function of the row offset, so
+    every pass over the chunk stream regenerates identical data."""
+    rng = np.random.default_rng(RSS_SEED + lo)
+    return rng.standard_normal((n, F), dtype=np.float32)
+
+
+def _tree_digest(booster) -> str:
+    h = hashlib.sha256()
+    for key in ("feature", "threshold", "left", "right", "value"):
+        h.update(np.ascontiguousarray(getattr(booster, key)).tobytes())
+    return h.hexdigest()
+
+
+def run_worker(arm: str, rows: int) -> int:
+    """One RSS-proof arm in its own process lifetime."""
+    import resource
+
+    from dryad_tpu.config import Params
+    from dryad_tpu.cpu.trainer import train_cpu
+    from dryad_tpu.data.sketch import sketch_features
+    from dryad_tpu.data.streaming import dataset_from_chunks
+
+    N, F = int(rows), RSS_FEATURES
+
+    def chunks():
+        for lo in range(0, N, RSS_CHUNK):
+            yield _gen_chunk(lo, min(RSS_CHUNK, N - lo), F)
+
+    # frozen mapper from a fixed prefix — identical in both arms, so the
+    # bin space (and therefore the grown tree) is shared bitwise
+    mapper = sketch_features(_gen_chunk(0, 200_000, F), max_bins=RSS_BINS)
+
+    ys = []
+    for lo in range(0, N, RSS_CHUNK):
+        c = _gen_chunk(lo, min(RSS_CHUNK, N - lo), F)
+        ys.append((c[:, 0] + 0.5 * c[:, 1] > 0.2).astype(np.float32))
+    y = np.concatenate(ys)
+    del ys
+
+    t0 = time.perf_counter()
+    spill = None
+    if arm == "streamed":
+        spill = os.path.join(tempfile.mkdtemp(prefix="dryad_stream_"),
+                             "bins.stream")
+        ds = dataset_from_chunks(chunks, y, N, F, mapper=mapper,
+                                 spill=spill, chunk_rows=RSS_CHUNK)
+    else:
+        ds = dataset_from_chunks(chunks, y, N, F, mapper=mapper)
+    build_s = time.perf_counter() - t0
+
+    p = Params(objective="binary", num_trees=1, num_leaves=3, seed=7)
+    t1 = time.perf_counter()
+    booster = train_cpu(p, ds)
+    train_s = time.perf_counter() - t1
+
+    peak_kb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+    print(json.dumps({
+        "arm": arm, "rows": N, "features": F,
+        "rss_peak_mb": round(peak_kb / 1024.0, 1),
+        "build_s": round(build_s, 2), "train_s": round(train_s, 2),
+        "digest": _tree_digest(booster),
+    }))
+    if spill is not None:
+        try:
+            os.unlink(spill)
+        except OSError:
+            pass
+    return 0
+
+
+def overhead_ab(reps: int = 3) -> dict:
+    """Resident-vs-streamed CPU training walls on a 200k fixture."""
+    import dryad_tpu as dryad
+    from dryad_tpu.data.stream_dataset import StreamedDataset
+
+    N, F, TREES = 200_000, 32, 6
+    rng = np.random.default_rng(11)
+    X = rng.standard_normal((N, F)).astype(np.float32)
+    y = (X[:, 0] + 0.5 * X[:, 1] ** 2 + rng.normal(0, 0.1, N) > 0.4
+         ).astype(np.float32)
+    ds = dryad.Dataset(X, y, max_bins=64)
+    sds = StreamedDataset.from_dataset(
+        ds, os.path.join(tempfile.mkdtemp(prefix="dryad_ab_"), "bins.stream"),
+        chunk_rows=N // 4)
+    p = dryad.Params(objective="binary", num_trees=TREES, num_leaves=31,
+                     seed=3, subsample=0.8)
+
+    ref = dryad.train(p, ds, backend="cpu")
+    got = dryad.train(p, sds, backend="cpu")
+    for key in ("feature", "threshold", "left", "right", "value"):
+        np.testing.assert_array_equal(getattr(ref, key), getattr(got, key))
+
+    walls = {"resident": [], "streamed": []}
+    for _ in range(reps):                   # alternate arms: drift-fair
+        for arm, d in (("resident", ds), ("streamed", sds)):
+            t0 = time.perf_counter()
+            dryad.train(p, d, backend="cpu")
+            walls[arm].append(time.perf_counter() - t0)
+    res, stm = min(walls["resident"]), min(walls["streamed"])
+    spread = max(
+        (max(w) - min(w)) / min(w) * 100.0 for w in walls.values())
+    try:
+        os.unlink(sds.path)
+    except OSError:
+        pass
+    return {
+        "stream_ab_rows": N, "stream_ab_trees": TREES,
+        "stream_train_rows_per_s": round(N * TREES / stm, 1),
+        "stream_overhead_pct": round((stm - res) / res * 100.0, 2),
+        "stream_overhead_spread": round(spread, 2),
+        "stream_wall_resident_s": round(res, 3),
+        "stream_wall_streamed_s": round(stm, 3),
+        "stream_bitwise_ab": True,
+    }
+
+
+def rss_proof(rows: int) -> dict:
+    """Run both RSS arms as subprocesses; assert the streamed peak is
+    below the resident binned-matrix requirement AND the digests agree."""
+    results = {}
+    for arm in ("streamed", "resident"):
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "--worker", arm, "--rows", str(rows)],
+            capture_output=True, text=True, cwd=REPO,
+            env={**os.environ, "DRYAD_OBS": "0", "DRYAD_PROFILE": "0"})
+        if proc.returncode != 0:
+            raise SystemExit(
+                f"{arm} worker failed:\n{proc.stdout}\n{proc.stderr}")
+        results[arm] = json.loads(proc.stdout.strip().splitlines()[-1])
+    stm, res = results["streamed"], results["resident"]
+    if stm["digest"] != res["digest"]:
+        raise SystemExit(
+            f"streamed/resident tree digests diverge at {rows} rows: "
+            f"{stm['digest']} vs {res['digest']}")
+    matrix_mb = rows * RSS_FEATURES / (1024.0 * 1024.0)  # u8 bins
+    out = {
+        "stream_rss_rows": int(rows),
+        "stream_rss_features": RSS_FEATURES,
+        "resident_matrix_mb": round(matrix_mb, 1),
+        "stream_rss_peak_mb": stm["rss_peak_mb"],
+        "resident_rss_peak_mb": res["rss_peak_mb"],
+        "stream_build_s": stm["build_s"], "stream_train_s": stm["train_s"],
+        "resident_build_s": res["build_s"], "resident_train_s": res["train_s"],
+        "stream_bitwise_10m": True,
+    }
+    if not (stm["rss_peak_mb"] < matrix_mb
+            and stm["rss_peak_mb"] < res["rss_peak_mb"]):
+        raise SystemExit(
+            "RSS proof failed: streamed peak "
+            f"{stm['rss_peak_mb']} MB is not below the resident matrix "
+            f"({matrix_mb:.0f} MB) and the resident peak "
+            f"({res['rss_peak_mb']} MB)\n{json.dumps(out)}")
+    return out
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--worker", choices=("streamed", "resident"),
+                    help="internal: run one RSS arm and exit")
+    ap.add_argument("--rows", type=int, default=RSS_ROWS,
+                    help=f"RSS-proof row count (default {RSS_ROWS})")
+    ap.add_argument("--reps", type=int, default=3,
+                    help="A/B wall repetitions per arm")
+    ap.add_argument("--skip-rss", action="store_true",
+                    help="only the cheap overhead A/B (bench.py default)")
+    ap.add_argument("--out", help="also write the JSON artifact here")
+    args = ap.parse_args()
+
+    if args.worker:
+        return run_worker(args.worker, args.rows)
+
+    out: dict = {"bench": "stream_train"}
+    out.update(overhead_ab(args.reps))
+    if not args.skip_rss:
+        if args.rows < 10_000_000:
+            print(f"# note: --rows {args.rows} is below the 1e7 acceptance "
+                  "floor; artifact will say so", file=sys.stderr)
+        out.update(rss_proof(args.rows))
+
+    from dryad_tpu.obs.trends import artifact_stamp
+
+    out.update(artifact_stamp(device_kind="cpu", root=REPO))
+    line = json.dumps(out)
+    print(line)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -558,6 +558,25 @@ def test_harness_detects_unlocked_scheduler_admit(monkeypatch):
         "the unlocked debounce never double-launched a retrain"
 
 
+def test_harness_detects_wedged_prefetch_producer(monkeypatch):
+    """r20: ChunkPrefetcher's producer must put through the cancellable
+    timeout loop — mechanically reverting it to a plain blocking put lets
+    a mid-stream close() strand the producer (the post-drain sentinel put
+    wedges forever on the refilled queue); the stream-prefetch drill's
+    thread-reaped assertion catches it."""
+    from dryad_tpu.data import stream_dataset as smod
+
+    def blocking_put(self, item):
+        self._q.put(item)   # the pre-fix shape: no cancellation window
+        return True
+
+    monkeypatch.setattr(smod.ChunkPrefetcher, "_put_cancellable",
+                        blocking_put)
+    seed = _first_failing_seed("stream-prefetch", 60)
+    assert seed is not None, \
+        "a non-cancellable producer put never wedged past close()"
+
+
 def test_harness_detects_recovery_blocking_the_monitor(monkeypatch):
     from dryad_tpu.fleet import supervisor as smod
 

@@ -1,0 +1,278 @@
+"""Out-of-core streamed training (Issue 17 / r20).
+
+The headline invariant: training from a ``StreamedDataset`` (binned
+matrix on disk, bounded chunk reads) is BITWISE identical to training
+from the resident matrix — trees, eval metrics, and the early-stop
+iteration — at two different chunkings, on both trainers, including
+GOSS/bagging/early-stop and kill-and-resume through the supervisor.
+Exactness is by construction (the streamed accessors return arrays
+elementwise identical to resident slices, so every fold order is
+unchanged); these tests pin that construction against the real trainers.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import dryad_tpu as dryad
+from dryad_tpu.data.stream_dataset import (
+    DEFAULT_CHUNK_ROWS,
+    SpillSink,
+    StreamedDataset,
+)
+from dryad_tpu.data.streaming import dataset_from_chunks
+from dryad_tpu.datasets import higgs_like
+
+KEYS = ("feature", "threshold", "left", "right", "value")
+#: two deliberately ragged chunkings (neither divides 3000)
+CHUNKINGS = (700, 1231)
+
+PARAMS = dict(objective="binary", num_trees=8, num_leaves=7, max_bins=32,
+              seed=3, min_data_in_leaf=5)
+
+
+def assert_same_booster(a, b):
+    for k in KEYS:
+        np.testing.assert_array_equal(getattr(a, k), getattr(b, k))
+
+
+@pytest.fixture(scope="module")
+def data():
+    X, y = higgs_like(3000, seed=21)
+    return dryad.Dataset(X, y, max_bins=32)
+
+
+@pytest.fixture(scope="module")
+def valid(data):
+    Xv, yv = higgs_like(800, seed=22)
+    return dryad.Dataset(Xv, yv, mapper=data.mapper)
+
+
+def spill(ds, tmp_path, chunk_rows, name="bins.stream"):
+    return StreamedDataset.from_dataset(
+        ds, str(tmp_path / f"{chunk_rows}_{name}"), chunk_rows=chunk_rows)
+
+
+# ---- the spill + bounded accessors ------------------------------------------
+
+def test_spill_roundtrip_and_accessors(data, tmp_path):
+    sds = spill(data, tmp_path, 700)
+    Xb = data.X_binned
+    assert sds.num_rows == data.num_rows
+    assert sds.num_features == data.num_features
+    assert sds.num_chunks == -(-data.num_rows // 700)
+    np.testing.assert_array_equal(sds.read_rows(0, data.num_rows), Xb)
+    np.testing.assert_array_equal(sds.read_rows(693, 1402), Xb[693:1402])
+    assert sds.read_rows(5, 5).shape == (0, data.num_features)
+    # chunk iteration (prefetched AND inline) re-assembles the matrix
+    for prefetch in (2, 0):
+        got = np.concatenate(
+            [buf for _lo, _hi, buf in sds.iter_chunks(prefetch)], axis=0)
+        np.testing.assert_array_equal(got, Xb)
+    # the profile subsample stride is exactly Xb[::stride]
+    for stride in (1, 3, 700, 997):
+        np.testing.assert_array_equal(sds.strided_rows(stride), Xb[::stride])
+    assert sds.has_missing == data.has_missing
+    with pytest.raises(ValueError, match="row range"):
+        sds.read_rows(0, data.num_rows + 1)
+
+
+def test_streamed_matrix_gathers_and_traps(data, tmp_path):
+    sds = spill(data, tmp_path, 1231)
+    view = sds.binned_view()
+    Xb = data.X_binned
+    assert view.shape == Xb.shape and len(view) == len(Xb)
+    rng = np.random.default_rng(5)
+    rows = np.sort(rng.choice(data.num_rows, 900, replace=False))
+    np.testing.assert_array_equal(view[rows], Xb[rows])
+    np.testing.assert_array_equal(view[rows, 7], Xb[rows, 7])
+    dup = np.sort(rng.integers(0, data.num_rows, 400))  # repeats are fine
+    np.testing.assert_array_equal(view[dup, 2], Xb[dup, 2])
+    with pytest.raises(ValueError, match="ascending"):
+        view[rows[::-1]]
+    with pytest.raises(TypeError):
+        sds.X_binned  # the resident attribute is a trap on this class
+    sink = SpillSink(str(tmp_path / "over.bins"), 10, 4, np.dtype(np.uint8))
+    sink.write(np.zeros((8, 4), np.uint8))
+    with pytest.raises(ValueError, match="more than the declared"):
+        sink.write(np.zeros((3, 4), np.uint8))
+    with pytest.raises(ValueError, match="expected"):
+        SpillSink(str(tmp_path / "short.bins"), 10, 4,
+                  np.dtype(np.uint8)).finish()
+
+
+def test_dataset_from_chunks_spill_bitwise(tmp_path):
+    """The chunked builder's spill arm: same sketch, same two-pass keying,
+    bins land on disk instead of in the resident matrix — bit for bit."""
+    N, F = 2000, 16
+    rng = np.random.default_rng(9)
+    X = rng.standard_normal((N, F)).astype(np.float32)
+    X[rng.random((N, F)) < 0.05] = np.nan          # exercise missing bins
+    y = (X[:, 0] > 0.1).astype(np.float32)
+
+    def chunks():
+        for lo in range(0, N, 517):
+            yield X[lo:lo + 517]
+
+    res = dataset_from_chunks(chunks, y, N, F, max_bins=32)
+    stm = dataset_from_chunks(chunks, y, N, F, max_bins=32,
+                              spill=str(tmp_path / "cb.bins"), chunk_rows=601)
+    assert stm.is_streamed and stm.chunk_rows == 601
+    np.testing.assert_array_equal(stm.read_rows(0, N), res.X_binned)
+    assert stm.has_missing == res.has_missing
+    p = dict(PARAMS, num_trees=4)
+    assert_same_booster(dryad.train(p, res, backend="cpu"),
+                        dryad.train(p, stm, backend="cpu"))
+
+
+def test_dataset_from_csr_chunks_spill_bitwise(tmp_path):
+    """The sparse/EFB builder's spill arm: plan + exact verification
+    passes unchanged, the BUNDLED (folded-width) fold lands on disk."""
+    from dryad_tpu.data.bundling import BundledMapper
+    from dryad_tpu.data.streaming import dataset_from_csr_chunks
+    from tests.test_bundling import _onehot_csr
+
+    (indptr, cols, vals, F), y = _onehot_csr(n=2048)
+
+    def chunks():
+        for lo in range(0, 2048, 600):
+            hi = min(lo + 600, 2048)
+            a, b = indptr[lo], indptr[hi]
+            yield (indptr[lo:hi + 1] - a, cols[a:b], vals[a:b])
+
+    res = dataset_from_csr_chunks(chunks, y, 2048, F, max_bins=64)
+    stm = dataset_from_csr_chunks(chunks, y, 2048, F, max_bins=64,
+                                  spill=str(tmp_path / "csr.bins"),
+                                  chunk_rows=777)
+    assert isinstance(res.mapper, BundledMapper) and res.mapper.bundles
+    # the spill is sized by the FOLDED width, not the raw column count
+    assert stm.num_features == res.num_features < F
+    np.testing.assert_array_equal(stm.read_rows(0, 2048), res.X_binned)
+    p = dict(PARAMS, num_trees=3)
+    assert_same_booster(dryad.train(p, res, backend="cpu"),
+                        dryad.train(p, stm, backend="cpu"))
+
+
+# ---- the headline: streamed ≡ resident bitwise, both trainers ---------------
+
+def test_cpu_streamed_bitwise_both_growers(data, tmp_path):
+    for growth, extra in (("leafwise", {}),
+                          ("depthwise", {"max_depth": 4})):
+        p = dict(PARAMS, growth=growth, **extra)
+        ref = dryad.train(p, data, backend="cpu")
+        for chunk_rows in CHUNKINGS:
+            got = dryad.train(p, spill(data, tmp_path, chunk_rows,
+                                       f"{growth}.bins"), backend="cpu")
+            assert_same_booster(ref, got)
+
+
+def test_engine_streamed_bitwise_two_chunkings(data, tmp_path):
+    p = dict(PARAMS, num_trees=4)
+    ref = dryad.train(p, data, backend="tpu")
+    for chunk_rows in CHUNKINGS:
+        got = dryad.train(p, spill(data, tmp_path, chunk_rows, "eng.bins"),
+                          backend="tpu")
+        assert_same_booster(ref, got)
+
+
+def test_cpu_streamed_goss_bagging_earlystop(data, valid, tmp_path):
+    """Sampling keyed on global row id + eval on the chunked matrix:
+    GOSS, bagging+colsample, and the early-stop iteration all match the
+    resident run exactly — including a STREAMED valid set on CPU."""
+    sds = spill(data, tmp_path, 700)
+    svalid = spill(valid, tmp_path, 271, "valid.bins")
+    for extra in ({"boosting": "goss"},
+                  {"subsample": 0.7, "colsample": 0.7}):
+        p = dict(PARAMS, num_trees=30, early_stopping_rounds=3, **extra)
+        ref = dryad.train(p, data, valid_sets=[valid], backend="cpu")
+        for vset in (valid, svalid):
+            got = dryad.train(p, sds, valid_sets=[vset], backend="cpu")
+            assert_same_booster(ref, got)
+            assert got.best_iteration == ref.best_iteration
+            assert (got.train_state["eval_history"]
+                    == ref.train_state["eval_history"])
+
+
+def test_supervised_kill_resume_streamed_bitwise(data, tmp_path):
+    """Kill-and-resume mid-epoch: the supervisor's checkpoint replay path
+    walks the streamed matrix too, and the resumed run reproduces the
+    uninterrupted streamed run — which IS the resident run — bitwise."""
+    from dryad_tpu.resilience import (FaultInjector, RetryPolicy, RunJournal,
+                                      supervise_train)
+    from dryad_tpu.resilience import faults as F
+
+    sds = spill(data, tmp_path, 1231)
+    p = dict(PARAMS, num_trees=12)
+    ref = dryad.train(p, data, backend="cpu")
+    injector = FaultInjector([(5, F.DEVICE_UNAVAILABLE, "dispatch"),
+                              (9, F.OOM, "fetch")])
+    jpath = str(tmp_path / "journal.jsonl")
+    got = supervise_train(p, sds, backend="cpu",
+                          checkpoint_dir=str(tmp_path / "ck"),
+                          checkpoint_every=3, journal=jpath,
+                          fault_injector=injector,
+                          policy=RetryPolicy(backoff_base_s=0.0))
+    assert injector.pending == 0
+    assert_same_booster(ref, got)
+    events = RunJournal.read(jpath)
+    assert any(e["event"] == "resume" for e in events)
+
+
+# ---- engine gates (fail loudly, never silently materialize) -----------------
+
+def test_engine_streamed_gates(data, tmp_path):
+    import jax
+
+    from dryad_tpu.engine.distributed import make_mesh
+
+    sds = spill(data, tmp_path, 700, "gates.bins")
+    with pytest.raises(ValueError, match="streamed"):
+        dryad.train(dict(PARAMS, num_trees=2), sds, backend="tpu",
+                    mesh=make_mesh(jax.devices()[:2]))
+    with pytest.raises(ValueError, match="materialize"):
+        dryad.train(dict(PARAMS, num_trees=2), data,
+                    valid_sets=[sds], backend="tpu")
+    # materialize() really is the resident equivalent
+    assert_same_booster(
+        dryad.train(dict(PARAMS, num_trees=2), data, backend="cpu"),
+        dryad.train(dict(PARAMS, num_trees=2), sds.materialize(),
+                    backend="cpu"))
+
+
+# ---- the retrain CLI's directory-of-shards corpus ---------------------------
+
+def test_retrain_cli_directory_corpus(tmp_path):
+    from dryad_tpu.__main__ import main
+
+    N, F = 1200, 8
+    rng = np.random.default_rng(31)
+    X = rng.standard_normal((N, F)).astype(np.float32)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0.2).astype(np.float32)
+    base = dryad.train(dict(PARAMS, num_trees=6),
+                       dryad.Dataset(X, y, max_bins=32), backend="cpu")
+    mpath = str(tmp_path / "m.dryad")
+    base.save(mpath)
+
+    Xf = rng.standard_normal((900, F)).astype(np.float32)
+    yf = (Xf[:, 0] + 0.5 * Xf[:, 1] > 0.2).astype(np.float32)
+    shard_dir = tmp_path / "shards"
+    shard_dir.mkdir()
+    np.savez(shard_dir / "a.npz", X=Xf[:400], y=yf[:400])
+    np.savez(shard_dir / "b.npz", X=Xf[400:], y=yf[400:])
+    np.savez(tmp_path / "fresh.npz", X=Xf, y=yf)
+
+    out_dir = str(tmp_path / "gen1_dir.dryad")
+    out_npz = str(tmp_path / "gen1_npz.dryad")
+    for out, src in ((out_dir, str(shard_dir)),
+                     (out_npz, str(tmp_path / "fresh.npz"))):
+        assert main(["retrain", "--model", mpath, "--data", src,
+                     "--out", out, "--trees", "3", "--backend", "cpu"]) == 0
+    a, b = dryad.Booster.load(out_dir), dryad.Booster.load(out_npz)
+    assert_same_booster(a, b)          # shard stream ≡ one resident npz
+    assert a.num_iterations == base.num_iterations + 3
+    n0 = base.feature.shape[0]         # old trees are a bitwise prefix
+    for k in KEYS:
+        np.testing.assert_array_equal(np.asarray(getattr(a, k))[:n0],
+                                      np.asarray(getattr(base, k)))
+    assert not os.path.exists(out_dir + ".bins")  # spill cleaned up
