@@ -19,9 +19,10 @@ is made of.  Set BENCH_10M=0 to skip (~5 min: two compiles + four runs).
 
 Env knobs: BENCH_ROWS (default 200000), BENCH_TREES (default 50),
 BENCH_LEAVES (default 255), BENCH_GROWTH (default depthwise),
-BENCH_10M (default 1), BENCH_DEEP / BENCH_LEAFWISE / BENCH_WIDE
-(default 1 — the wired-vs-legacy level probes and the r16 Epsilon-shaped
-hist_reduce fused-vs-feature scan probe).
+BENCH_10M (default 1), BENCH_DEEP / BENCH_LEAFWISE / BENCH_WIDE /
+BENCH_PREDICT (default 1 — the wired-vs-legacy level probes, the r16
+Epsilon-shaped hist_reduce fused-vs-feature scan probe, and the r21
+packed-vs-legacy predict traversal probe).
 
 r9 adds ``obs_overhead_ms``/``obs_overhead_pct``: instrumented-vs-
 disabled telemetry registry (dryad_tpu/obs) on the 200k series, min-of-3
@@ -344,6 +345,34 @@ def hist_reduce_probe(rows: int = 400_000, F: int = 2000, B: int = 256,
     }
 
 
+def predict_layout_probe(rows: int = 1_000_000, K: int = 4,
+                         reps: int = 2) -> dict | None:
+    """Per-tree traversal wall per predict table layout (r21): the legacy
+    structure-of-arrays arm (~7 small-table gathers per level) vs the
+    packed node-word arm (ONE (M,2)-uint32 limb-table gather per level) on
+    the same synthetic depth-6 tree.  Gather cost on TPU is per-ACCESS,
+    so the packed/legacy gap here is the real per-level lookup saving the
+    jaxpr census pins statically (18 vs 126 trip-weighted table gathers).
+    Both arms ride ``engine/probes`` liveness-proven timed-fori programs;
+    fields are us/row so serve-side percentiles have a unit to compare
+    against.  None on CPU."""
+    import jax
+
+    if jax.devices()[0].platform == "cpu":
+        return None
+    from dryad_tpu.engine.probes import run_probe
+
+    legacy = run_probe("predict_traversal", rows=rows, K=K, reps=reps)
+    packed = run_probe("predict_traversal_packed", rows=rows, K=K, reps=reps)
+    return {
+        "predict_us_per_row_packed": round(packed["ms"] * 1000.0 / rows, 4),
+        "predict_us_per_row_legacy": round(legacy["ms"] * 1000.0 / rows, 4),
+        "predict_spread_packed": round(packed["spread"], 3),
+        "predict_spread_legacy": round(legacy["spread"], 3),
+        "predict_probe_rows": rows,
+    }
+
+
 def main() -> None:
     # Pin the device-resident chunked boosting path: the bench estimates the
     # LONG-run (500-tree-scale) steady state from short timed runs, and the
@@ -561,6 +590,14 @@ def main() -> None:
     # like the wired/legacy pairs above.  BENCH_WIDE=0 skips.
     if os.environ.get("BENCH_WIDE", "1") != "0":
         probe = hist_reduce_probe()
+        if probe:
+            out.update(probe)
+
+    # ---- packed-vs-legacy predict traversal walls (r21) ---------------------
+    # One node-word table gather per level vs the structure-of-arrays ~7;
+    # same trend-not-point rule as the arms above.  BENCH_PREDICT=0 skips.
+    if os.environ.get("BENCH_PREDICT", "1") != "0":
+        probe = predict_layout_probe()
         if probe:
             out.update(probe)
 

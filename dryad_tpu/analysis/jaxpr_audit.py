@@ -79,6 +79,12 @@ class Census:
     global_row_sorts: int = 0
     local_row_sorts: int = 0
     row_gathers: int = 0
+    # gathers whose every operand sits BELOW the row threshold — the
+    # small-table per-node lookups of the predict traversal (r21).  Gather
+    # cost is per-ACCESS on TPU, so the packed node-word arm's whole point
+    # is this count: 1 per level vs the legacy structure-of-arrays 7.
+    # Trip-weighted like everything else.
+    table_gathers: int = 0
     pallas_kernels: dict = field(default_factory=dict)  # name -> set of sigs
     dynamic_loop: bool = False
     branch_mismatch: bool = False
@@ -86,7 +92,7 @@ class Census:
     def scaled(self, k: int) -> "Census":
         out = Census(Counter({p: n * k for p, n in self.collectives.items()}),
                      self.global_row_sorts * k, self.local_row_sorts * k,
-                     self.row_gathers * k,
+                     self.row_gathers * k, self.table_gathers * k,
                      {n: set(s) for n, s in self.pallas_kernels.items()},
                      self.dynamic_loop, self.branch_mismatch)
         return out
@@ -96,6 +102,7 @@ class Census:
         self.global_row_sorts += other.global_row_sorts
         self.local_row_sorts += other.local_row_sorts
         self.row_gathers += other.row_gathers
+        self.table_gathers += other.table_gathers
         for name, sigs in other.pallas_kernels.items():
             self.pallas_kernels.setdefault(name, set()).update(sigs)
         self.dynamic_loop |= other.dynamic_loop
@@ -137,8 +144,11 @@ def census_jaxpr(jaxpr, row_threshold: int,
                 out.local_row_sorts += 1
             else:
                 out.global_row_sorts += 1
-        elif name == "gather" and _max_rows(eqn) >= row_threshold:
-            out.row_gathers += 1
+        elif name == "gather":
+            if _max_rows(eqn) >= row_threshold:
+                out.row_gathers += 1
+            else:
+                out.table_gathers += 1
         elif name == "pallas_call":
             kname = getattr(eqn.params.get("name_and_src_info"), "name",
                             None) or "pallas"
@@ -177,6 +187,8 @@ def census_jaxpr(jaxpr, row_threshold: int,
                     merged.local_row_sorts = max(merged.local_row_sorts,
                                                  b.local_row_sorts)
                     merged.row_gathers = max(merged.row_gathers, b.row_gathers)
+                    merged.table_gathers = max(merged.table_gathers,
+                                               b.table_gathers)
                     for n, s in b.pallas_kernels.items():
                         merged.pallas_kernels.setdefault(n, set()).update(s)
                     merged.dynamic_loop |= b.dynamic_loop
@@ -377,8 +389,41 @@ def _arm_sharded_predict():
     args = (trees, sds((N, F), jnp.uint8), sds((1,), jnp.float32))
     meta = {"rows_threshold": N // N_SHARDS, "expected_psums": 0,
             "comm": {"psum_calls_per_iter": 0}}
+    # legacy structure-of-arrays traversal, CAT program: per level the
+    # feature/threshold/default_left/is_cat/left/right lookups + the
+    # cat_bitset word = 7 small-table gathers — the baseline the packed
+    # arm collapses to 1/level.  (The per-iteration value lookup's index
+    # operand is N-long after take_along_axis's reshape, so it lands in
+    # row_gathers, not here.)
     return fn, args, meta, {"expected_row_sorts": 0,
-                            "collective_free": True}
+                            "collective_free": True,
+                            "expected_table_gathers": 3 * 6 * 7}
+
+
+def _arm_packed_predict():
+    import jax
+    import jax.numpy as jnp
+
+    from dryad_tpu.engine.predict import sharded_accumulate_fn
+
+    mesh = _mesh()
+    N, F, M, n_iter, K, depth = 2048, 8, 63, 3, 1, 6
+    fn = sharded_accumulate_fn(mesh, depth)
+    sds = jax.ShapeDtypeStruct
+    # the r21 packed numeric program: node traversal fields live in ONE
+    # (M, 2)-uint32 limb table, no cat_bitset key -> statically bitset-free
+    trees = {
+        "node_word": sds((n_iter, K, M, 2), jnp.uint32),
+        "value": sds((n_iter, K, M), jnp.float32),
+    }
+    args = (trees, sds((N, F), jnp.uint8), sds((1,), jnp.float32))
+    meta = {"rows_threshold": N // N_SHARDS, "expected_psums": 0,
+            "comm": {"psum_calls_per_iter": 0}}
+    # exactly ONE node-word gather per level — the acceptance pin (<= 2
+    # small-table gathers/level; the value lookup rides row_gathers)
+    return fn, args, meta, {"expected_row_sorts": 0,
+                            "collective_free": True,
+                            "expected_table_gathers": 3 * 6 * 1}
 
 
 ARMS: dict[str, Arm] = {
@@ -420,6 +465,10 @@ ARMS: dict[str, Arm] = {
         "sharded_predict",
         "shard_map predict: zero collectives (per-row traversal)",
         _arm_sharded_predict),
+    "packed_predict": Arm(
+        "packed_predict",
+        "shard_map packed node-word predict: one table gather per level",
+        _arm_packed_predict),
 }
 
 
@@ -445,6 +494,7 @@ class ArmReport:
             "global_row_sorts": self.census.global_row_sorts,
             "local_row_sorts": self.census.local_row_sorts,
             "row_gathers": self.census.row_gathers,
+            "table_gathers": self.census.table_gathers,
             "pallas_kernels": {k: sorted(v) for k, v in
                                sorted(self.census.pallas_kernels.items())},
         }
@@ -532,6 +582,14 @@ def trace_arm(name: str) -> ArmReport:
             f"{expect['expected_row_sorts']} (threshold "
             f"{meta['rows_threshold']} rows) — only GOSS (+1/iter) and L1 "
             "renewal (+1/tree) may sort the global rows")
+    if "expected_table_gathers" in expect \
+            and census.table_gathers != expect["expected_table_gathers"]:
+        rep.failures.append(
+            f"small-table gathers {census.table_gathers} != expected "
+            f"{expect['expected_table_gathers']} — the predict traversal's "
+            "per-level lookup budget drifted (packed arm: exactly 1 "
+            "node-word gather/level; gather cost is per-ACCESS, so every "
+            "extra lookup is a real per-level cost)")
     if expect.get("wired") and census.local_row_sorts:
         rep.failures.append(
             f"{census.local_row_sorts} row-scale sort(s) inside the wired "
@@ -592,7 +650,8 @@ def run_audit(arm_names=None, goldens_path: Optional[str] = None,
                 "commit the diff")
             continue
         for key in ("digest", "collectives", "global_row_sorts",
-                    "local_row_sorts", "row_gathers", "pallas_kernels"):
+                    "local_row_sorts", "row_gathers", "table_gathers",
+                    "pallas_kernels"):
             if stored[name].get(key) != payloads[name][key]:
                 report.drift.append(
                     f"{name}: {key} drifted from golden "

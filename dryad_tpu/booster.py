@@ -110,6 +110,15 @@ class Booster:
     def num_iterations(self) -> int:
         return self.num_total_trees // self.num_outputs
 
+    @property
+    def has_categorical_splits(self) -> bool:
+        """True when ANY tree holds a categorical split.  Device staging
+        (engine/predict.stage_trees) uses the per-slice equivalent to drop
+        the ``cat_bitset`` table from numeric programs — dict-key presence
+        is static under jit, so the bitset gather disappears from the
+        traced traversal entirely rather than being masked at runtime."""
+        return bool(self.is_cat.any())
+
     def tree_arrays(self) -> dict[str, np.ndarray]:
         return {
             "feature": self.feature,

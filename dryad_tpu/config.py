@@ -166,6 +166,19 @@ class Params:
     # them (the documented chunked-vs-dispatch tolerance class in
     # engine/train.py); model quality is unaffected.
     deep_layout: str = "auto"    # auto | legacy
+    # Device predict traversal table layout (engine/predict.stage_trees,
+    # r21): "auto" stages the packed node-word tables — per node one
+    # (2,)-uint32 limb pair holding children/threshold/feature/
+    # default_left/is_cat/internal (width-asserted: children+threshold
+    # 16 bits, feature 12), so the per-level traversal body pays ONE
+    # small-table gather instead of the legacy structure-of-arrays ~7 —
+    # falling back to "legacy" when a field exceeds its width.  "packed"
+    # forces the packed arm (ValueError when it cannot fit); "legacy"
+    # keeps the per-field tables — the comparison arm for parity gates
+    # and benches.  Leaf-value accumulation is untouched by the layout,
+    # so packed ≡ legacy predict is BITWISE on every arm (single-device,
+    # sharded, serve cache) — tests/test_predict_packed.py pins it.
+    predict_layout: str = "auto"    # auto | packed | legacy
     # Cross-shard histogram reduction for the level-synchronous growers
     # (levelwise + the batched leaf-wise expansion) under shard_map:
     # "fused" keeps the classic one fused grad/hess/count psum of the full
@@ -310,6 +323,8 @@ class Params:
             raise ValueError("hist_backend must be auto|xla|pallas")
         if self.deep_layout not in ("auto", "legacy"):
             raise ValueError("deep_layout must be auto|legacy")
+        if self.predict_layout not in ("auto", "packed", "legacy"):
+            raise ValueError("predict_layout must be auto|packed|legacy")
         if self.hist_reduce not in ("auto", "fused", "feature"):
             raise ValueError("hist_reduce must be auto|fused|feature")
         if self.ch_max < 0:

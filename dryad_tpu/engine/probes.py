@@ -465,6 +465,42 @@ def _build_predict_traversal(rows, F, B, P, seed, depth: int = 6):
     return step, (Xb, tree), {"rows": rows, "depth": depth}
 
 
+def _build_predict_traversal_packed(rows, F, B, P, seed, depth: int = 6):
+    """The r21 packed node-word twin of ``predict_traversal``: the SAME
+    synthetic tree packed into the (M, 2)-uint32 limb table, numeric
+    program (no cat_bitset key), so the per-level body is one node-word
+    gather + the Xb column read.  The perturbation bumps limb1's
+    threshold field (low 16 bits) by the carried period-8 parity — the
+    synthetic thresholds top out at 3B/4, so +7 can never carry into the
+    feature bits, and the liveness signal is the legacy probe's exactly."""
+    import jax.numpy as jnp
+
+    from dryad_tpu.engine.predict import pack_node_words, tree_leaves
+
+    rng, Xb, _, _ = _synth(rows, F, B, seed)
+    n_internal = (1 << depth) - 1
+    M = (1 << (depth + 1)) - 1
+    feature = np.full(M, -1, np.int32)
+    feature[:n_internal] = rng.integers(0, F, n_internal)
+    threshold = np.zeros(M, np.int32)
+    threshold[:n_internal] = rng.integers(B // 4, (3 * B) // 4, n_internal)
+    nodes = np.arange(M, dtype=np.int32)
+    words = pack_node_words(
+        feature, threshold,
+        np.minimum(2 * nodes + 1, M - 1), np.minimum(2 * nodes + 2, M - 1),
+        np.ones(M, bool), np.zeros(M, bool))
+    Xb = jnp.asarray(Xb)
+    nw = jnp.asarray(words)
+
+    def step(s, Xb, nw):
+        si = s.astype(jnp.int32)
+        bump = jnp.array([0, 1], jnp.uint32) * (si % 8).astype(jnp.uint32)
+        lv = tree_leaves({"node_word": nw + bump}, Xb, depth)
+        return s + 1.0, jnp.sum(lv.astype(jnp.float32))
+
+    return step, (Xb, nw), {"rows": rows, "depth": depth}
+
+
 def _build_goss_sort(rows, F, B, P, seed):
     """The GOSS arm's +1 global sort per iteration (threshold quantile).
     Perturb the SORT KEY itself — a rolled key would sort to the same
@@ -532,6 +568,9 @@ PROBES: dict[str, StageProbe] = {p.name: p for p in (
     StageProbe("predict_traversal",
                "per-tree traversal (tree_leaves) on a depth-6 tree",
                _build_predict_traversal),
+    StageProbe("predict_traversal_packed",
+               "packed node-word traversal (one table gather/level, r21)",
+               _build_predict_traversal_packed),
     StageProbe("goss_sort",
                "GOSS global quantile sort (+1 sort/iteration arm)",
                _build_goss_sort),

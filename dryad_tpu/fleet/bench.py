@@ -152,6 +152,31 @@ def _start_fleet(model_path: str, n_replicas: int, *, backend: str,
     return sup, router, reg
 
 
+def _replica_layouts(sup: FleetSupervisor) -> Optional[str]:
+    """The fleet's served predict layout ("packed"/"legacy", r21), read
+    over the wire from one routable replica's ``/stats`` (the registry's
+    ``memory.staged_layouts`` block).  This module is jax-free by lint,
+    so the layout is observed exactly as an operator would see it — via
+    HTTP, never by loading the model.  None when no replica answers or
+    the replica predates the field (protocol stubs in tests)."""
+    for slot in sup.routable_slots():
+        if slot.proc is None:
+            continue
+        try:
+            status, payload = slot.proc.request("GET", "/stats",
+                                                timeout_s=5.0)
+            if status != 200:
+                continue
+            layouts = (json.loads(payload).get("memory") or {}).get(
+                "staged_layouts") or {}
+            if layouts:
+                # one model per bench fleet; newest staged version wins
+                return layouts[max(layouts, key=int)]
+        except (OSError, ValueError):
+            continue
+    return None
+
+
 def _router_states(reg: Registry) -> dict:
     """priority -> the router's end-to-end (stage="router") histogram
     state — snapshotted after warmup so percentiles cover MEASURED
@@ -218,6 +243,13 @@ def run_fleet_bench(model_path: str, num_features: int, *,
             # percentile baseline AFTER warmup: the reported (and
             # trend-gated) p99 must cover measured traffic only
             pct_base = _router_states(reg)
+            if "fleet_predict_layout" not in report:
+                # which traversal layout (r21 packed vs legacy) the
+                # replicas actually staged — read over the wire so the
+                # rows/s numbers are attributable to a layout arm
+                layout = _replica_layouts(sup)
+                if layout is not None:
+                    report["fleet_predict_layout"] = layout
             arm_rates = []
             failures = 0
             mismatches = 0

@@ -73,6 +73,10 @@ HIGHER_BETTER = frozenset({
     # r20 out-of-core training (scripts/stream_rss_probe.py): streamed
     # CPU train throughput
     "stream_train_rows_per_s",
+    # r21 packed-vs-legacy serve layout A/B (scripts/bench_serve.py
+    # --layout): closed-loop rows/s per traversal layout + their ratio
+    "layout_rows_per_s_packed", "layout_rows_per_s_legacy",
+    "predict_layout_speedup",
 })
 LOWER_BETTER = frozenset({
     "marginal_s_per_iter_10m", "wall_2tree_10m", "wall_8tree_10m",
@@ -86,6 +90,9 @@ LOWER_BETTER = frozenset({
     "drift_overhead_ms", "drift_overhead_pct",
     # r20 streamed-vs-resident train overhead and the RSS proof peak
     "stream_overhead_pct", "stream_rss_peak_mb",
+    # r21 per-layout predict traversal walls (bench.py
+    # predict_layout_probe: one node-word table gather/level vs ~7)
+    "predict_us_per_row_packed", "predict_us_per_row_legacy",
     "p50_ms", "p99_ms",
 })
 
@@ -109,6 +116,12 @@ _SPREAD_FIELDS = {
     "drift_overhead_pct": ("drift_overhead_spread",),
     "stream_train_rows_per_s": ("stream_overhead_spread",),
     "stream_overhead_pct": ("stream_overhead_spread",),
+    "predict_us_per_row_packed": ("predict_spread_packed",),
+    "predict_us_per_row_legacy": ("predict_spread_legacy",),
+    "layout_rows_per_s_packed": ("layout_spread_packed",),
+    "layout_rows_per_s_legacy": ("layout_spread_legacy",),
+    "predict_layout_speedup": ("layout_spread_packed",
+                               "layout_spread_legacy"),
     "rows_per_s": ("spread_rows_per_s",),
     "fleet_rows_per_s_n1": ("fleet_spread_n1",),
     "fleet_rows_per_s_n2": ("fleet_spread_n2",),

@@ -1,6 +1,13 @@
 """Micro-batching queue: coalesce concurrent requests into one dispatch,
 with an optional two-deep overlapped dispatch pipeline.
 
+The batcher is layout-agnostic by construction (r21): the traversal
+table layout (packed node-word vs legacy — ``Params.predict_layout``)
+is resolved once at registry staging time and reaches the device through
+the compiled-cache programs this module dispatches, so per-bucket
+batches run the packed program with no batcher-side branching and a
+model pushed with a different layout simply resolves new cache entries.
+
 A single collector thread drains a bounded queue.  The first dequeued
 request opens a batch and starts a max-wait deadline clock; requests
 keep joining until the row cap is reached or the deadline expires, then

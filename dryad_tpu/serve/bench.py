@@ -200,6 +200,46 @@ def run_bench_drift(model, *, arms: int = 2, **kw) -> dict:
     }
 
 
+def run_bench_layout(model, *, arms: int = 2, backend: str = "tpu",
+                     **kw) -> dict:
+    """Packed-vs-legacy traversal layout A/B (r21): the SAME closed loop
+    on two otherwise identical jax-backend servers, one forcing
+    ``predict_layout='packed'`` (one node-word table gather per level),
+    one ``'legacy'`` (the structure-of-arrays ~7).  The registry stages
+    each arm's layout once at model add; everything downstream (cache
+    programs, batcher dispatch, sharded family) inherits it, so the
+    rows/s gap is the per-level gather saving measured end to end.
+    ``layout_spread_*`` carries each arm's per-arm spread — the veto
+    convention of every A/B here.  Defaults to the 'tpu' (jax) backend:
+    the CPU predict path never stages device tables, so a cpu-backend
+    A/B would measure nothing.  Forcing 'packed' raises on a model whose
+    fields exceed the packed widths — a bench must not silently fall
+    back to measuring legacy twice."""
+    booster = model if isinstance(model, Booster) else Booster.load_any(model)
+    orig = booster.params
+    try:
+        booster.params = orig.replace(predict_layout="packed")
+        packed = run_bench(booster, backend=backend, arms=arms, **kw)
+        booster.params = orig.replace(predict_layout="legacy")
+        legacy = run_bench(booster, backend=backend, arms=arms, **kw)
+    finally:
+        booster.params = orig
+    speedup = (packed["rows_per_s"] / legacy["rows_per_s"]
+               if legacy["rows_per_s"] > 0 else 0.0)
+    return {
+        "layout_rows_per_s_packed": round(packed["rows_per_s"], 1),
+        "layout_rows_per_s_legacy": round(legacy["rows_per_s"], 1),
+        "predict_layout_speedup": round(speedup, 3),
+        "layout_spread_packed": packed["spread_rows_per_s"],
+        "layout_spread_legacy": legacy["spread_rows_per_s"],
+        "layout_recompiles_after_warmup": (
+            packed["recompiles_after_warmup"]
+            + legacy["recompiles_after_warmup"]),
+        "suspect_capture": (packed["suspect_capture"]
+                            or legacy["suspect_capture"]),
+    }
+
+
 def run_bench_compare(model, *, pipeline_depth: int = 2, **kw) -> dict:
     """Pipeline-vs-serial A/B on otherwise identical servers: the serial
     arm pins ``pipeline_depth=1`` (the strictly sequential dispatch loop),

@@ -183,8 +183,10 @@ class CompiledPredictCache:
             b = bucket_rows(m, self.min_bucket, self.max_bucket)
             fn = self._get(entry, b, self.shards_for(b, entry.num_outputs))
             if m < b:
+                # concatenate already yields a fresh contiguous array; the
+                # old ascontiguousarray pre-copy doubled the pad-path copy
                 pad = np.zeros((b - m,) + chunk.shape[1:], chunk.dtype)
-                chunk = np.concatenate([np.ascontiguousarray(chunk), pad])
+                chunk = np.concatenate([chunk, pad])
             chunks.append((fn, chunk, start, m))
         return PreparedPredict(entry, n, chunks)
 
@@ -277,6 +279,12 @@ class CompiledPredictCache:
             # eviction's re-stage is picked up transparently — jit caches
             # on shape/dtype, not array identity, so this never recompiles
             trees_dev, init_dev = entry.device_state(mesh)
+            # r21: the staged dict's keys carry the traversal layout —
+            # packed node-word tables dispatch the packed program per
+            # bucket with no cache-side branching, and a re-stage under a
+            # different predict_layout retraces via the pytree structure
+            # (the version in the key keeps introspection honest too)
+            layout = "packed" if "node_word" in trees_dev else "legacy"
             # compile-boundary introspection (memoized per shape; the
             # cache-level _get already notes the tripwire key, so the
             # capture only records dryad_prog_* cost series)
@@ -285,19 +293,21 @@ class CompiledPredictCache:
                 introspect.capture(
                     "serve.predict",
                     (entry.version, Xp.shape, n_shards, depth,
-                     trees_dev["value"].shape),
+                     trees_dev["value"].shape, layout),
                     acc, trees_dev, Xd, init_dev, note_tripwire=False,
-                    labels={"bucket": Xp.shape[0], "shards": n_shards})
+                    labels={"bucket": Xp.shape[0], "shards": n_shards,
+                            "layout": layout})
                 raw = np.asarray(acc(trees_dev, Xd, init_dev))
             else:
                 Xj = jnp.asarray(Xp)
                 introspect.capture(
                     "serve.predict",
                     (entry.version, Xp.shape, 1, depth,
-                     trees_dev["value"].shape),
+                     trees_dev["value"].shape, layout),
                     _accumulate, trees_dev, Xj, init_dev, depth,
                     note_tripwire=False,
-                    labels={"bucket": Xp.shape[0], "shards": 1})
+                    labels={"bucket": Xp.shape[0], "shards": 1,
+                            "layout": layout})
                 raw = np.asarray(_accumulate(trees_dev, Xj, init_dev,
                                              depth))
             if is_rf:

@@ -80,6 +80,21 @@ class ModelEntry:
             return self._staged is not None
 
     @property
+    def staged_layout(self) -> Optional[str]:
+        """Traversal table layout of the staged predict state (r21):
+        ``"packed"`` (node-word limb table) or ``"legacy"`` — None while
+        nothing is staged.  Resolved once at ``stage_trees`` time from the
+        model's ``predict_layout`` param; every downstream consumer
+        (cache programs, sharded family, fleet replicas) inherits the
+        staged dict, so this is THE layout the whole serve path runs."""
+        with self._lock:
+            if self._staged is None:
+                return None
+            from dryad_tpu.engine.predict import staged_layout
+
+            return staged_layout(self._staged[0])
+
+    @property
     def staged_bytes(self) -> int:
         """The budget's accounting unit: the host staged tables plus one
         mirror per device-state family built so far (a model warm on BOTH
@@ -308,6 +323,9 @@ class ModelRegistry:
                 "budget_bytes": self.budget_bytes,
                 "staged_bytes": sum(staged.values()),
                 "staged_versions": sorted(staged),
+                # r21: which traversal layout each staged version runs
+                "staged_layouts": {v: self._models[v].staged_layout
+                                   for v in sorted(staged)},
             }
 
     # ---- lookup ------------------------------------------------------------

@@ -24,6 +24,12 @@ Arms:
               ``drift_overhead_ms/_pct/_spread`` (obs/trends.py tracks
               them); exit 1 when the cost exceeds 2% and the spread does
               not veto the capture
+  --layout    packed-vs-legacy predict traversal layout A/B (r21): the
+              same closed loop with ``predict_layout`` forced to packed
+              (one node-word table gather per level) vs legacy (~7) on
+              the jax backend — ``layout_rows_per_s_packed/_legacy`` +
+              ``predict_layout_speedup`` (obs/trends.py tracks them);
+              recompiles in either arm fail the run
   --fleet     closed-loop fleet arm (r14, dryad_tpu/fleet/bench.py): REAL
               subprocess replicas behind the router at N=1/2/4
               (``fleet_rows_per_s_nN`` + spreads + ``fleet_scaling_nN``)
@@ -160,6 +166,11 @@ def main(argv=None) -> int:
                     help="drift-monitor overhead A/B (instrumented vs "
                          "disabled; drift_overhead_ms/_pct/_spread, exit 1 "
                          "over the 2% budget unless the spread vetoes)")
+    ap.add_argument("--layout", action="store_true",
+                    help="packed-vs-legacy predict layout A/B on the jax "
+                         "backend (layout_rows_per_s_packed/_legacy + "
+                         "predict_layout_speedup; exit 1 on any recompile "
+                         "after warmup in either arm)")
     ap.add_argument("--fleet", action="store_true",
                     help="closed-loop fleet arm: real subprocess replicas "
                          "at N=1/2/4 + a rolling-swap drill (standalone; "
@@ -230,6 +241,20 @@ def main(argv=None) -> int:
         summary.update({k: v for k, v in drift.items()
                         if k.startswith("drift_overhead")})
 
+    if args.layout:
+        # r21 packed-vs-legacy traversal layout A/B: always on the jax
+        # backend ('tpu'; the 8 fake CPU devices in CI) — the cpu predict
+        # path never stages device tables, so it has no layout to compare
+        from dryad_tpu.serve.bench import run_bench_layout
+
+        layout = run_bench_layout(model,
+                                  pipeline_depth=args.pipeline_depth, **kw)
+        report["layout"] = layout
+        summary.update({k: v for k, v in layout.items()
+                        if k.startswith(("layout_", "predict_layout"))})
+        summary["suspect_capture"] = (summary.get("suspect_capture", False)
+                                      or layout["suspect_capture"])
+
     if args.sharded:
         # forced-sharded arm: every bucket takes the shard_map family
         sharded_report = run_bench(model, backend="tpu", sharded=True,
@@ -276,6 +301,7 @@ def main(argv=None) -> int:
 
     recompiles = summary.get("recompiles_after_warmup", 0)
     recompiles += summary.get("sharded_recompiles_after_warmup", 0)
+    recompiles += summary.get("layout_recompiles_after_warmup", 0)
     if recompiles != 0:
         print("WARNING: cache recompiled after warmup", file=sys.stderr)
         return 1

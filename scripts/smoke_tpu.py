@@ -277,6 +277,54 @@ def smoke_hist_reduce_parity():
           "(both growers, degenerate 1-shard feature program)")
 
 
+def smoke_predict_packed_parity():
+    """Packed node-word traversal (r21) vs legacy ON THE REAL DEVICE:
+    bitwise-identical raw scores across numeric/missing, categorical and
+    multiclass models.  Interpret-mode CI pins the same identity on the
+    CPU backend and the 8-virtual-device mesh; what only an attached TPU
+    can vouch for is the LOWERING of the packed body — the uint32 limb
+    shifts/masks and the single node-table gather fuse differently than
+    the legacy seven-array reads, and a drift there would flip predict
+    bits (the serve registry stages packed by default, so every fleet
+    replica runs this program)."""
+    import jax
+    import numpy as np
+
+    import dryad_tpu as dryad
+    from dryad_tpu.datasets import higgs_like
+    from dryad_tpu.engine.predict import stage_trees, staged_layout
+
+    if jax.devices()[0].platform == "cpu":
+        print("packed predict parity: skipped (no accelerator attached)")
+        return
+    X, y = higgs_like(20_000, seed=23)
+    X = X.copy()
+    X[::7, 3] = np.nan    # exercise default_left on device
+    configs = [
+        ("binary", dict(objective="binary", num_trees=6, num_leaves=31,
+                        max_bins=64)),
+        ("multiclass", dict(objective="multiclass", num_class=3,
+                            num_trees=4, num_leaves=15, max_bins=64)),
+    ]
+    for name, p in configs:
+        yy = (y if name == "binary"
+              else (np.abs(X[:, 0]) * 7).astype(np.int32) % 3)
+        ds = dryad.Dataset(X, yy, max_bins=64)
+        booster = dryad.train(p, ds, backend="tpu")
+        assert staged_layout(stage_trees(booster)[0]) == "packed", name
+        booster.params = booster.params.replace(predict_layout="legacy")
+        legacy = booster.predict_binned(ds.X_binned, raw_score=True,
+                                        backend="tpu")
+        booster.params = booster.params.replace(predict_layout="packed")
+        packed = booster.predict_binned(ds.X_binned, raw_score=True,
+                                        backend="tpu")
+        np.testing.assert_array_equal(
+            np.asarray(legacy), np.asarray(packed),
+            err_msg=f"{name}: packed vs legacy predict on device")
+    print(f"packed predict parity on device: {len(configs)} models — "
+          "packed ≡ legacy bitwise (one node-word gather per level)")
+
+
 def smoke_stage_profiler():
     """First per-stage device breakdown (r13): run the cheap tier of the
     stage-probe registry (engine/probes) on the attached device, each
@@ -342,6 +390,7 @@ _ALL_SMOKES = [
     smoke_leafperm_wired_parity,
     smoke_leafwise_wired_parity,
     smoke_hist_reduce_parity,
+    smoke_predict_packed_parity,
     smoke_stage_profiler,
 ]
 

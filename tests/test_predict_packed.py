@@ -1,0 +1,246 @@
+"""Packed node-word predict traversal (r21): packed ≡ legacy ≡ CPU, bitwise.
+
+The packed arm stages every node's traversal fields in one (M, 2)-uint32
+limb table so the per-level body pays a single small-table gather; the
+accumulation scan is byte-for-byte the legacy one, so the identity is by
+construction — these tests pin it across numeric/missing/categorical/
+multiclass/rf models, ``num_iteration`` slicing, 1/2/8-shard meshes, and
+the serve registry, plus the pack/unpack round trip and the width-overflow
+fallbacks that keep "auto" safe on any model."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+
+import dryad_tpu as dryad
+from dryad_tpu.datasets import higgs_like
+from dryad_tpu.engine.predict import (PACKED_CHILD_BITS,
+                                      PACKED_FEATURE_BITS,
+                                      PACKED_THRESHOLD_BITS,
+                                      pack_node_words, packed_fields_fit,
+                                      stage_trees, staged_layout,
+                                      unpack_node_words)
+
+
+def _train(params: dict, X, y, *, cat=()):
+    ds = dryad.Dataset(X, y, max_bins=32, categorical_features=cat)
+    return dryad.train(dict(params, max_bins=32), ds, backend="cpu"), ds
+
+
+@pytest.fixture(scope="module")
+def model_numeric_missing():
+    """Binary model on missing-heavy rows: exercises default_left."""
+    X, y = higgs_like(700, seed=11)
+    X = X.copy()
+    X[::5, 2] = np.nan
+    X[1::7, 4] = np.nan
+    return _train(dict(objective="binary", num_trees=8, num_leaves=15), X, y)
+
+
+@pytest.fixture(scope="module")
+def model_categorical():
+    rng = np.random.default_rng(5)
+    n = 800
+    X = rng.standard_normal((n, 6)).astype(np.float32)
+    X[:, 1] = rng.integers(0, 12, n)
+    X[::9, 3] = np.nan
+    y = (X[:, 0] + (X[:, 1] > 5) > 0).astype(np.float32)
+    return _train(dict(objective="binary", num_trees=8, num_leaves=15),
+                  X, y, cat=(1,))
+
+
+@pytest.fixture(scope="module")
+def model_multiclass():
+    rng = np.random.default_rng(9)
+    X = rng.standard_normal((600, 8)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32) + (X[:, 2] > 0.4)
+    return _train(dict(objective="multiclass", num_class=3, num_trees=5,
+                       num_leaves=7), X, y)
+
+
+@pytest.fixture(scope="module")
+def model_rf():
+    X, y = higgs_like(700, seed=13)
+    return _train(dict(objective="binary", boosting="rf", num_trees=6,
+                       num_leaves=15, subsample=0.6), X, y)
+
+
+ALL_MODELS = ("model_numeric_missing", "model_categorical",
+              "model_multiclass", "model_rf")
+
+
+def _predict_layout(booster, Xb, layout, **kw):
+    booster.params = booster.params.replace(predict_layout=layout)
+    try:
+        return booster.predict_binned(Xb, raw_score=True, backend="tpu", **kw)
+    finally:
+        booster.params = booster.params.replace(predict_layout="auto")
+
+
+# ---- pack/unpack round trip -------------------------------------------------
+
+def test_pack_unpack_round_trip():
+    rng = np.random.default_rng(0)
+    shape = (3, 2, 37)
+    feature = rng.integers(-1, 1 << PACKED_FEATURE_BITS, shape)
+    internal = feature >= 0
+    threshold = rng.integers(0, 1 << PACKED_THRESHOLD_BITS, shape)
+    left = rng.integers(0, 1 << PACKED_CHILD_BITS, shape)
+    right = rng.integers(0, 1 << PACKED_CHILD_BITS, shape)
+    default_left = rng.integers(0, 2, shape).astype(bool)
+    is_cat = rng.integers(0, 2, shape).astype(bool)
+    words = pack_node_words(feature, threshold, left, right,
+                            default_left, is_cat)
+    assert words.dtype == np.uint32 and words.shape == shape + (2,)
+    got = unpack_node_words(words)
+    # leaf fields are canonicalised to zero (feature to -1): the packing is
+    # a pure function of the traversal-relevant content
+    np.testing.assert_array_equal(got["feature"],
+                                  np.where(internal, feature, -1))
+    for name, ref in (("threshold", threshold), ("left", left),
+                      ("right", right)):
+        np.testing.assert_array_equal(got[name], np.where(internal, ref, 0))
+    for name, ref in (("default_left", default_left), ("is_cat", is_cat)):
+        np.testing.assert_array_equal(got[name], internal & ref)
+
+
+def test_pack_width_overflow_raises():
+    ones = np.ones(4, np.int64)
+    for field, bad in (("feature", 1 << PACKED_FEATURE_BITS),
+                       ("threshold", 1 << PACKED_THRESHOLD_BITS),
+                       ("left", 1 << PACKED_CHILD_BITS),
+                       ("right", 1 << PACKED_CHILD_BITS)):
+        kw = dict(feature=ones, threshold=ones, left=ones, right=ones)
+        kw[field] = np.where(np.arange(4) == 1, bad, 1)
+        assert not packed_fields_fit(kw["feature"], kw["threshold"],
+                                     kw["left"], kw["right"])
+        with pytest.raises(ValueError, match=field):
+            pack_node_words(kw["feature"], kw["threshold"], kw["left"],
+                            kw["right"], ones.astype(bool),
+                            np.zeros(4, bool))
+
+
+def test_packed_fields_fit_all_leaves():
+    leaf = -np.ones(5, np.int64)
+    huge = np.full(5, 1 << 40)
+    assert packed_fields_fit(leaf, huge, huge, huge)    # no internal nodes
+
+
+# ---- stage_trees layout resolution -----------------------------------------
+
+def test_stage_trees_key_sets(model_numeric_missing, model_categorical):
+    num, _ = model_numeric_missing
+    cat, _ = model_categorical
+    trees, _, _ = stage_trees(num)
+    assert sorted(trees) == ["node_word", "value"]
+    assert staged_layout(trees) == "packed"
+    trees, _, _ = stage_trees(cat)
+    assert sorted(trees) == ["cat_bitset", "node_word", "value"]
+    # legacy numeric drops the dead is_cat/cat_bitset gathers (satellite)
+    trees, _, _ = stage_trees(num, layout="legacy")
+    assert staged_layout(trees) == "legacy"
+    assert "is_cat" not in trees and "cat_bitset" not in trees
+    trees, _, _ = stage_trees(cat, layout="legacy")
+    assert "is_cat" in trees and "cat_bitset" in trees
+
+
+def test_stage_trees_auto_falls_back_on_overflow(model_numeric_missing):
+    booster, ds = model_numeric_missing
+    ref = booster.predict_binned(ds.X_binned, raw_score=True)
+    saved = booster.feature.copy()
+    try:
+        idx = np.argwhere(booster.feature >= 0)[0]
+        booster.feature[tuple(idx)] = 1 << PACKED_FEATURE_BITS
+        trees, _, _ = stage_trees(booster)           # auto -> legacy
+        assert staged_layout(trees) == "legacy"
+        with pytest.raises(ValueError, match="feature"):
+            stage_trees(booster, layout="packed")    # forced packed refuses
+    finally:
+        booster.feature[:] = saved
+    np.testing.assert_array_equal(
+        booster.predict_binned(ds.X_binned, raw_score=True), ref)
+
+
+def test_params_validate_predict_layout():
+    with pytest.raises(ValueError, match="predict_layout"):
+        dryad.Params.from_dict({"predict_layout": "zigzag"})
+
+
+# ---- bitwise parity ---------------------------------------------------------
+
+@pytest.mark.parametrize("fixture", ALL_MODELS)
+def test_packed_equals_legacy_equals_cpu(fixture, request):
+    booster, ds = request.getfixturevalue(fixture)
+    Xb = ds.X_binned
+    cpu = booster.predict_binned(Xb, raw_score=True, backend="cpu")
+    legacy = _predict_layout(booster, Xb, "legacy")
+    packed = _predict_layout(booster, Xb, "packed")
+    auto = booster.predict_binned(Xb, raw_score=True, backend="tpu")
+    np.testing.assert_array_equal(legacy, packed, err_msg=fixture)
+    np.testing.assert_array_equal(packed, auto, err_msg=fixture)
+    np.testing.assert_array_equal(packed, cpu, err_msg=fixture)
+
+
+def test_packed_num_iteration_slicing(model_numeric_missing):
+    booster, ds = model_numeric_missing
+    for n_iter in (1, 3):
+        legacy = _predict_layout(booster, ds.X_binned, "legacy",
+                                 num_iteration=n_iter)
+        packed = _predict_layout(booster, ds.X_binned, "packed",
+                                 num_iteration=n_iter)
+        cpu = booster.predict_binned(ds.X_binned, raw_score=True,
+                                     backend="cpu", num_iteration=n_iter)
+        np.testing.assert_array_equal(legacy, packed)
+        np.testing.assert_array_equal(packed, cpu)
+
+
+@pytest.mark.parametrize("n_shards", [1, 2, 8])
+def test_packed_sharded_parity(model_categorical, n_shards):
+    from dryad_tpu.engine.distributed import make_mesh
+    from dryad_tpu.engine.predict import predict_binned_sharded
+
+    booster, ds = model_categorical
+    assert len(jax.devices()) >= 8, "conftest must provide 8 virtual devices"
+    mesh = make_mesh(jax.devices()[:n_shards])
+    single = _predict_layout(booster, ds.X_binned, "packed")
+    booster.params = booster.params.replace(predict_layout="packed")
+    try:
+        # 13 rows does not divide the mesh: the pad path must not leak
+        for n in (13, ds.X_binned.shape[0]):
+            got = np.asarray(predict_binned_sharded(
+                booster, ds.X_binned[:n], mesh=mesh))
+            np.testing.assert_array_equal(
+                got.reshape(n, -1), np.asarray(single)[:n].reshape(n, -1),
+                err_msg=f"shards={n_shards} n={n}")
+    finally:
+        booster.params = booster.params.replace(predict_layout="auto")
+
+
+# ---- serve path -------------------------------------------------------------
+
+def test_registry_stages_packed_and_reports_layout(model_numeric_missing):
+    from dryad_tpu.serve import ModelRegistry, PredictServer
+
+    booster, ds = model_numeric_missing
+    server = PredictServer(backend="tpu", max_batch_rows=64, max_wait_ms=0.2)
+    v = server.registry.add(booster)
+    with server:
+        direct = booster.predict_binned(ds.X_binned[:33])
+        served = server.predict(ds.X_binned[:33], binned=True)
+        np.testing.assert_array_equal(served, direct)
+        entry = server.registry.get(v)
+        assert entry.staged_layout == "packed"
+        mem = server.registry.memory()
+        assert mem["staged_layouts"] == {v: "packed"}
+    # a legacy-pinned model reports legacy through the same channel
+    reg = ModelRegistry()
+    booster.params = booster.params.replace(predict_layout="legacy")
+    try:
+        v2 = reg.add(booster)
+        reg.get(v2).staged()
+        assert reg.get(v2).staged_layout == "legacy"
+    finally:
+        booster.params = booster.params.replace(predict_layout="auto")
