@@ -578,7 +578,8 @@ def cmd_fleet(args) -> int:
     """Replicated serving: N serve subprocesses under lifecycle
     supervision (crash/hang detection, budgeted respawn, journal) behind
     the health-routed fleet router (dryad_tpu/fleet)."""
-    from dryad_tpu.fleet import FleetSupervisor, make_fleet_router, serve_argv
+    from dryad_tpu.fleet import (CapacityController, FleetSupervisor,
+                                 make_fleet_router, serve_argv)
     from dryad_tpu.fleet.router import main_loop
     from dryad_tpu.obs.drift import parse_psi_budget
     from dryad_tpu.obs.slo import parse_budgets
@@ -626,10 +627,21 @@ def cmd_fleet(args) -> int:
                           drift_window=args.drift_window,
                           auth_token=args.auth_token)
 
+    # elastic bounds (r22): --replicas alone keeps the frozen-pool
+    # behavior (min == max == replicas); explicit bounds arm the
+    # capacity controller, and the pool starts inside them
+    min_replicas = (args.min_replicas if args.min_replicas is not None
+                    else args.replicas)
+    max_replicas = (args.max_replicas if args.max_replicas is not None
+                    else args.replicas)
+    if not 1 <= min_replicas <= max_replicas:
+        raise SystemExit("need 1 <= --min-replicas <= --max-replicas")
+    n_start = min(max(args.replicas, min_replicas), max_replicas)
+
     policy = (RetryPolicy() if args.retry_budget is None
               else RetryPolicy(retry_budget=args.retry_budget))
     supervisor = FleetSupervisor(
-        make_argv, args.replicas, policy=policy, journal=args.journal,
+        make_argv, n_start, policy=policy, journal=args.journal,
         probe_interval_s=args.probe_interval,
         startup_timeout_s=args.startup_timeout)
     # a process MANAGER must not die leaving its children running: the
@@ -648,6 +660,7 @@ def cmd_fleet(args) -> int:
     # (which terminates whatever was already spawned), or the half-built
     # pool leaks serve processes
     scheduler = None
+    controller = None
     try:
         supervisor.start()
         httpd = make_fleet_router(
@@ -663,11 +676,21 @@ def cmd_fleet(args) -> int:
             drift_budget_psi=parse_psi_budget(args.drift_psi),
             drift_breach_after=args.drift_breach_after)
         host, port = httpd.server_address[:2]
+        if max_replicas > min_replicas:
+            controller = CapacityController(
+                supervisor, httpd.state.capacity_signals,
+                min_replicas=min_replicas, max_replicas=max_replicas,
+                breach_after=args.scale_breach_after,
+                cooldown_up_s=args.scale_cooldown,
+                cooldown_down_s=2.0 * args.scale_cooldown).start()
+            httpd.state.autoscale = controller
         if not args.quiet:
             urls = {s.name: s.state()["url"]
                     for s in supervisor.slots}
+            elastic = (f", elastic {min_replicas}..{max_replicas}"
+                       if controller is not None else "")
             print(f"dryad fleet on http://{host}:{port}  "
-                  f"({args.replicas} replicas: {urls}; POST /predict, "
+                  f"({n_start} replicas{elastic}: {urls}; POST /predict, "
                   "POST /models/push, GET /metrics aggregates the pool)")
         if continual_models:
             from dryad_tpu.continual import (JournalTailer,
@@ -705,7 +728,13 @@ def cmd_fleet(args) -> int:
     finally:
         if scheduler is not None:
             scheduler.stop(timeout_s=5.0)
+        if controller is not None:
+            # signal first with a short join: an in-flight scale-up
+            # unblocks when supervisor.stop() below reaps its child
+            controller.stop(timeout_s=2.0)
         supervisor.stop()
+        if controller is not None:
+            controller.stop(timeout_s=5.0)
     return 0
 
 
@@ -893,7 +922,25 @@ def main(argv=None) -> int:
                     help="model path or NAME=path alias; repeat to co-serve "
                          "(every replica loads the same set)")
     fl.add_argument("--replicas", type=int, default=2,
-                    help="serve subprocesses in the pool")
+                    help="serve subprocesses in the pool (with elastic "
+                         "bounds unset this is also min == max: the "
+                         "frozen pre-r22 pool)")
+    fl.add_argument("--min-replicas", type=int, default=None,
+                    help="elastic floor (r22): the capacity loop never "
+                         "drains below this many slots (default "
+                         "--replicas)")
+    fl.add_argument("--max-replicas", type=int, default=None,
+                    help="elastic ceiling (r22): the capacity loop never "
+                         "grows past this many slots (default "
+                         "--replicas; max > min arms the controller)")
+    fl.add_argument("--scale-cooldown", type=float, default=60.0,
+                    help="seconds after a scale-up before the next one "
+                         "(scale-downs wait 2x this) — one breach burst "
+                         "buys one replica, not a ramp-to-max")
+    fl.add_argument("--scale-breach-after", type=int, default=2,
+                    help="consecutive pressure polls (sustained SLO "
+                         "breach or admission saturation) before a "
+                         "scale-up is admitted")
     fl.add_argument("--backend", default="auto",
                     choices=["auto", "tpu", "cpu"])
     fl.add_argument("--host", default="127.0.0.1")

@@ -1397,6 +1397,152 @@ def drill_stream_prefetch(sched: Scheduler):
     return check
 
 
+def drill_capacity_breach_vs_push(sched: Scheduler):
+    """r22 elastic capacity: CapacityController vs concurrent breach
+    deliveries vs rolling push vs a replica crash.
+
+    The REAL CapacityController drives the REAL FleetSupervisor slot
+    registry (fake replica processes on the virtual clock): two scaler
+    tasks poke the controller concurrently under sustained pressure
+    while a rolling push swaps models and a replica dies mid-everything;
+    once the new replica is routable the signal flips to sustained
+    headroom and the pool must drain back down.  Invariants: EXACTLY ONE
+    scale-up for the burst (every refused poke journals
+    ``scale_skipped`` with a canonical reason), exactly one scale-down,
+    the retired slot is never resurrected by the monitor, pokes at the
+    min bound journal ``at-bound``, router-shaped traffic never leaks
+    inflight through the drain, and the runtime lock order stays
+    acyclic.  Mechanically splitting ``_admit``'s checks from its
+    in-flight mark (the unlocked mutation in the pytest revert test)
+    double-launches the spawn and fails the drill."""
+    from dryad_tpu.fleet.autoscale import (SKIP_AT_BOUND, SKIP_COOLDOWN,
+                                           SKIP_IN_FLIGHT, SKIP_SUSTAIN,
+                                           CapacityController)
+    from dryad_tpu.obs.registry import Registry
+
+    fs, journal, procs = _make_fleet(sched, {}, n=2)
+    sig = {"mode": "calm"}
+
+    def signals() -> dict:
+        # the drill's router stand-in: pressure = admission saturation,
+        # headroom = near-empty fleet, calm = neither (streaks reset)
+        mode = sig["mode"]
+        inflight = {"pressure": 8, "headroom": 0, "calm": 4}[mode]
+        slo = ({"interactive": {"breached": True, "sustained": True,
+                                "p_ms": 900.0, "budget_ms": 250.0}}
+               if mode == "pressure" else {})
+        return {"slo": slo, "inflight": inflight, "max_inflight": 10,
+                "slots": {}}
+
+    ctrl = CapacityController(
+        fs, signals, min_replicas=2, max_replicas=3,
+        breach_after=1, idle_after=1,
+        cooldown_up_s=1000.0, cooldown_down_s=1000.0,
+        poll_interval_s=0.01, drain_timeout_s=5.0,
+        registry=Registry(enabled=False))
+
+    def by_name(name: str) -> _FakeReplicaProc:
+        for p in procs:
+            if p.name == name:
+                return p
+        raise AssertionError(f"no spawned proc named {name}")
+
+    def scaler() -> None:
+        for _ in range(2):
+            ctrl.poke()
+            _time_mod.sleep(0.003)
+
+    def traffic() -> None:
+        # router-shaped clients: mark in-flight, re-check routable (the
+        # pick->inc window close), work, unmark — the retire drain must
+        # wait these out, never drop them
+        for i in range(12):
+            slots = fs.slots
+            slot = slots[i % len(slots)]
+            slot.inflight_inc()
+            if not slot.routable:
+                slot.inflight_dec()
+                continue
+            _time_mod.sleep(0.003)
+            slot.inflight_dec()
+
+    def pusher() -> None:
+        _wait_until(lambda: fs._monitor is not None, "fleet started")
+        fs.rolling_push("model-v2", drain_timeout_s=5.0)
+
+    def killer() -> None:
+        _wait_until(lambda: any(p.name.startswith("r2") for p in procs),
+                    "scale-up spawn dispatched")
+        by_name("r0g0").exit_code = 23       # crash slot 0 mid-scale-up
+
+    def downscaler() -> None:
+        for _ in range(60):
+            ctrl.poke()
+            if (len(fs.slots) == 2
+                    and ctrl.state()["action_in_flight"] is None):
+                break
+            _time_mod.sleep(0.01)
+        ctrl.poke()                          # at the min bound now:
+        ctrl.poke()                          # must journal ``at-bound``
+
+    def controller() -> None:
+        fs.start()
+        sig["mode"] = "pressure"
+        tasks = [sched.spawn(scaler, "scale-a"),
+                 sched.spawn(scaler, "scale-b"),
+                 sched.spawn(traffic, "traffic"),
+                 sched.spawn(pusher, "pusher"),
+                 sched.spawn(killer, "killer")]
+        _wait_until(lambda: "scale_up" in journal.kinds(),
+                    "the burst admitted a scale-up")
+        _wait_until(lambda: len(fs.slots) == 3 and fs.slots[2].routable,
+                    "new replica routable")
+        _wait_until(lambda: fs.slots[0].generation == 1
+                    and fs.slots[0].healthy, "crashed replica respawned")
+        _wait_until(lambda: all(x.state == _DONE for x in tasks),
+                    "pressure-phase tasks done")
+        _wait_until(lambda: ctrl.state()["action_in_flight"] is None,
+                    "scale-up settled")
+        sig["mode"] = "headroom"
+        down = sched.spawn(downscaler, "downscaler")
+        _wait_until(lambda: down.state == _DONE, "drain-down done")
+        ctrl.stop(timeout_s=1.0)
+        fs.stop()
+
+    sched.spawn(controller, "controller")
+
+    def check() -> None:
+        kinds = journal.kinds()
+        assert kinds.count("scale_up") == 1, (
+            f"capacity burst not exactly-one: {kinds} — _admit's check "
+            "and in-flight mark are not one critical section")
+        assert kinds.count("scale_down") == 1, kinds
+        assert kinds.count("scale_failed") == 0, kinds
+        assert kinds.count("replica_retired") == 1, kinds
+        reasons = [f.get("reason") for k, f in journal.events
+                   if k == "scale_skipped"]
+        assert set(reasons) <= {SKIP_AT_BOUND, SKIP_COOLDOWN,
+                                SKIP_IN_FLIGHT, SKIP_SUSTAIN}, reasons
+        assert SKIP_AT_BOUND in reasons, (
+            f"min-bound pokes never journaled at-bound: {reasons}")
+        r2_spawns = [p.name for p in procs if p.name.startswith("r2")]
+        assert r2_spawns == ["r2g0"], (
+            f"retired slot resurrected: {r2_spawns}")
+        names = [s.name for s in fs.slots]
+        assert names == ["r0", "r1"], f"pool did not settle: {names}"
+        for slot in fs.slots:
+            assert not slot.retiring, f"{slot.name} left retiring"
+            assert slot.inflight == 0, \
+                f"{slot.name} leaked inflight={slot.inflight}"
+        assert all(p.exit_code is not None for p in procs), \
+            "stop() left a live replica process"
+        st = ctrl.state()
+        assert st["action_in_flight"] is None, st
+        assert st["actions_total"] == {"up": 1, "down": 1}, st
+
+    return check
+
+
 #: name -> (drill, schedules to run in CI, preempt_p, trace file suffixes)
 DRILLS: dict = {
     "batcher-stop-start": (drill_batcher_stop_start, 20, 0.1,
@@ -1417,6 +1563,9 @@ DRILLS: dict = {
                                  ("continual/scheduler.py",)),
     "stream-prefetch": (drill_stream_prefetch, 15, 0.25,
                         ("data/stream_dataset.py",)),
+    "capacity-vs-breach-vs-push": (drill_capacity_breach_vs_push, 10, 0.1,
+                                   ("fleet/autoscale.py",
+                                    "fleet/supervisor.py")),
 }
 
 

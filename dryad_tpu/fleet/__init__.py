@@ -8,8 +8,11 @@ append-only journal: the resilience subsystem's machinery pointed at
 processes instead of device faults), fronted by a thin stdlib router
 (health-aware routing, one retry on a different replica, priority-classed
 load shedding, per-model admission caps) with zero-drop rolling model
-pushes (drain at the pinned version, then swap, replica by replica) and
-one aggregated ``/metrics``/``/healthz`` scrape for the whole pool.
+pushes (drain at the pinned version, then swap, replica by replica),
+one aggregated ``/metrics``/``/healthz`` scrape for the whole pool, and
+(r22) an SLO-driven capacity loop (``CapacityController``) that adds a
+replica on sustained p99 breach or admission saturation and drains one
+back out on sustained headroom, inside declared min/max bounds.
 
 The package is host-side and jax-free by lint (the same contract as
 ``dryad_tpu/obs``): replicas own the devices; the fleet owns processes
@@ -24,6 +27,7 @@ and sockets.  Entry points::
 or ``python -m dryad_tpu fleet --model m.dryad --replicas 2 --port 8000``.
 """
 
+from dryad_tpu.fleet.autoscale import CapacityController
 from dryad_tpu.fleet.replica import (ReplicaProcess, ReplicaStartupError,
                                      serve_argv)
 from dryad_tpu.fleet.router import (FleetRouter, make_fleet_router,
@@ -31,7 +35,7 @@ from dryad_tpu.fleet.router import (FleetRouter, make_fleet_router,
 from dryad_tpu.fleet.supervisor import FleetSupervisor, ReplicaSlot
 
 __all__ = [
-    "FleetRouter", "FleetSupervisor", "ReplicaProcess", "ReplicaSlot",
-    "ReplicaStartupError", "make_fleet_router", "relabel_exposition",
-    "serve_argv",
+    "CapacityController", "FleetRouter", "FleetSupervisor",
+    "ReplicaProcess", "ReplicaSlot", "ReplicaStartupError",
+    "make_fleet_router", "relabel_exposition", "serve_argv",
 ]

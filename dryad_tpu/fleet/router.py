@@ -124,6 +124,7 @@ class _RouterState:
     threads, and no forward/scrape I/O ever happens under it."""
 
     GUARDED_BY = {"_inflight_total": "_lock", "_inflight_model": "_lock",
+                  "_inflight_priority": "_lock",
                   "_rr": "_lock", "_slo_last": "_lock"}
 
     def __init__(self, supervisor, *, registry: Optional[Registry],
@@ -175,7 +176,12 @@ class _RouterState:
         self._lock = threading.Lock()
         self._inflight_total = 0
         self._inflight_model: dict[str, int] = {}
+        self._inflight_priority: dict[str, int] = {p: 0
+                                                   for p in PRIORITIES}
         self._rr = 0
+        # the capacity loop (fleet/autoscale.py), when the CLI arms one;
+        # purely observational here — /stats surfaces its state
+        self.autoscale = None
 
     # ---- admission ---------------------------------------------------------
     def admit(self, priority: str, model: Optional[str]) -> Optional[str]:
@@ -192,14 +198,18 @@ class _RouterState:
                         >= self.model_caps[model]):
                     return f"model {model!r} at its admission cap"
             self._inflight_total += 1
+            self._inflight_priority[priority] = (
+                self._inflight_priority.get(priority, 0) + 1)
             if model is not None:
                 self._inflight_model[model] = (
                     self._inflight_model.get(model, 0) + 1)
             return None
 
-    def release(self, model: Optional[str]) -> None:
+    def release(self, priority: str, model: Optional[str]) -> None:
         with self._lock:
             self._inflight_total -= 1
+            self._inflight_priority[priority] = (
+                self._inflight_priority.get(priority, 1) - 1)
             if model is not None:
                 self._inflight_model[model] = (
                     self._inflight_model.get(model, 1) - 1)
@@ -208,6 +218,10 @@ class _RouterState:
     def inflight_total(self) -> int:
         with self._lock:
             return self._inflight_total
+
+    def inflight_by_priority(self) -> dict:
+        with self._lock:
+            return dict(self._inflight_priority)
 
     # ---- slot choice -------------------------------------------------------
     def pick(self, exclude=()) -> Optional[object]:
@@ -226,11 +240,47 @@ class _RouterState:
             (fam.labels(**labels) if labels else fam).inc()
 
     def gauge_inflight(self) -> None:
-        if self.registry.enabled:
-            self.registry.gauge(
-                "dryad_fleet_inflight",
-                "Requests currently inside the fleet").set(
-                self.inflight_total)
+        """Live admission-depth gauges (r22): per-priority fleet depth
+        plus each slot's router-side in-flight count — the numbers the
+        capacity loop steers on, exported so operators read the same
+        signal the controller does."""
+        if not self.registry.enabled:
+            return
+        with self._lock:
+            per = dict(self._inflight_priority)
+            total = self._inflight_total
+        fam = self.registry.gauge(
+            "dryad_fleet_inflight",
+            "Requests currently inside the fleet, by priority class")
+        for priority in PRIORITIES:
+            fam.labels(priority=priority).set(per.get(priority, 0))
+        fam.labels(priority="total").set(total)
+        slot_fam = self.registry.gauge(
+            "dryad_fleet_slot_inflight",
+            "Router-side in-flight requests per replica slot")
+        for s in self.supervisor.slots:
+            slot_fam.labels(replica=s.name).set(s.inflight)
+
+    def capacity_signals(self) -> dict:
+        """The autoscaler's one-call view of the router (r22): a fresh
+        SLO window evaluation (the gate's streaks advance — sustained
+        semantics are shared with /healthz), the admission ledger, and
+        per-slot in-flight.  Jax-free, scrape-free, one short critical
+        section."""
+        slo = self.evaluate_slo()
+        with self._lock:
+            per = dict(self._inflight_priority)
+            total = self._inflight_total
+        return {
+            "slo": slo,
+            "inflight": total,
+            "inflight_priority": per,
+            "max_inflight": self.max_inflight,
+            "slots": {s.name: {"inflight": s.inflight,
+                               "routable": s.routable,
+                               "retiring": s.retiring}
+                      for s in self.supervisor.slots},
+        }
 
     # ---- drift (r18) -------------------------------------------------------
     def _journal_drift_breach(self, model: str, verdict: dict) -> None:
@@ -397,9 +447,12 @@ class _Handler(BaseHTTPRequestHandler):
             self._send(200, {
                 "replicas": state.supervisor.states(),
                 "inflight": state.inflight_total,
+                "inflight_priority": state.inflight_by_priority(),
                 "max_inflight": state.max_inflight,
                 "bulk_max_inflight": state.bulk_max_inflight,
                 "model_caps": state.model_caps,
+                "autoscale": (state.autoscale.state()
+                              if state.autoscale is not None else None),
                 "fleet": state.registry.snapshot(),
             })
         elif self.path == "/trace" or self.path.startswith("/trace?"):
@@ -733,7 +786,7 @@ class _Handler(BaseHTTPRequestHandler):
                                 "Requests served, by replica",
                                 replica=replica)
         finally:
-            state.release(model)
+            state.release(priority, model)
 
     def _forward(self, body: bytes, trace: Optional[str] = None):
         """Forward to one routable replica; retry once elsewhere on a
@@ -873,6 +926,12 @@ class FleetRouter:
     @property
     def url(self) -> str:
         return f"http://{self.host}:{self.port}"
+
+    @property
+    def state(self) -> "Optional[_RouterState]":
+        """The live router state (None before start()) — the capacity
+        controller's signal source in tests and the smoke."""
+        return self._httpd.state if self._httpd is not None else None
 
     def start(self) -> "FleetRouter":
         if self._httpd is not None:

@@ -76,6 +76,11 @@ class ReplicaSlot:
         self.healthy = False
         self.draining = False
         self.recovering = False
+        # r22 elastic capacity: a slot being drained OUT OF THE FLEET
+        # (scale-down).  Single-writer (the retiring thread) like
+        # ``draining``; the monitor must never respawn a retiring slot —
+        # resurrection would undo the capacity decision mid-drain.
+        self.retiring = False
         self.fail_closed = False
         self.generation = 0
         self.respawns = 0
@@ -106,13 +111,15 @@ class ReplicaSlot:
     @property
     def routable(self) -> bool:
         """Whether the router may pick this slot for a new request."""
-        return (self.healthy and not self.draining and not self.fail_closed
+        return (self.healthy and not self.draining and not self.retiring
+                and not self.fail_closed
                 and self.proc is not None and self.proc.alive)
 
     def state(self) -> dict:
         """The observability view (/healthz + /stats on the router)."""
         return {
             "healthy": self.healthy, "draining": self.draining,
+            "retiring": self.retiring,
             "fail_closed": self.fail_closed, "generation": self.generation,
             "respawns": self.respawns, "inflight": self.inflight,
             "alive": self.proc is not None and self.proc.alive,
@@ -131,19 +138,25 @@ class FleetSupervisor:
     ``journal`` takes a path (owned/closed here) or an open RunJournal,
     exactly like ``supervise_train``.
 
-    Lock contract (r15): two locks, committed order ``_swap_lock`` before
-    ``_journal_lock`` (analysis/goldens/lock_order.json).  ``_journal_lock``
+    Lock contract (r15, extended r22): three locks, committed order
+    ``_swap_lock`` before ``_slots_lock`` before ``_journal_lock``
+    (analysis/goldens/lock_order.json).  ``_journal_lock``
     guards the journal HANDLE — monitor, recovery threads, and the push
     path all journal concurrently, and ``stop()`` swaps the owned handle
     to None under it (each ``event()`` line is additionally atomic under
     the journal's own lock).  ``_swap_lock`` is a pure serialization
     mutex — one rolling push at a time; nothing else ever acquires it,
     which is why blocking inside it (the drain wait) is waived rather
-    than redesigned.  Slot state crosses threads via each slot's own
+    than redesigned.  ``_slots_lock`` (r22) guards the MUTABLE slot
+    registry: the autoscaler adds and retires slots at runtime, so every
+    reader takes a point-in-time snapshot through the ``slots`` property
+    (append/remove are the only mutations, both short critical
+    sections); slot STATE still crosses threads via each slot's own
     lock (the in-flight count) and single-writer flags.
     """
 
-    GUARDED_BY = {"_journal": "_journal_lock"}
+    GUARDED_BY = {"_journal": "_journal_lock", "_slots": "_slots_lock",
+                  "_next_index": "_slots_lock"}
 
     def __init__(self, make_argv, n_replicas: int, *,
                  policy: Optional[RetryPolicy] = None,
@@ -170,7 +183,9 @@ class FleetSupervisor:
         self.startup_timeout_s = float(startup_timeout_s)
         self.fault_env = dict(fault_env or {})
         self.log_dir = log_dir
-        self.slots = [ReplicaSlot(i) for i in range(int(n_replicas))]
+        self._slots = [ReplicaSlot(i) for i in range(int(n_replicas))]
+        self._next_index = int(n_replicas)
+        self._slots_lock = threading.Lock()
         self._registry = registry
         self._own_journal = isinstance(journal, (str, os.PathLike))
         self._journal = (RunJournal(os.fspath(journal)) if self._own_journal
@@ -204,6 +219,35 @@ class FleetSupervisor:
         reads in the same flight recorder as a crash or a swap."""
         self._event(kind, **fields)
 
+    @property
+    def slots(self) -> "list[ReplicaSlot]":
+        """Point-in-time snapshot of the slot registry.  The list is
+        MUTABLE at runtime (r22: the autoscaler adds/retires slots), so
+        every iteration — monitor, router, push, teardown — runs over
+        its own snapshot; the slot OBJECTS stay shared and carry their
+        own synchronization."""
+        with self._slots_lock:
+            return list(self._slots)
+
+    def gauge_replicas(self) -> None:
+        """The fleet census gauge the capacity loop (and operators)
+        read: ``dryad_fleet_replicas{state=...}``."""
+        reg = self._reg()
+        if not reg.enabled:
+            return
+        slots = self.slots
+        fam = reg.gauge("dryad_fleet_replicas",
+                        "Fleet slot census by state")
+        fam.labels(state="total").set(len(slots))
+        fam.labels(state="routable").set(
+            sum(1 for s in slots if s.routable))
+        fam.labels(state="retiring").set(
+            sum(1 for s in slots if s.retiring))
+        fam.labels(state="recovering").set(
+            sum(1 for s in slots if s.recovering))
+        fam.labels(state="fail_closed").set(
+            sum(1 for s in slots if s.fail_closed))
+
     def _gauge_healthy(self, slot: ReplicaSlot) -> None:
         reg = self._reg()
         if reg.enabled:
@@ -234,6 +278,7 @@ class FleetSupervisor:
                                          daemon=True,
                                          name="dryad-fleet-monitor")
         self._monitor.start()
+        self.gauge_replicas()
         return self
 
     def stop(self) -> None:
@@ -389,10 +434,20 @@ class FleetSupervisor:
         t.start()
 
     # ---- monitor -----------------------------------------------------------
+    @staticmethod
+    def _monitor_skips(slot: ReplicaSlot) -> bool:
+        """Slots the monitor must leave alone this pass.  ``retiring``
+        is load-bearing (r22): a scale-down drains the slot and then
+        KILLS its process — without the guard the monitor would read
+        that planned death as a crash and respawn the replica the
+        capacity decision just removed."""
+        return (slot.fail_closed or slot.recovering or slot.retiring
+                or slot.proc is None)
+
     def _monitor_loop(self) -> None:
         while not self._stop.wait(self.probe_interval_s):
             for slot in self.slots:
-                if slot.fail_closed or slot.recovering or slot.proc is None:
+                if self._monitor_skips(slot):
                     continue
                 if self._stop.is_set():
                     return
@@ -433,6 +488,77 @@ class FleetSupervisor:
                                 consecutive=slot.consecutive_bad)
                     slot.consecutive_bad = 0
                     self._recover_async(slot, "hang")
+
+    # ---- elastic capacity (r22) --------------------------------------------
+    def add_slot(self) -> Optional[ReplicaSlot]:
+        """Grow the fleet by one slot: register it, spawn its replica,
+        wait for readiness (the same ``_spawn`` budgeted path a respawn
+        takes).  The slot joins the registry BEFORE the long ready wait
+        so a concurrent ``stop()`` terminates the half-born child in its
+        normal sweep; ``recovering`` keeps the monitor off it until it
+        serves.  Returns the routable slot, or None (spawn failed under
+        budget, or the fleet is stopping — either way the registry is
+        left without the dead slot)."""
+        if self._stop.is_set():
+            return None
+        with self._slots_lock:
+            slot = ReplicaSlot(self._next_index)
+            self._next_index += 1
+            slot.recovering = True
+            self._slots.append(slot)
+        try:
+            ok = self._spawn(slot, first=True)
+        finally:
+            slot.recovering = False
+        if not ok:
+            with self._slots_lock:
+                if slot in self._slots:
+                    self._slots.remove(slot)
+            self.gauge_replicas()
+            return None
+        self.gauge_replicas()
+        return slot
+
+    def retire_slot(self, name: str, *,
+                    drain_timeout_s: float = 30.0) -> bool:
+        """Shrink the fleet by one slot through the rolling push's
+        zero-drop discipline: mark it non-routable (``retiring``), wait
+        for its in-flight count to reach zero (requests already on the
+        slot finish normally), then reap the process and drop the slot
+        from the registry.  A drain that cannot reach zero within
+        ``drain_timeout_s`` ABORTS the retire (the slot returns to
+        routing) rather than dropping work.  The wait holds NO lock —
+        ``retiring`` is a single-writer flag and the router re-checks
+        ``routable`` after its in-flight mark, the same window-closing
+        discipline ``draining`` rides."""
+        slot = next((s for s in self.slots if s.name == name), None)
+        if slot is None or slot.retiring:
+            return False
+        self._event("replica_retire", replica=slot.name,
+                    inflight=slot.inflight)
+        slot.retiring = True
+        self._gauge_healthy(slot)
+        deadline = time.monotonic() + float(drain_timeout_s)
+        while slot.inflight > 0:
+            if self._stop.is_set() or time.monotonic() > deadline:
+                slot.retiring = False
+                self._gauge_healthy(slot)
+                self._event("replica_retire_aborted", replica=slot.name,
+                            inflight=slot.inflight,
+                            stopping=self._stop.is_set())
+                return False
+            time.sleep(0.002)
+        if slot.proc is not None:
+            slot.proc.stop()
+        slot.healthy = False
+        with self._slots_lock:
+            if slot in self._slots:
+                self._slots.remove(slot)
+        self._gauge_healthy(slot)
+        self._event("replica_retired", replica=slot.name,
+                    generation=slot.generation, respawns=slot.respawns)
+        self.gauge_replicas()
+        return True
 
     # ---- routing / observability views -------------------------------------
     def routable_slots(self) -> list[ReplicaSlot]:

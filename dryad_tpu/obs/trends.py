@@ -93,6 +93,11 @@ LOWER_BETTER = frozenset({
     # r21 per-layout predict traversal walls (bench.py
     # predict_layout_probe: one node-word table gather/level vs ~7)
     "predict_us_per_row_packed", "predict_us_per_row_legacy",
+    # r22 elastic capacity (scripts/smoke_fleet.py ramp drill summary):
+    # capacity actions and peak replica count a FIXED stepped ramp needs
+    # to stay unshed — a stabler controller (or faster replicas) holds
+    # the same load with fewer actions and a smaller pool
+    "fleet_scale_up_total", "fleet_scale_down_total", "fleet_replicas",
     "p50_ms", "p99_ms",
 })
 

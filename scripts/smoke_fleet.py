@@ -42,6 +42,20 @@ r18 (drift telemetry) — the end-to-end model-quality drill:
   a drifted model still serves) with ``drift:<model>`` in its payload,
   and the aggregated /metrics carries ``dryad_fleet_drift_*`` gauges.
 
+r22 (elastic capacity) — the ramp drill on REAL replicas:
+
+* a min=1/max=3 fleet under a sustained closed-loop ramp: the
+  CapacityController reads the router's admission saturation, journals
+  ``scale_up``, and the new replica becomes routable — with ZERO shed
+  and zero failed interactive requests end to end (capacity arrives
+  before the router ever degrades to shedding),
+* continued pressure inside the up-cooldown and sustained idle at the
+  min bound journal ``scale_skipped`` with the canonical ``cooldown`` /
+  ``at-bound`` reasons (one burst = one action),
+* sustained idle drains the added replica back out through the retire
+  path (``scale_down`` -> ``replica_retired``) with zero dropped
+  in-flight requests, and the pool settles at min_replicas.
+
 Prints one JSON summary line on success, exits 1 with a reason otherwise.
 """
 
@@ -178,6 +192,84 @@ def main() -> int:
             sup.stop()
         events = RunJournal.read(journal_path)
 
+        # ---- r22 elastic capacity ramp (its own fleet: min=1, max=3) -------
+        from dryad_tpu.fleet import CapacityController
+
+        journal2_path = os.path.join(td, "fleet_elastic.jsonl")
+        reg2 = Registry()
+        sup2 = FleetSupervisor(
+            make_argv, 1,
+            policy=RetryPolicy(backoff_base_s=0.1, retry_budget=3),
+            journal=journal2_path, registry=reg2,
+            probe_interval_s=0.1, startup_timeout_s=180.0)
+        sup2.start()
+        # generous budgets: this drill's pressure is admission saturation;
+        # a latency breach would HOLD its streak through the idle phase
+        # (empty windows are no evidence) and block the drain half
+        router2 = FleetRouter(sup2, registry=reg2, max_inflight=8,
+                              slo_budgets_ms={"interactive": 30000.0,
+                                              "bulk": 30000.0}).start()
+        # saturation pressure: 6 closed-loop clients against max_inflight=8
+        # keep admission depth near 6 (>= 0.6 * 8) without ever shedding
+        ctrl = CapacityController(
+            sup2, router2.state.capacity_signals,
+            min_replicas=1, max_replicas=3,
+            breach_after=2, idle_after=6,
+            cooldown_up_s=120.0, cooldown_down_s=5.0,
+            saturation=0.6, poll_interval_s=0.25,
+            drain_timeout_s=30.0, registry=reg2).start()
+        ramp_failures = ramp_requests = 0
+        try:
+            heavy = {}
+            for n, start in ((200, 0), (600, 100)):
+                heavy[n] = json.dumps(
+                    {"rows": X[start:start + n].tolist()}).encode()
+            # ramp until the controller's replica is routable (the spawn
+            # pays a full jax import) — pressure stays on throughout
+            deadline = time.monotonic() + 150.0
+            ramp_seed = 21
+            while time.monotonic() < deadline:
+                leg = _closed_loop(router2.host, router2.port, heavy,
+                                   clients=6, duration_s=2.0,
+                                   seed=ramp_seed, priority="interactive")
+                ramp_seed += 1
+                ramp_failures += leg["failures"]
+                ramp_requests += leg["requests"]
+                if len(sup2.slots) >= 2 and sup2.slots[1].routable:
+                    break
+            else:
+                return fail("the ramp never scaled up to a routable "
+                            f"replica (states: {sup2.states()}, journal: "
+                            f"{RunJournal.read(journal2_path)[-5:]})")
+            # one more pressured leg across BOTH replicas: proves the
+            # grown fleet serves, and pokes inside the up-cooldown now
+            # journal the canonical 'cooldown' skip
+            leg = _closed_loop(router2.host, router2.port, heavy,
+                               clients=6, duration_s=2.5, seed=ramp_seed,
+                               priority="interactive")
+            ramp_failures += leg["failures"]
+            ramp_requests += leg["requests"]
+            peak_replicas = len(sup2.slots)
+            # sustained idle: the controller must drain the added replica
+            # back out (zero in-flight to drop) and then hold at-bound
+            drain_deadline = time.monotonic() + 45.0
+            while time.monotonic() < drain_deadline:
+                k2 = [e["event"] for e in RunJournal.read(journal2_path)]
+                if "replica_retired" in k2 and len(sup2.slots) == 1:
+                    break
+                time.sleep(0.25)
+            else:
+                return fail("sustained idle never drained the scaled-up "
+                            f"replica (states: {sup2.states()})")
+            # a few more idle polls at the min bound -> 'at-bound' skips
+            time.sleep(2.5)
+            shed2 = reg2.counter("dryad_fleet_shed_total", "").value()
+        finally:
+            ctrl.stop(timeout_s=10.0)
+            router2.stop()
+            sup2.stop()
+        elastic_events = RunJournal.read(journal2_path)
+
     if loop["failures"] or tail["failures"]:
         return fail(f"{loop['failures']} + {tail['failures']} failed "
                     "interactive request(s) — the single-retry budget did "
@@ -273,6 +365,34 @@ def main() -> int:
         return fail(f"no drift_breach journal event for {model}: "
                     f"{breaches}")
 
+    # ---- r22 elastic capacity assertions ------------------------------------
+    if ramp_failures:
+        return fail(f"{ramp_failures} failed interactive request(s) during "
+                    "the capacity ramp — the fleet degraded before the "
+                    "scale-up landed")
+    if shed2:
+        return fail(f"the router shed {shed2} request(s) during the ramp — "
+                    "capacity must arrive before shedding starts")
+    ekinds = [e["event"] for e in elastic_events]
+    if ekinds.count("scale_up") != 1:
+        return fail(f"expected exactly one scale_up for the burst, got "
+                    f"{ekinds.count('scale_up')}: {ekinds}")
+    if not any(e["event"] == "replica_ready" and e.get("replica") == "r1"
+               for e in elastic_events):
+        return fail("the scaled-up replica r1 never journaled ready")
+    if ekinds.count("scale_down") != 1 \
+            or ekinds.count("replica_retired") != 1:
+        return fail(f"sustained idle did not drain exactly one replica: "
+                    f"{ekinds}")
+    skip_reasons = {e.get("reason") for e in elastic_events
+                    if e["event"] == "scale_skipped"}
+    for want in ("cooldown", "at-bound"):
+        if want not in skip_reasons:
+            return fail(f"no '{want}' scale_skipped journaled "
+                        f"(saw: {sorted(skip_reasons)})")
+    if ekinds.index("scale_up") > ekinds.index("scale_down"):
+        return fail("scale_down journaled before scale_up")
+
     print(json.dumps({
         "fleet_smoke": "ok",
         "requests": loop["requests"] + tail["requests"],
@@ -289,6 +409,11 @@ def main() -> int:
         "drift_clean_psi_max": max(v.get("psi_max", 0.0)
                                    for v in clean_models.values()),
         "drift_breaches_journaled": len(breaches),
+        "ramp_requests": ramp_requests,
+        "ramp_failures": 0,
+        "fleet_scale_up_total": ekinds.count("scale_up"),
+        "fleet_scale_down_total": ekinds.count("scale_down"),
+        "fleet_replicas": peak_replicas,
     }))
     return 0
 

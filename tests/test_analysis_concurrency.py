@@ -558,6 +558,63 @@ def test_harness_detects_unlocked_scheduler_admit(monkeypatch):
         "the unlocked debounce never double-launched a retrain"
 
 
+def test_harness_detects_unlocked_capacity_admit(monkeypatch):
+    """r22: the capacity decision's streak/bound/cooldown checks and its
+    in-flight mark must be ONE critical section — the mechanically
+    reverted unlocked version lets two concurrent pokes both pass the
+    checks before either marks, double-spawning a replica past the
+    declared bounds; the capacity-vs-breach-vs-push drill's exactly-one
+    invariant catches it."""
+    from dryad_tpu.fleet import autoscale as amod
+
+    def racy_admit(self, pressure, headroom, census):
+        # the unlocked shape: check, then mark, no critical section
+        now = amod.time.monotonic()
+        if pressure:
+            self._down_streak = 0
+            self._up_streak += 1
+            direction, streak, sustain_n = ("up", self._up_streak,
+                                            self.breach_after)
+            bound_hit = census >= self.max_replicas
+        elif headroom:
+            self._up_streak = 0
+            self._down_streak += 1
+            direction, streak, sustain_n = ("down", self._down_streak,
+                                            self.idle_after)
+            bound_hit = census <= self.min_replicas
+        else:
+            self._up_streak = 0
+            self._down_streak = 0
+            self._last_skip = {"up": None, "down": None}
+            return None, None, None, False
+        if self._action is not None:
+            reason = amod.SKIP_IN_FLIGHT
+        elif bound_hit:
+            reason = amod.SKIP_AT_BOUND
+        elif streak < sustain_n:
+            reason = amod.SKIP_SUSTAIN
+        elif now < self._cooldown_until[direction]:
+            reason = amod.SKIP_COOLDOWN
+        else:
+            self._action = direction
+            if direction == "up":
+                self._up_streak = 0
+            else:
+                self._down_streak = 0
+            self._last_skip[direction] = None
+            return ("scale_up" if direction == "up" else "scale_down",
+                    direction, None, False)
+        journal_skip = reason != self._last_skip[direction]
+        self._last_skip[direction] = reason
+        return None, direction, reason, journal_skip
+
+    monkeypatch.setattr(amod.CapacityController, "_admit", racy_admit)
+    seed = _first_failing_seed("capacity-vs-breach-vs-push", 100,
+                               extra_trace=("test_analysis_concurrency.py",))
+    assert seed is not None, \
+        "the unlocked capacity debounce never double-launched a scale-up"
+
+
 def test_harness_detects_wedged_prefetch_producer(monkeypatch):
     """r20: ChunkPrefetcher's producer must put through the cancellable
     timeout loop — mechanically reverting it to a plain blocking put lets

@@ -479,3 +479,375 @@ def test_serve_front_end_honors_reject_503_drill(served_model):
         httpd.server_close()
         server.stop()
         thread.join(timeout=5.0)
+
+
+# ---------------------------------------------------------------------------
+# elastic capacity (r22): mutable slot registry + CapacityController
+
+def test_elastic_add_and_retire_slot(tmp_path):
+    with fleet(stub_argv(), 1, tmp_path) as (sup, router, reg, journal):
+        assert [s.name for s in sup.slots] == ["r0"]
+        slot = sup.add_slot()
+        assert slot is not None and slot.name == "r1"
+        assert wait_until(lambda: slot.routable)
+        assert predict(router)[0] == 200
+        # the census gauge and the router's admission gauges are live
+        status, body = http_call(router.host, router.port, "GET", "/metrics")
+        text = body.decode()
+        assert status == 200
+        assert 'dryad_fleet_replicas{state="total"} 2' in text
+        assert 'dryad_fleet_inflight{priority="total"}' in text
+        assert 'dryad_fleet_slot_inflight{replica="r1"}' in text
+        # a held in-flight request stalls the drain (zero-drop), then
+        # releasing it lets the retire complete
+        slot.inflight_inc()
+        done = []
+        t = threading.Thread(target=lambda: done.append(
+            sup.retire_slot("r1", drain_timeout_s=10.0)))
+        t.start()
+        assert wait_until(lambda: slot.retiring)
+        assert not slot.routable
+        time.sleep(0.1)
+        assert not done, "retire completed with a request still in flight"
+        slot.inflight_dec()
+        t.join(timeout=10.0)
+        assert done == [True]
+        assert [s.name for s in sup.slots] == ["r0"]
+        kinds = [e["event"] for e in RunJournal.read(journal)]
+        assert "replica_retire" in kinds and "replica_retired" in kinds
+        # retiring an unknown slot refuses cleanly
+        assert sup.retire_slot("r1") is False
+
+
+def test_retire_aborts_rather_than_dropping_inflight(tmp_path):
+    with fleet(stub_argv(), 2, tmp_path) as (sup, router, reg, journal):
+        slot = sup.slots[1]
+        slot.inflight_inc()
+        try:
+            assert sup.retire_slot("r1", drain_timeout_s=0.1) is False
+        finally:
+            slot.inflight_dec()
+        assert not slot.retiring, "aborted retire left the slot non-routable"
+        assert slot.routable
+        assert [s.name for s in sup.slots] == ["r0", "r1"]
+        assert events_of(journal, "replica_retire_aborted")
+
+
+def test_monitor_skips_retiring_slot(tmp_path):
+    """A scale-down kills its process ON PURPOSE; the monitor must read
+    that as the planned death it is, never as a crash to respawn."""
+    with fleet(stub_argv(), 2, tmp_path) as (sup, router, reg, journal):
+        slot = sup.slots[1]
+        slot.retiring = True
+        assert not slot.routable
+        assert slot.state()["retiring"] is True
+        slot.proc.stop()                 # the planned death
+        time.sleep(0.5)                  # ~10 monitor cycles
+        assert slot.generation == 0 and not slot.recovering
+        assert not [e for e in events_of(journal, "replica_crash")
+                    if e.get("replica") == "r1"], \
+            "the monitor read a planned retire death as a crash"
+
+
+def test_monitor_retiring_guard_is_load_bearing(tmp_path, monkeypatch):
+    """Mechanical revert of the r22 guard: drop ``retiring`` from the
+    monitor's skip predicate and the drained replica is resurrected —
+    the exact bug the shipped predicate prevents."""
+    monkeypatch.setattr(
+        FleetSupervisor, "_monitor_skips",
+        staticmethod(lambda slot: slot.fail_closed or slot.recovering
+                     or slot.proc is None))
+    with fleet(stub_argv(), 2, tmp_path) as (sup, router, reg, journal):
+        slot = sup.slots[1]
+        slot.retiring = True
+        slot.proc.stop()
+        assert wait_until(lambda: slot.generation == 1 and slot.healthy), \
+            "without the revert the monitor no longer resurrects — " \
+            "update this test alongside _monitor_skips"
+        slot.retiring = False            # let teardown see a normal slot
+
+
+def test_stop_reaps_in_flight_scale_up(tmp_path):
+    """stop() during add_slot's ready wait: the half-born slot is
+    registered BEFORE the wait, so the teardown sweep terminates its
+    child, add_slot unblocks promptly and leaves no ghost slot."""
+    def make(index: int, port_file: str) -> list:
+        if index == 0:
+            return [sys.executable, STUB, "--port-file", port_file]
+        # a replica that never reports ready (the jax-import phase)
+        return [sys.executable, "-c", "import time; time.sleep(60)"]
+
+    sup = FleetSupervisor(
+        make, 1, policy=RetryPolicy(backoff_base_s=0.0),
+        journal=str(tmp_path / "fleet.jsonl"), registry=Registry(),
+        probe_interval_s=0.05, probe_timeout_s=1.0,
+        startup_timeout_s=30.0).start()
+    try:
+        got = []
+        t = threading.Thread(target=lambda: got.append(sup.add_slot()))
+        t.start()
+        assert wait_until(lambda: len(sup.slots) == 2), \
+            "half-born slot not registered before the ready wait"
+        half = sup.slots[1]
+        assert wait_until(lambda: half.proc is not None)
+        sup.stop()
+        t.join(timeout=15.0)
+        assert not t.is_alive(), "add_slot stayed wedged past stop()"
+        assert got == [None]
+        assert [s.name for s in sup.slots] == ["r0"], \
+            "failed scale-up left a ghost slot in the registry"
+        assert not half.proc.alive, "stop() leaked the half-born child"
+    finally:
+        sup.stop()
+
+
+def test_add_slot_registers_before_spawn_is_load_bearing(tmp_path,
+                                                         monkeypatch):
+    """Mechanical revert: register the slot only AFTER the spawn and
+    stop()'s sweeps can no longer see the half-born child — it outlives
+    the fleet, the leak the shipped ordering prevents."""
+    from dryad_tpu.fleet.supervisor import ReplicaSlot
+
+    seen = []
+
+    def late_register(self):
+        if self._stop.is_set():
+            return None
+        with self._slots_lock:
+            slot = ReplicaSlot(self._next_index)
+            self._next_index += 1
+        seen.append(slot)
+        slot.recovering = True
+        try:
+            ok = self._spawn(slot, first=True)
+        finally:
+            slot.recovering = False
+        if not ok:
+            return None
+        with self._slots_lock:
+            self._slots.append(slot)
+        return slot
+
+    monkeypatch.setattr(FleetSupervisor, "add_slot", late_register)
+
+    def make(index: int, port_file: str) -> list:
+        if index == 0:
+            return [sys.executable, STUB, "--port-file", port_file]
+        return [sys.executable, "-c", "import time; time.sleep(60)"]
+
+    sup = FleetSupervisor(
+        make, 1, policy=RetryPolicy(backoff_base_s=0.0),
+        journal=str(tmp_path / "fleet.jsonl"), registry=Registry(),
+        probe_interval_s=0.05, probe_timeout_s=1.0,
+        startup_timeout_s=30.0).start()
+    t = threading.Thread(target=lambda: sup.add_slot())
+    t.start()
+    try:
+        assert wait_until(lambda: seen and seen[0].proc is not None
+                          and seen[0].proc.alive)
+        sup.stop()
+        assert seen[0].proc.alive, \
+            "the sweep saw the unregistered child — revert test is stale"
+    finally:
+        if seen and seen[0].proc is not None:
+            seen[0].proc.stop()          # reap the demonstrated leak
+        t.join(timeout=15.0)
+
+
+# ---------------------------------------------------------------------------
+# CapacityController decision logic (no subprocesses)
+
+class _CtrlSlot:
+    def __init__(self, index: int):
+        self.index = index
+        self.name = f"r{index}"
+        self.fail_closed = False
+        self.retiring = False
+        self.routable = True
+        self.inflight = 0
+
+
+class _CtrlSup:
+    """Supervisor stand-in: exactly the surface the controller drives."""
+
+    def __init__(self, n: int):
+        self._slots = [_CtrlSlot(i) for i in range(n)]
+        self.events: list = []
+
+    @property
+    def slots(self):
+        return list(self._slots)
+
+    def journal(self, kind, /, **fields):
+        self.events.append((kind, fields))
+
+    def kinds(self):
+        return [k for k, _ in self.events]
+
+    def skip_reasons(self):
+        return [f["reason"] for k, f in self.events if k == "scale_skipped"]
+
+    def gauge_replicas(self):
+        pass
+
+    def routable_slots(self):
+        return [s for s in self._slots if s.routable and not s.retiring]
+
+    def add_slot(self):
+        s = _CtrlSlot(len(self._slots))
+        self._slots.append(s)
+        return s
+
+    def retire_slot(self, name, *, drain_timeout_s=30.0):
+        s = next((x for x in self._slots if x.name == name), None)
+        if s is None:
+            return False
+        self._slots.remove(s)
+        return True
+
+
+def _sig(mode: str) -> dict:
+    return {
+        "pressure": {"slo": {"interactive": {"breached": True,
+                                             "sustained": True}},
+                     "inflight": 9, "max_inflight": 10},
+        "saturated": {"slo": {}, "inflight": 9, "max_inflight": 10},
+        "headroom": {"slo": {}, "inflight": 0, "max_inflight": 10},
+        "calm": {"slo": {}, "inflight": 5, "max_inflight": 10},
+    }[mode]
+
+
+def _controller(sup, sig, **kw):
+    from dryad_tpu.fleet.autoscale import CapacityController
+
+    kw.setdefault("min_replicas", 1)
+    kw.setdefault("max_replicas", 4)
+    kw.setdefault("breach_after", 2)
+    kw.setdefault("idle_after", 2)
+    kw.setdefault("cooldown_up_s", 0.0)
+    kw.setdefault("cooldown_down_s", 0.0)
+    return CapacityController(sup, lambda: _sig(sig["mode"]),
+                              registry=Registry(enabled=False), **kw)
+
+
+def _settle(ctrl):
+    assert wait_until(lambda: ctrl.state()["action_in_flight"] is None)
+
+
+def test_capacity_sustain_admits_at_exactly_breach_after():
+    sup = _CtrlSup(1)
+    sig = {"mode": "pressure"}
+    ctrl = _controller(sup, sig, breach_after=3)
+    assert ctrl.poke() is None
+    assert ctrl.poke() is None
+    # two refusals, ONE journaled skip (debounced on the reason)
+    assert sup.skip_reasons() == ["insufficient-sustain"]
+    assert ctrl.poke() == "scale_up"
+    _settle(ctrl)
+    assert sup.kinds().count("scale_up") == 1
+    assert len(sup.slots) == 2
+    assert ctrl.state()["actions_total"] == {"up": 1, "down": 0}
+
+
+def test_capacity_flapping_resets_streaks():
+    sup = _CtrlSup(1)
+    sig = {"mode": "pressure"}
+    ctrl = _controller(sup, sig, breach_after=2)
+    assert ctrl.poke() is None
+    sig["mode"] = "calm"
+    assert ctrl.poke() is None
+    assert ctrl.state()["up_streak"] == 0
+    sig["mode"] = "pressure"
+    assert ctrl.poke() is None, "flapping traffic accumulated to an action"
+    assert sup.kinds().count("scale_up") == 0
+
+
+def test_capacity_saturation_alone_is_pressure():
+    sup = _CtrlSup(1)
+    ctrl = _controller(sup, {"mode": "saturated"}, breach_after=1)
+    assert ctrl.poke() == "scale_up"
+    _settle(ctrl)
+    up = next(f for k, f in sup.events if k == "scale_up")
+    assert up["saturated"] is True and up["slo_sustained"] == []
+
+
+def test_capacity_bound_and_cooldown_refusals():
+    sup = _CtrlSup(2)
+    sig = {"mode": "pressure"}
+    ctrl = _controller(sup, sig, breach_after=1, max_replicas=3,
+                       cooldown_up_s=60.0)
+    assert ctrl.poke() == "scale_up"
+    _settle(ctrl)
+    assert len(sup.slots) == 3
+    assert ctrl.poke() is None
+    assert sup.skip_reasons()[-1] == "at-bound"
+    sup._slots.pop()                     # headroom to grow again, but...
+    assert ctrl.poke() is None           # ...inside the up cooldown
+    assert sup.skip_reasons()[-1] == "cooldown"
+    assert sup.kinds().count("scale_up") == 1
+
+
+def test_capacity_never_below_min_never_last_routable():
+    sup = _CtrlSup(2)
+    ctrl = _controller(sup, {"mode": "headroom"}, idle_after=1,
+                       min_replicas=2)
+    assert ctrl.poke() is None
+    assert sup.skip_reasons() == ["at-bound"]
+    # min allows a drain, but only one slot is routable: the victim
+    # picker refuses (zero routable is an outage) and journals the miss
+    sup2 = _CtrlSup(2)
+    sup2._slots[0].routable = False
+    ctrl2 = _controller(sup2, {"mode": "headroom"}, idle_after=1,
+                        min_replicas=1)
+    assert ctrl2.poke() == "scale_down"
+    _settle(ctrl2)
+    assert sup2.kinds().count("scale_down") == 0
+    failed = next(f for k, f in sup2.events if k == "scale_failed")
+    assert failed["direction"] == "down"
+    assert len(sup2.slots) == 2
+
+
+def test_capacity_in_flight_action_refuses_second():
+    sup = _CtrlSup(3)
+    gate = threading.Event()
+    orig = sup.retire_slot
+
+    def slow_retire(name, *, drain_timeout_s=30.0):
+        gate.wait(10.0)
+        return orig(name, drain_timeout_s=drain_timeout_s)
+
+    sup.retire_slot = slow_retire
+    ctrl = _controller(sup, {"mode": "headroom"}, idle_after=1)
+    try:
+        assert ctrl.poke() == "scale_down"
+        assert ctrl.poke() is None
+        assert sup.skip_reasons() == ["already-in-flight"]
+    finally:
+        gate.set()
+    _settle(ctrl)
+    assert sup.kinds().count("scale_down") == 1
+    assert [s.name for s in sup.slots] == ["r0", "r1"]
+    ctrl.stop(timeout_s=5.0)
+
+
+def test_capacity_poll_loop_runs_and_stops():
+    sup = _CtrlSup(1)
+    sig = {"mode": "pressure"}
+    ctrl = _controller(sup, sig, breach_after=1, max_replicas=2,
+                       poll_interval_s=0.01).start()
+    try:
+        assert wait_until(lambda: sup.kinds().count("scale_up") == 1)
+        assert wait_until(lambda: "at-bound" in sup.skip_reasons())
+    finally:
+        ctrl.stop(timeout_s=5.0)
+    n = len(sup.events)
+    time.sleep(0.1)
+    assert len(sup.events) == n, "the poll loop survived stop()"
+
+
+def test_capacity_validates_bounds():
+    sup = _CtrlSup(1)
+    with pytest.raises(ValueError):
+        _controller(sup, {"mode": "calm"}, min_replicas=3, max_replicas=2)
+    with pytest.raises(ValueError):
+        _controller(sup, {"mode": "calm"}, breach_after=0)

@@ -258,6 +258,74 @@ def test_slo_gate_sustained_breach_hold_and_recovery():
         priority="interactive").value() == 0
 
 
+def test_slo_gate_fires_at_exactly_breach_after():
+    """The hysteresis edge the autoscaler steers on (r22): breach_after
+    consecutive breached windows — not N-1, not a lifetime total — flip
+    ``sustained``."""
+    reg = Registry()
+    from dryad_tpu.obs.health import HealthState
+    health = HealthState(registry=reg)
+    gate = SloGate({"interactive": 10.0}, breach_after=3,
+                   registry=reg, health=health)
+    slow = Registry().log_histogram(REQUEST_LATENCY)
+    for _ in range(5):
+        slow.observe(0.5)
+    for i in range(1, 3):                     # windows 1, 2: not yet
+        v = gate.evaluate({"interactive": slow.value()})
+        assert v["interactive"]["breached"]
+        assert v["interactive"]["streak"] == i
+        assert not v["interactive"]["sustained"], \
+            f"sustained fired at window {i} < breach_after"
+        assert health.ok
+    v = gate.evaluate({"interactive": slow.value()})    # window 3: exactly
+    assert v["interactive"]["sustained"] and v["interactive"]["streak"] == 3
+    assert not health.ok
+
+
+def test_slo_gate_clean_window_resets_streak():
+    """One in-budget NON-EMPTY window zeroes the streak — breaches on
+    either side never add up across it."""
+    gate = SloGate({"interactive": 10.0}, breach_after=2,
+                   registry=Registry())
+    slow = Registry().log_histogram(REQUEST_LATENCY)
+    fast = Registry().log_histogram(REQUEST_LATENCY)
+    for _ in range(5):
+        slow.observe(0.5)
+        fast.observe(0.001)
+    assert gate.evaluate(
+        {"interactive": slow.value()})["interactive"]["streak"] == 1
+    clean = gate.evaluate({"interactive": fast.value()})["interactive"]
+    assert clean["streak"] == 0 and not clean["breached"]
+    again = gate.evaluate({"interactive": slow.value()})["interactive"]
+    assert again["streak"] == 1 and not again["sustained"], \
+        "a pre-reset breach leaked into the new streak"
+    assert gate.ok
+
+
+def test_slo_gate_priorities_are_independent():
+    """interactive sustaining its breach neither advances bulk's streak
+    nor degrades bulk's health key — each priority carries its own
+    hysteresis."""
+    reg = Registry()
+    from dryad_tpu.obs.health import HealthState
+    health = HealthState(registry=reg)
+    gate = SloGate({"interactive": 10.0, "bulk": 2000.0}, breach_after=2,
+                   registry=reg, health=health)
+    slow = Registry().log_histogram(REQUEST_LATENCY)
+    fast = Registry().log_histogram(REQUEST_LATENCY)
+    for _ in range(5):
+        slow.observe(0.5)                     # over 10 ms, under 2000 ms
+        fast.observe(0.001)
+    for _ in range(2):
+        v = gate.evaluate({"interactive": slow.value(),
+                           "bulk": fast.value()})
+    assert v["interactive"]["sustained"]
+    assert v["bulk"]["streak"] == 0 and not v["bulk"]["breached"]
+    assert "slo:interactive" in health.reasons()
+    assert "slo:bulk" not in health.reasons()
+    assert not gate.ok                        # any sustained priority
+
+
 def test_parse_budgets():
     assert parse_budgets("") == {"interactive": 250.0, "bulk": 2000.0}
     assert parse_budgets("interactive=5,bulk=80.5") == {
