@@ -445,14 +445,11 @@ def main() -> None:
     # ---- artifact stamp (r12: the trend ledger keys history off data) -------
     # schema_version + git rev + device kind in the JSON itself, so
     # obs/trends.py never parses filenames; the reader stays tolerant of
-    # the unstamped r1-r7 artifacts
-    import jax as _jax
-
+    # the unstamped r1-r7 artifacts.  r23: device_kind comes from the ONE
+    # derivation (policy/device.py) instead of a hand-rolled probe.
     from dryad_tpu.obs.trends import artifact_stamp
 
-    _dev = _jax.devices()[0]
     out.update(artifact_stamp(
-        device_kind=getattr(_dev, "device_kind", None) or _dev.platform,
         root=os.path.dirname(os.path.abspath(__file__))))
 
     # ---- supervisor overhead (r8: the wrapper must be free on the hot path)

@@ -363,6 +363,148 @@ register(Rule(
 
 
 # ---------------------------------------------------------------------------
+# policy-jax-free (r23) — the calibration table keys dispatch decisions
+# and must load in the fleet control plane (serve /stats, the supervisor)
+# while a device is wedged; resolvers are pure dict-and-compare code.
+# The ONE sanctioned exception is the lazy best-effort device_kind probe
+# in policy/device.py, waived inline (and counted by the ratchet).
+
+def _check_policy_direct(path, src, tree):
+    out = []
+    for line, mod in _imports_of(tree, ("jax", "jaxlib")):
+        out.append(Violation(
+            "policy-jax-free", path, line,
+            f"import {mod} in dryad_tpu/policy — gate resolution is "
+            "host-side table lookup and jax-free by lint (r23); the "
+            "calibration SWEEP reaches devices only through "
+            "engine/probes, imported lazily inside calibrate.run_sweep"))
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Attribute) and node.attr in (
+                "device_get", "addressable_data", "asnumpy"):
+            out.append(Violation(
+                "policy-jax-free", path, node.lineno,
+                f".{node.attr} in dryad_tpu/policy — a gate resolver "
+                "must never touch device buffers; walls arrive as floats "
+                "from the probe harness"))
+    return out
+
+
+def _tree_check_policy(sources, tree):
+    out = []
+    chains = find_banned_chains(sorted(sources), tree,
+                                banned_roots=("jax", "jaxlib"))
+    for chain, banned in chains:
+        entry = chain[0]
+        out.append(Violation(
+            "policy-jax-free", _module_rel(entry, tree), 1,
+            "transitive jax import: " + " -> ".join(chain)
+            + " — importing dryad_tpu.policy must not pull in jax (r23; "
+            "probe/trends imports stay lazy inside the sweep functions)"))
+    return out
+
+
+register(Rule(
+    name="policy-jax-free",
+    doc="dryad_tpu/policy is jax-free, directly and transitively",
+    targets=("dryad_tpu/policy/**",),
+    check=_check_policy_direct,
+    tree_check=_tree_check_policy,
+))
+
+
+# ---------------------------------------------------------------------------
+# gate-through-policy (r23) — the dispatch-gate functions must read their
+# thresholds from the policy calibration table, never from re-inlined
+# literals: a constant hand-edited at ONE call site silently forks the
+# gate from the committed table (and from every other caller), which is
+# exactly the two-copy drift select_bins' r5 review caught.  Structural
+# encoding widths stay at the call sites as NAMED module constants
+# (levelwise._MAX_PACKED_BINS) — the rule flags folded int literals at or
+# past 512 (the smallest calibrated threshold) inside the known gate
+# functions only, so shape arithmetic like ``9 + F * itemsize`` passes.
+
+_GATE_FUNCTIONS = {
+    "partition_prefers_reduce", "hist_reduce_resolved",
+    "deep_layout_supported", "leafwise_layout_supported",
+    "resolve_backend", "stage_trees",
+}
+_GATE_LITERAL_FLOOR = 512
+
+
+def _fold_int(node) -> Optional[int]:
+    """Constant-fold an int expression (``1 << 15`` must not evade the
+    rule by being spelled as a BinOp)."""
+    if isinstance(node, ast.Constant) and type(node.value) is int:
+        return node.value
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub):
+        v = _fold_int(node.operand)
+        return -v if v is not None else None
+    if isinstance(node, ast.BinOp):
+        left, right = _fold_int(node.left), _fold_int(node.right)
+        if left is None or right is None:
+            return None
+        try:
+            if isinstance(node.op, ast.LShift):
+                return left << right
+            if isinstance(node.op, ast.Add):
+                return left + right
+            if isinstance(node.op, ast.Sub):
+                return left - right
+            if isinstance(node.op, ast.Mult):
+                return left * right
+            if isinstance(node.op, ast.Pow) and right < 64:
+                return left ** right
+            if isinstance(node.op, ast.FloorDiv) and right != 0:
+                return left // right
+        except (OverflowError, ValueError):
+            return None
+    return None
+
+
+def _check_gate_literals(path, src, tree):
+    out = []
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if fn.name not in _GATE_FUNCTIONS:
+            continue
+        # fold top-down and don't descend into folded expressions, so
+        # `1 << 15` reports once (as 32768), not once per operand
+        stack = list(ast.iter_child_nodes(fn))
+        while stack:
+            node = stack.pop()
+            folded = _fold_int(node) if isinstance(
+                node, (ast.Constant, ast.BinOp, ast.UnaryOp)) else None
+            if folded is not None:
+                if abs(folded) >= _GATE_LITERAL_FLOOR:
+                    out.append(Violation(
+                        "gate-through-policy", path, node.lineno,
+                        f"literal {folded} inside gate function "
+                        f"{fn.name}() — dispatch thresholds live in the "
+                        "policy calibration table "
+                        "(policy/table.GATE_DEFAULTS + goldens/"
+                        "calibration.json); resolve through "
+                        "policy.gates.resolve()/gate_value() so a device "
+                        "entry can move them and the committed default "
+                        "stays the single source"))
+                continue
+            stack.extend(ast.iter_child_nodes(node))
+    return out
+
+
+register(Rule(
+    name="gate-through-policy",
+    doc="dispatch-gate functions read thresholds from the policy table, "
+        "not re-inlined literals",
+    targets=("dryad_tpu/config.py", "dryad_tpu/engine/levelwise.py",
+             "dryad_tpu/engine/leafwise_fast.py",
+             "dryad_tpu/engine/histogram.py", "dryad_tpu/engine/predict.py",
+             "dryad_tpu/serve/server.py", "dryad_tpu/resilience/policy.py"),
+    check=_check_gate_literals,
+))
+
+
+# ---------------------------------------------------------------------------
 # jit-closure-constant
 
 _MATERIALIZERS = {

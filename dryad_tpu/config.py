@@ -14,6 +14,8 @@ import dataclasses
 import json
 from typing import Any, Mapping, Sequence
 
+from dryad_tpu.policy.table import GATE_DEFAULTS as _POLICY_DEFAULTS
+
 OBJECTIVES = ("binary", "multiclass", "regression", "lambdarank",
               "l1", "huber", "fair", "quantile", "poisson")
 GROWTH_POLICIES = ("leafwise", "depthwise")
@@ -385,8 +387,10 @@ LEAFWISE_TOTAL_BYTES_BUDGET = 12 << 30
 # 256 KB (Epsilon's 2000 x 256 u8 = 500 KB clears it; Higgs' 28 x 256 =
 # 7 KB stays fused).  bin_bytes is the binned-matrix itemsize (1 below
 # 257 bins, else 2) so the gate is jax-free and shard-count aware only
-# through its explicit argument.
-HIST_REDUCE_WIDE_BYTES = 1 << 18
+# through its explicit argument.  r23: the constant lives in the policy
+# calibration table (policy/table.GATE_DEFAULTS["hist_reduce"]); this
+# name is the compatibility re-export of the committed default.
+HIST_REDUCE_WIDE_BYTES = _POLICY_DEFAULTS["hist_reduce"]["wide_bytes"]
 
 
 def hist_reduce_resolved(p: Params, num_features: int, total_bins: int,
@@ -395,12 +399,16 @@ def hist_reduce_resolved(p: Params, num_features: int, total_bins: int,
     AND train._comm_stats so the observability accounting can never drift
     from the program choice (the nat-gate/phase-plan precedent, ADVICE
     r4).  A pure function of (params, feature/bin shape, shard count) —
-    NEVER of the row count (CLAUDE.md same-program rule)."""
+    NEVER of the row count (CLAUDE.md same-program rule).  r23: the
+    threshold comes from the device-keyed policy table; the committed
+    default resolves bitwise-identically to the pre-r23 constant."""
     if p.hist_reduce != "auto":
         return p.hist_reduce
-    bin_bytes = 1 if total_bins <= 256 else 2
-    wide = num_features * total_bins * bin_bytes >= HIST_REDUCE_WIDE_BYTES
-    return "feature" if (wide and n_shards > 1) else "fused"
+    from dryad_tpu.policy.gates import resolve
+
+    return resolve("hist_reduce", {"num_features": num_features,
+                                   "total_bins": total_bins,
+                                   "n_shards": n_shards})
 
 
 def leafwise_fast_supported(p: Params, num_features: int,

@@ -33,9 +33,14 @@ import jax
 import jax.numpy as jnp
 
 from dryad_tpu.engine.jax_compat import pcast_varying
+from dryad_tpu.policy.table import GATE_DEFAULTS as _POLICY_DEFAULTS
 
 
-_PALLAS_PLATFORMS = ("tpu", "axon")  # axon: the tunneled-TPU plugin platform
+# axon: the tunneled-TPU plugin platform.  r23: the platform list lives
+# in the policy table ("hist_backend"/"pallas_platforms"); this name is
+# the compatibility re-export of the committed default.
+_PALLAS_PLATFORMS = tuple(
+    _POLICY_DEFAULTS["hist_backend"]["pallas_platforms"])
 
 
 def resolve_backend(backend: str, *, segmented: bool = False,
@@ -53,9 +58,10 @@ def resolve_backend(backend: str, *, segmented: bool = False,
     forced on a TPU-attached process — train_device resolves against its
     mesh and passes a concrete backend down)."""
     if backend == "auto":
-        if (platform or jax.default_backend()) not in _PALLAS_PLATFORMS:
-            return "xla"
-        return "pallas"
+        from dryad_tpu.policy.gates import resolve
+
+        return resolve("hist_backend",
+                       {"platform": platform or jax.default_backend()})
     return backend
 
 

@@ -72,6 +72,7 @@ from dryad_tpu.engine.grower import (
 from dryad_tpu.engine import levelwise
 from dryad_tpu.engine.histogram import build_hist, build_hist_segmented
 from dryad_tpu.engine.split import NEG_INF, find_best_split
+from dryad_tpu.policy.table import GATE_DEFAULTS as _POLICY_DEFAULTS
 
 from dryad_tpu.config import (  # noqa: F401  (re-exported API)
     LEAFWISE_HIST_BYTES_BUDGET as _HIST_BYTES_BUDGET,
@@ -119,8 +120,10 @@ def phase_plan(depth_cap: int):
 # noise for any row count the expansion budget admits, while the
 # recoverable per-level sort+gather stays fixed (~164 ms/level at 10M) —
 # so deeper caps keep the legacy plan path (a written verdict, not a
-# TODO; the gate cannot consult N — same-program rule).
-_MAX_WIRED_SEGMENTS = 1024
+# TODO; the gate cannot consult N — same-program rule).  r23: the cap
+# lives in the policy table ("leafwise_layout"/"max_segments"); this
+# name is the compatibility re-export of the committed default.
+_MAX_WIRED_SEGMENTS = _POLICY_DEFAULTS["leafwise_layout"]["max_segments"]
 
 
 def leafwise_layout_supported(p: Params, num_features: int, total_bins: int,
@@ -144,7 +147,10 @@ def leafwise_layout_supported(p: Params, num_features: int, total_bins: int,
     # rejects non-subtraction configs before this gate is consulted)
     if not p.hist_subtraction:
         return False
-    return 0 < p.max_depth and (1 << p.max_depth) <= _MAX_WIRED_SEGMENTS
+    from dryad_tpu.policy.gates import resolve
+
+    return resolve("leafwise_layout",
+                   {"max_depth": p.max_depth}) == "layout"
 
 
 def grow_tree_leafwise_batched(

@@ -58,6 +58,15 @@ from dryad_tpu.engine.histogram import (
 )
 from dryad_tpu.engine.split import NEG_INF, find_best_split
 
+# STRUCTURAL packed-word caps for the wired layout (r10): the side
+# derivation rides the same packed per-slot word as the natural-order
+# partition (13-bit threshold, 16-bit slot fields).  These are encoding
+# widths, not measured crossovers — they stay here, never in the policy
+# calibration table (a table can only narrow the CALIBRATED caps that
+# deep_layout_supported routes through policy below).
+_MAX_PACKED_BINS = 1 << 13
+_MAX_PACKED_LEAVES = 1 << 16
+
 
 def partition_prefers_reduce(num_features: int, itemsize: int) -> bool:
     """Partition formulation choice, shared by both level-synchronous
@@ -74,8 +83,13 @@ def partition_prefers_reduce(num_features: int, itemsize: int) -> bool:
     widens the gate to 4 KB/row (u8: F <= 4096, u16: F <= 2048), measured
     on the Epsilon shape (exp_r5_eps.py: reduce 11.1 ms vs gather 18.6 ms
     per pass at 400k x 2000; the whole-run effect measured 10.2 ->
-    7.1 s/iter warm)."""
-    return num_features * itemsize <= 4096
+    7.1 s/iter warm).  r23: the row-byte budget lives in the policy
+    table ("partition"/"reduce_max_row_bytes"); the committed default is
+    the 4 KB above, bitwise-identical resolution."""
+    from dryad_tpu.policy.gates import resolve
+
+    return resolve("partition", {"num_features": num_features,
+                                 "itemsize": itemsize}) == "reduce"
 
 
 def select_bins(Xb: jnp.ndarray, rf: jnp.ndarray) -> jnp.ndarray:
@@ -146,8 +160,9 @@ def deep_layout_supported(p: Params, num_features: int, total_bins: int,
     * ``deep_layout="legacy"`` (explicit opt-out: smoke gate + bench
       comparison arms, and the escape hatch if wired drifts on device).
     """
-    from dryad_tpu.engine import leafperm, pallas_hist
+    from dryad_tpu.engine import pallas_hist
     from dryad_tpu.engine.histogram import resolve_backend
+    from dryad_tpu.policy.gates import resolve
 
     if p.deep_layout == "legacy":
         return False
@@ -157,13 +172,14 @@ def deep_layout_supported(p: Params, num_features: int, total_bins: int,
     if not pallas_hist.supports(total_bins):
         return False
     L = p.effective_num_leaves
-    if not (total_bins <= (1 << 13) and L < (1 << 16)):
+    if not (total_bins <= _MAX_PACKED_BINS and L < _MAX_PACKED_LEAVES):
         return False
-    if L > 512:
-        return False
-    if 9 + num_features * bin_itemsize > leafperm._REC_WB:
-        return False
-    return True
+    # the CALIBRATED caps (leaf budget, record width) route through the
+    # policy table; structural exclusions above never do
+    return resolve("deep_layout",
+                   {"num_leaves": L,
+                    "record_bytes": 9 + num_features * bin_itemsize}
+                   ) == "layout"
 
 
 def phase_plan(depth_cap: int, num_leaves: int, nat_live: bool):

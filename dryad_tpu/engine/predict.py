@@ -24,6 +24,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from dryad_tpu.policy.table import GATE_DEFAULTS as _POLICY_DEFAULTS
+
 # ---- packed node-word layout (r21) ----------------------------------------
 # Gather cost on TPU is per-ACCESS, not per-byte (CLAUDE.md measured
 # lowering facts), so the traversal fields of one node are packed into a
@@ -44,21 +46,32 @@ PACKED_THRESHOLD_BITS = 16  # bin ids: max_bins <= 65536
 PACKED_FEATURE_BITS = 12    # column ids in the binned matrix
 
 
-def packed_fields_fit(feature, threshold, left, right) -> bool:
-    """True when every traversal field fits its packed-word width (checked
-    against the ACTUAL staged values, not declared dims — a sliced model can
-    fit even when the full one would not)."""
+def packed_fallback_reason(feature, threshold, left, right):
+    """The first traversal field that overflows its packed-word width,
+    named (``"threshold max 70000 exceeds 16-bit packed width"``), or
+    None when everything fits (checked against the ACTUAL staged values,
+    not declared dims — a sliced model can fit even when the full one
+    would not).  The reason rides the policy decision record into serve
+    ``/stats`` so an operator can see WHY a model serves legacy (r23)."""
     feature = np.asarray(feature)
     internal = feature >= 0
     if not internal.any():
-        return True
-    limits = ((feature, PACKED_FEATURE_BITS),
-              (np.asarray(threshold), PACKED_THRESHOLD_BITS),
-              (np.asarray(left), PACKED_CHILD_BITS),
-              (np.asarray(right), PACKED_CHILD_BITS))
-    return all(
-        int(arr[internal].min()) >= 0 and int(arr[internal].max()) < (1 << bits)
-        for arr, bits in limits)
+        return None
+    named = (("feature", feature, PACKED_FEATURE_BITS),
+             ("threshold", np.asarray(threshold), PACKED_THRESHOLD_BITS),
+             ("left", np.asarray(left), PACKED_CHILD_BITS),
+             ("right", np.asarray(right), PACKED_CHILD_BITS))
+    for name, arr, bits in named:
+        lo, hi = int(arr[internal].min()), int(arr[internal].max())
+        if lo < 0 or hi >= (1 << bits):
+            return (f"{name} range {lo}..{hi} exceeds its "
+                    f"{bits}-bit packed width")
+    return None
+
+
+def packed_fields_fit(feature, threshold, left, right) -> bool:
+    """True when every traversal field fits its packed-word width."""
+    return packed_fallback_reason(feature, threshold, left, right) is None
 
 
 def pack_node_words(feature, threshold, left, right, default_left,
@@ -240,8 +253,12 @@ def sharded_accumulate_fn(mesh, depth_bound: int):
 # below ~32k row-outputs the per-shard blocks are too small to beat the
 # single-device program's dispatch cost, and interactive traffic stays on
 # the fast path.  The serving layer exposes this as its default
-# ``sharded_threshold``; callers gate on rows × num_outputs.
-SHARDED_MIN_WORK = 1 << 15
+# ``sharded_threshold``; callers gate on rows × num_outputs.  r23: the
+# constant lives in the policy table ("predict_sharded"/"min_work");
+# this name is the compatibility re-export of the committed default —
+# serve resolves its live default through gate_value() so a calibrated
+# device entry can move it.
+SHARDED_MIN_WORK = _POLICY_DEFAULTS["predict_sharded"]["min_work"]
 
 
 def predict_binned_sharded(booster, Xb, num_iteration: Optional[int] = None,
@@ -333,9 +350,13 @@ def stage_trees(booster, num_iteration: Optional[int] = None,
     if layout is None:
         layout = getattr(booster.params, "predict_layout", "auto")
     if layout == "auto":
-        layout = "packed" if packed_fields_fit(
+        from dryad_tpu.policy.gates import resolve
+
+        reason = packed_fallback_reason(
             trees["feature"], trees["threshold"], trees["left"],
-            trees["right"]) else "legacy"
+            trees["right"])
+        layout = resolve("predict_layout", {"fits": reason is None},
+                         detail=reason)
     has_cat = bool(np.asarray(trees["is_cat"]).any())
     if layout == "packed":
         words = pack_node_words(

@@ -349,6 +349,57 @@ def _build_route_gather(rows, F, B, P, seed):
     return step, (tile_run, run_slot), {"rows": rows, "num_slots": P}
 
 
+def _build_partition_reduce(rows, F, B, P, seed):
+    """The partition column-select's masked-reduce arm (levelwise
+    ``select_bins`` when ``partition_prefers_reduce`` admits): max over
+    the CONTIGUOUS (N, F) matrix where the per-row feature id matches.
+    The rf vector is ROLLED by the carried scalar so the selected column
+    set changes every iteration — a whole-unit advance into integer
+    indices, the r5 dead-input class this harness rejects.  Comparison
+    arm for the r23 ``partition`` calibration gate (vs the gather probe
+    below at the same shape)."""
+    import jax.numpy as jnp
+
+    rng, Xb, _, _ = _synth(rows, F, B, seed)
+    rf = jnp.asarray(rng.integers(0, F, size=rows).astype(np.int32))
+    Xb = jnp.asarray(Xb)
+
+    def step(s, Xb, rf):
+        si = s.astype(jnp.int32)
+        rfi = jnp.roll(rf, si)
+        iota_f = jnp.arange(Xb.shape[1], dtype=jnp.int32)
+        sel = jnp.max(
+            jnp.where(rfi[:, None] == iota_f[None, :], Xb,
+                      jnp.zeros((), Xb.dtype)),
+            axis=1).astype(jnp.float32)
+        # whole-column SUM: the rolled rf re-selects random bins, so the
+        # contrib moves by far more than its fp32 ulp
+        return s + 1.0, sel[0] + jnp.sum(sel) / rows
+
+    return step, (Xb, rf), {"rows": rows}
+
+
+def _build_partition_gather(rows, F, B, P, seed):
+    """The partition column-select's per-row gather arm
+    (``take_along_axis`` into (N, F) — the ~per-ACCESS-cost formulation;
+    CLAUDE.md gather facts).  Same fixture, perturbation, and contrib as
+    the reduce probe so the pair is a clean A/B at any width."""
+    import jax.numpy as jnp
+
+    rng, Xb, _, _ = _synth(rows, F, B, seed)
+    rf = jnp.asarray(rng.integers(0, F, size=rows).astype(np.int32))
+    Xb = jnp.asarray(Xb)
+
+    def step(s, Xb, rf):
+        si = s.astype(jnp.int32)
+        rfi = jnp.roll(rf, si)
+        sel = jnp.take_along_axis(
+            Xb, rfi[:, None], axis=1)[:, 0].astype(jnp.float32)
+        return s + 1.0, sel[0] + jnp.sum(sel) / rows
+
+    return step, (Xb, rf), {"rows": rows}
+
+
 def _build_hist_reduce_scan(rows, F, B, P, seed, n_shards: int = 8):
     """The feature-parallel reduction's per-device scan stage (r16): the
     sliced best-split scan over ONE owned F/n feature slice + the packed
@@ -565,6 +616,12 @@ PROBES: dict[str, StageProbe] = {p.name: p for p in (
     StageProbe("route_gather",
                "wired per-level packed route small-table gather",
                _build_route_gather),
+    StageProbe("partition_reduce",
+               "partition column-select, masked-reduce arm (select_bins)",
+               _build_partition_reduce),
+    StageProbe("partition_gather",
+               "partition column-select, per-row gather arm",
+               _build_partition_gather),
     StageProbe("predict_traversal",
                "per-tree traversal (tree_leaves) on a depth-6 tree",
                _build_predict_traversal),

@@ -26,6 +26,16 @@ from __future__ import annotations
 import dataclasses
 
 
+def _default_ladder() -> tuple[int, ...]:
+    """The calibrated degradation ladder (r23: policy table
+    "chunk_cap"/"ladder"; the committed default is the pre-r23
+    ``(8, 4, 2)`` — STATUS r5's known-safe tunnel floor).  The policy
+    package is stdlib-only, so this keeps the module jax-free."""
+    from dryad_tpu.policy.gates import gate_value
+
+    return tuple(int(s) for s in gate_value("chunk_cap", "ladder"))
+
+
 @dataclasses.dataclass(frozen=True)
 class RetryPolicy:
     """Static supervision knobs (see module docstring)."""
@@ -42,7 +52,8 @@ class RetryPolicy:
     backoff_max_s: float = 60.0
     #: chunk-cap degradation steps, widest first, ending on the known-safe
     #: floor; degrade() moves to the first step below the current cap
-    ch_max_ladder: tuple[int, ...] = (8, 4, 2)
+    ch_max_ladder: tuple[int, ...] = dataclasses.field(
+        default_factory=_default_ladder)
     #: initial cap (0 = uncapped until the first fetch-death)
     ch_max_start: int = 0
     #: consecutive clean chunks before the cap re-widens one step

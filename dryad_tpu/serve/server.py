@@ -123,9 +123,13 @@ class PredictServer:
             self.registry.metrics = self.metrics
         self.mesh = self._make_mesh(sharded)
         if sharded_threshold is None:
-            from dryad_tpu.engine.predict import SHARDED_MIN_WORK
+            # r23: the live default comes from the policy table (the
+            # committed value IS predict.SHARDED_MIN_WORK; a calibrated
+            # device entry can move it without a redeploy)
+            from dryad_tpu.policy.gates import gate_value
 
-            sharded_threshold = SHARDED_MIN_WORK
+            sharded_threshold = int(gate_value("predict_sharded",
+                                               "min_work"))
         # threshold in rows × outputs; sharded=True forces the mesh arm for
         # every bucket, False (or a 1-device mesh) disables it entirely.
         # NOTE the interplay with max_batch_rows: buckets cap there, so at
@@ -434,6 +438,11 @@ class PredictServer:
         snap["mesh_shards"] = self.cache.n_shards
         snap["sharded_threshold"] = self.cache.sharded_threshold
         snap["memory"] = self.registry.memory()
+        from dryad_tpu.policy.gates import stats_block
+
+        # r23: table provenance + newest decision per gate (incl. the
+        # predict_layout fallback reason when a model serves legacy)
+        snap["policy"] = stats_block()
         drift = self.drift_report()
         if drift:
             snap["drift"] = {

@@ -278,14 +278,9 @@ def main(argv=None) -> int:
     # (dryad_tpu/obs/trends.py) keys serve history off data, not filenames
     from dryad_tpu.obs.trends import artifact_stamp
 
-    try:
-        import jax
-
-        _dev = jax.devices()[0]
-        _kind = getattr(_dev, "device_kind", None) or _dev.platform
-    except Exception:  # noqa: BLE001 — a stamp must never kill the bench
-        _kind = None
-    stamp = artifact_stamp(device_kind=_kind)
+    # r23: device_kind rides the stamp's "auto" default — the ONE
+    # derivation (policy/device.py), best-effort like the old inline probe
+    stamp = artifact_stamp()
     report.update(stamp)
     summary.update(stamp)
 

@@ -249,8 +249,12 @@ def test_artifact_stamp_in_repo_and_outside(tmp_path):
     assert stamp["schema_version"] == SCHEMA_VERSION
     assert stamp["device_kind"] == "cpu"
     assert stamp["git_rev"]          # this repo IS a git checkout
-    lost = artifact_stamp(root=str(tmp_path))    # no git here
+    lost = artifact_stamp(device_kind=None, root=str(tmp_path))  # no git here
     assert lost["git_rev"] is None and lost["device_kind"] is None
+    # r23: the default resolves through the ONE derivation
+    from dryad_tpu.policy.device import current_device_kind
+    auto = artifact_stamp(root=str(tmp_path))
+    assert auto["device_kind"] == current_device_kind()
 
 
 # ---- the CLI gate -----------------------------------------------------------
